@@ -1,0 +1,41 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Tasks, actors, and shared-memory objects on an asyncio+C++ core runtime;
+gang scheduling via placement groups; SPMD parallelism over JAX device
+meshes with XLA/ICI collectives; and AI libraries (train/tune/data/serve/
+rllib) layered on top.  Role-equivalent to the reference framework (ray)
+but designed TPU-first — see SURVEY.md at the repo root.
+"""
+
+from ray_tpu._version import version as __version__  # noqa: F401
+
+_API_EXPORTS = {}
+
+
+def __getattr__(name):
+    # Lazy core-API import: importing `ray_tpu` must stay cheap (and free of
+    # jax) so control-plane processes can use the package without pulling in
+    # the full runtime.
+    if name in (
+        "init",
+        "shutdown",
+        "is_initialized",
+        "remote",
+        "get",
+        "put",
+        "wait",
+        "kill",
+        "cancel",
+        "get_runtime_context",
+        "available_resources",
+        "cluster_resources",
+        "nodes",
+        "method",
+        "ObjectRef",
+        "ActorHandle",
+        "timeline",
+    ):
+        from ray_tpu.core import api
+
+        return getattr(api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

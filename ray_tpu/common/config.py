@@ -1,0 +1,97 @@
+"""Central config/flag registry.
+
+Role-equivalent of the reference's RAY_CONFIG registry (ray:
+src/ray/common/ray_config_def.h — 218 flags overridable via env vars), done
+the Python way: one declarative table, values overridable via ``RT_<NAME>``
+environment variables, importable everywhere as ``from ray_tpu.common.config
+import cfg``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+class _Config:
+    _DEFS: Dict[str, tuple[type, Any]] = {}
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+
+    @classmethod
+    def define(cls, name: str, typ: type, default: Any) -> None:
+        cls._DEFS[name] = (typ, default)
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._DEFS:
+            raise AttributeError(f"unknown config flag: {name}")
+        if name not in self._values:
+            typ, default = self._DEFS[name]
+            env = os.environ.get(f"RT_{name.upper()}")
+            if env is None:
+                self._values[name] = default
+            elif typ is bool:
+                self._values[name] = _parse_bool(env)
+            elif typ in (dict, list):
+                self._values[name] = json.loads(env)
+            else:
+                self._values[name] = typ(env)
+        return self._values[name]
+
+    def override(self, name: str, value: Any) -> None:
+        if name not in self._DEFS:
+            raise AttributeError(f"unknown config flag: {name}")
+        self._values[name] = value
+
+    def reset(self) -> None:
+        self._values.clear()
+
+
+D = _Config.define
+
+# --- wire protocol / rpc ---
+D("rpc_max_frame_bytes", int, 512 * 1024 * 1024)
+D("rpc_connect_timeout_s", float, 30.0)
+D("rpc_call_timeout_s", float, 120.0)
+D("heartbeat_interval_s", float, 1.0)
+D("node_death_timeout_s", float, 10.0)
+
+# --- object store ---
+D("object_store_bytes", int, 0)  # 0 = auto (30% of /dev/shm free, capped)
+D("object_store_auto_cap_bytes", int, 8 * 1024 * 1024 * 1024)
+D("inline_object_max_bytes", int, 100 * 1024)  # small results ride the RPC reply
+D("object_chunk_bytes", int, 16 * 1024 * 1024)  # node-to-node transfer chunk
+
+# --- scheduler ---
+D("sched_spread_threshold", float, 0.5)
+D("sched_max_pending_lease_s", float, 60.0)
+D("worker_pool_prestart", int, 0)
+D("worker_idle_timeout_s", float, 300.0)
+D("max_tasks_in_flight_per_worker", int, 4)
+
+# --- workers ---
+D("worker_start_timeout_s", float, 60.0)
+D("worker_nice", int, 0)
+
+# --- logging / observability ---
+D("log_dir", str, "")  # empty = <session_dir>/logs
+D("event_buffer_size", int, 10000)
+D("metrics_export_interval_s", float, 5.0)
+
+# --- accelerators ---
+D("tpu_chips_override", int, -1)  # -1 = autodetect
+D("tpu_topology_override", str, "")
+
+# --- task execution ---
+D("task_max_retries_default", int, 3)
+D("actor_max_restarts_default", int, 0)
+
+cfg = _Config()

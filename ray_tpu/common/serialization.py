@@ -1,0 +1,152 @@
+"""Object serialization: cloudpickle + out-of-band (pickle protocol 5) buffers.
+
+Role-equivalent of the reference's SerializationContext (ray:
+python/ray/_private/serialization.py:111).  Large contiguous buffers (numpy
+arrays, jax host arrays, bytes) are carried out-of-band so they can be written
+straight into shared memory without an extra copy, and reads off shared memory
+are zero-copy memoryviews.
+
+Wire layout of a serialized object:
+
+    [u32 meta_len][meta pickle][u32 nbufs]
+    ([u64 buf_len][buf bytes]) * nbufs
+
+The metadata pickle references the buffers positionally via
+pickle.PickleBuffer out-of-band serialization.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+from typing import Any, Callable, List, Optional, Sequence
+
+import cloudpickle
+
+_HEADER = struct.Struct("<I")
+_BUFHDR = struct.Struct("<Q")
+
+
+class SerializedObject:
+    """A serialized object: one metadata pickle plus N out-of-band buffers."""
+
+    __slots__ = ("meta", "buffers")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview]):
+        self.meta = meta
+        self.buffers = buffers
+
+    @property
+    def total_bytes(self) -> int:
+        n = _HEADER.size + len(self.meta) + _HEADER.size
+        for b in self.buffers:
+            n += _BUFHDR.size + b.nbytes
+        return n
+
+    def write_into(self, dest: memoryview) -> int:
+        """Write wire format into `dest`; returns bytes written."""
+        off = 0
+        _HEADER.pack_into(dest, off, len(self.meta))
+        off += _HEADER.size
+        dest[off : off + len(self.meta)] = self.meta
+        off += len(self.meta)
+        _HEADER.pack_into(dest, off, len(self.buffers))
+        off += _HEADER.size
+        for b in self.buffers:
+            _BUFHDR.pack_into(dest, off, b.nbytes)
+            off += _BUFHDR.size
+            dest[off : off + b.nbytes] = b.cast("B") if b.format != "B" else b
+            off += b.nbytes
+        return off
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_bytes)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def _restore_numpy(a):
+    return a
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    """Cloudpickle with isinstance-based custom reducers (handles jax.Array
+    subclasses anywhere inside a container graph)."""
+
+    def __init__(self, file, custom_reducers, **kw):
+        super().__init__(file, **kw)
+        self._custom = custom_reducers
+
+    def reducer_override(self, obj):
+        jax = sys.modules.get("jax")
+        if jax is not None and isinstance(obj, jax.Array):
+            import numpy as np
+
+            return (_restore_numpy, (np.asarray(obj),))
+        for typ, red in self._custom.items():
+            if isinstance(obj, typ):
+                return red(obj)
+        return super().reducer_override(obj)
+
+
+class SerializationContext:
+    """Pickles python objects with out-of-band buffer extraction."""
+
+    def __init__(self):
+        self._custom_reducers = {}
+
+    def register_reducer(self, typ: type, reducer: Callable) -> None:
+        self._custom_reducers[typ] = reducer
+
+    def serialize(self, obj: Any) -> SerializedObject:
+        import io
+
+        buffers: List[memoryview] = []
+
+        def cb(pb: pickle.PickleBuffer):
+            buffers.append(pb.raw())
+            return False  # buffer handled out-of-band
+
+        meta_io = io.BytesIO()
+        pickler = _Pickler(
+            meta_io, self._custom_reducers, protocol=5, buffer_callback=cb
+        )
+        pickler.dump(obj)
+        return SerializedObject(meta_io.getvalue(), buffers)
+
+    def deserialize(self, data: memoryview | bytes) -> Any:
+        mv = memoryview(data)
+        off = 0
+        (meta_len,) = _HEADER.unpack_from(mv, off)
+        off += _HEADER.size
+        meta = mv[off : off + meta_len]
+        off += meta_len
+        (nbufs,) = _HEADER.unpack_from(mv, off)
+        off += _HEADER.size
+        buffers = []
+        for _ in range(nbufs):
+            (blen,) = _BUFHDR.unpack_from(mv, off)
+            off += _BUFHDR.size
+            buffers.append(mv[off : off + blen])
+            off += blen
+        return pickle.loads(bytes(meta) if isinstance(meta, memoryview) else meta,
+                            buffers=buffers)
+
+
+_default_context: Optional[SerializationContext] = None
+
+
+def get_context() -> SerializationContext:
+    global _default_context
+    if _default_context is None:
+        _default_context = SerializationContext()
+    return _default_context
+
+
+def serialize(obj: Any) -> SerializedObject:
+    return get_context().serialize(obj)
+
+
+def deserialize(data: memoryview | bytes) -> Any:
+    return get_context().deserialize(data)

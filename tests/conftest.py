@@ -1,0 +1,39 @@
+"""Global test configuration.
+
+Tests run on CPU with a virtual 8-device mesh so every sharding path
+(dp/fsdp/tp/sp) is exercised without TPU hardware, mirroring how the
+reference tests multi-node logic in-process (ray: python/ray/tests/conftest.py
+fixtures + cluster_utils.Cluster).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process tree.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt_start_regular():
+    """Fresh single-node cluster for a test (ray: conftest.py ray_start_regular:419)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def rt_start_shared():
+    """Shared single-node cluster for a test module (ray_start_regular_shared)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()

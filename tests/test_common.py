@@ -1,0 +1,131 @@
+"""Tests for the common substrate: ids, config, resources, serialization."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu.common import ids
+from ray_tpu.common.config import cfg
+from ray_tpu.common.resources import ResourceSet, validate_task_resources
+from ray_tpu.common import serialization as ser
+
+
+class TestIDs:
+    def test_random_unique(self):
+        a, b = ids.TaskID.random(), ids.TaskID.random()
+        assert a != b
+        assert len(a.binary()) == 16
+
+    def test_kind_distinguishes(self):
+        raw = os.urandom(16)
+        assert ids.TaskID(raw) != ids.ActorID(raw)
+
+    def test_object_id_derivation_deterministic(self):
+        t = ids.TaskID.random()
+        assert ids.ObjectID.for_task_return(t, 0) == ids.ObjectID.for_task_return(t, 0)
+        assert ids.ObjectID.for_task_return(t, 0) != ids.ObjectID.for_task_return(t, 1)
+
+    def test_hex_roundtrip(self):
+        t = ids.NodeID.random()
+        assert ids.NodeID.from_hex(t.hex()) == t
+
+    def test_pickle_roundtrip(self):
+        t = ids.ObjectID.random()
+        assert pickle.loads(pickle.dumps(t)) == t
+
+    def test_nil(self):
+        assert ids.ActorID.nil().is_nil()
+        assert not ids.ActorID.random().is_nil()
+
+
+class TestConfig:
+    def test_default(self):
+        assert cfg.inline_object_max_bytes == 100 * 1024
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("RT_HEARTBEAT_INTERVAL_S", "2.5")
+        cfg.reset()
+        assert cfg.heartbeat_interval_s == 2.5
+        cfg.reset()
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(AttributeError):
+            cfg.not_a_flag
+
+
+class TestResources:
+    def test_covers(self):
+        avail = ResourceSet({"CPU": 4, "TPU": 8})
+        assert avail.covers(ResourceSet({"CPU": 1, "TPU": 4}))
+        assert not avail.covers(ResourceSet({"CPU": 5}))
+        assert not avail.covers(ResourceSet({"GPU": 1}))
+
+    def test_fractional_exact(self):
+        avail = ResourceSet({"CPU": 1})
+        half = ResourceSet({"CPU": 0.5})
+        rem = avail.subtract(half).subtract(half)
+        assert rem.is_empty()
+
+    def test_subtract_negative_raises(self):
+        with pytest.raises(ValueError):
+            ResourceSet({"CPU": 1}).subtract(ResourceSet({"CPU": 2}))
+
+    def test_add(self):
+        assert ResourceSet({"CPU": 1}).add(ResourceSet({"CPU": 2, "TPU": 1})).to_dict() == {
+            "CPU": 3.0,
+            "TPU": 1.0,
+        }
+
+    def test_validate_unit_instance(self):
+        validate_task_resources({"TPU": 0.5})
+        validate_task_resources({"TPU": 4})
+        with pytest.raises(ValueError):
+            validate_task_resources({"TPU": 2.5})
+
+    def test_pickle(self):
+        r = ResourceSet({"CPU": 1.5, "TPU": 2})
+        assert pickle.loads(pickle.dumps(r)) == r
+
+
+class TestSerialization:
+    def test_roundtrip_simple(self):
+        for obj in [42, "hello", {"a": [1, 2, (3, None)]}, b"raw"]:
+            s = ser.serialize(obj)
+            assert ser.deserialize(s.to_bytes()) == obj
+
+    def test_numpy_out_of_band(self):
+        arr = np.arange(1 << 16, dtype=np.float32)
+        s = ser.serialize({"x": arr, "tag": 7})
+        # big array must be out-of-band, not embedded in the metadata pickle
+        assert len(s.meta) < 10_000
+        assert sum(b.nbytes for b in s.buffers) >= arr.nbytes
+        out = ser.deserialize(s.to_bytes())
+        np.testing.assert_array_equal(out["x"], arr)
+        assert out["tag"] == 7
+
+    def test_lambda(self):
+        f = lambda x: x * 3  # noqa: E731
+        s = ser.serialize(f)
+        assert ser.deserialize(s.to_bytes())(4) == 12
+
+    def test_jax_array_to_numpy(self):
+        import jax.numpy as jnp
+
+        x = jnp.arange(100, dtype=jnp.float32) * 2
+        s = ser.serialize([x, {"y": x}])
+        out = ser.deserialize(s.to_bytes())
+        assert isinstance(out[0], np.ndarray)
+        np.testing.assert_array_equal(out[0], np.arange(100, dtype=np.float32) * 2)
+        np.testing.assert_array_equal(out[1]["y"], out[0])
+
+    def test_custom_reducer(self):
+        class Weird:
+            def __init__(self, v):
+                self.v = v
+
+        ctx = ser.SerializationContext()
+        ctx.register_reducer(Weird, lambda w: (Weird, (w.v + 1,)))
+        out = ctx.deserialize(ctx.serialize(Weird(1)).to_bytes())
+        assert out.v == 2
