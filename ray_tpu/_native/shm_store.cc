@@ -1,0 +1,702 @@
+// Shared-memory object store: per-node arena with an allocator and object
+// index living *inside* the shared mapping, so any local process can attach
+// and read sealed objects zero-copy.
+//
+// Role-equivalent of the reference's Plasma store (ray:
+// src/ray/object_manager/plasma/{store.h,object_lifecycle_manager.h,
+// eviction_policy.h,dlmalloc.cc}) redesigned daemon-less: instead of a store
+// server process brokering allocations over a unix socket with fd-passing,
+// every client attaches the same file-backed mapping and allocation/index
+// updates are serialized by a robust process-shared mutex.  This removes a
+// socket round-trip from the put/get hot path entirely (the reference needs
+// one per create/seal/get; here those are ~100ns lock acquisitions).
+//
+// Layout of the arena file:
+//   [ Header | client slots | hash-table entries | data region ]
+// All internal references are byte offsets, never pointers, so processes can
+// map at different addresses.
+//
+// Crash tolerance without a daemon (the reference recovers reader pins via
+// client-disconnect handling in the store server): every attached client owns
+// a slot holding its pid and a ledger of its outstanding pins.  rt_store_reap
+// (called by the raylet periodically, and by attach when slots run out)
+// detects dead pids and releases their pins — aborting their half-created
+// objects and unpinning their reads — so a crashed worker can never leak
+// refcounts or arena space permanently.
+//
+// Concurrency model: one mutex per node arena guards allocator + index
+// metadata only; object *payload* writes happen outside the lock (the object
+// is invisible until sealed).  Robust mutex semantics recover the lock if a
+// client dies while holding it.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <new>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5254504c41534d41ULL;  // "RTPLASMA"
+constexpr uint64_t kAlign = 64;
+constexpr uint32_t kIdLen = 16;
+constexpr uint32_t kMaxClients = 128;
+constexpr uint32_t kMaxPinsPerClient = 1024;
+
+// Object states in the index.
+enum : uint32_t {
+  kEmpty = 0,
+  kCreated = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+// Return codes (keep in sync with ray_tpu/_native/store.py).
+enum : int {
+  RT_OK = 0,
+  RT_EXISTS = -1,
+  RT_NOT_FOUND = -2,
+  RT_NO_SPACE = -3,
+  RT_ERR = -4,
+  RT_NOT_SEALED = -5,
+  RT_PINNED = -6,
+  RT_TOO_MANY_PINS = -7,
+  RT_NO_CLIENT_SLOT = -8,
+};
+
+struct Entry {
+  uint8_t id[kIdLen];
+  uint64_t offset;       // data offset from arena base
+  uint64_t size;         // payload size
+  uint64_t last_access;  // logical clock for LRU eviction
+  uint32_t state;
+  uint32_t refcnt;       // pin count; pinned objects are never evicted
+};
+
+struct PinRec {
+  uint8_t id[kIdLen];
+  uint32_t count;
+  uint32_t pad;
+};
+
+struct ClientSlot {
+  uint32_t pid;      // 0 = free
+  uint32_t npins;    // used prefix of pins[]
+  PinRec pins[kMaxPinsPerClient];
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;
+  uint64_t clients_off;
+  uint64_t table_off;
+  uint64_t table_cap;   // number of Entry slots (power of two)
+  uint64_t table_used;  // live + tombstone entries
+  uint64_t tombstones;
+  uint64_t live_objects;
+  uint64_t data_off;
+  uint64_t data_size;
+  uint64_t used_bytes;   // allocated bytes incl. block headers
+  uint64_t free_head;    // offset of first free block (0 = none)
+  uint64_t access_clock; // bumped on every lookup, feeds last_access
+  uint64_t num_evictions;
+  pthread_mutex_t mutex;
+};
+
+// Every data block (free or allocated) carries a boundary-tag header and
+// footer so free() can coalesce with neighbours in O(1).
+struct BlockHeader {
+  uint64_t size;  // total block size incl. header+footer; low bit = free flag
+  uint64_t next_free;
+  uint64_t prev_free;
+};
+constexpr uint64_t kBlockHdr = sizeof(BlockHeader);
+constexpr uint64_t kBlockFtr = sizeof(uint64_t);
+constexpr uint64_t kMinBlock = kBlockHdr + kBlockFtr + kAlign;
+
+inline uint64_t block_size(uint64_t tag) { return tag & ~1ULL; }
+inline bool block_free(uint64_t tag) { return tag & 1ULL; }
+
+struct Store {
+  uint8_t* base;
+  uint64_t map_size;
+  int fd;
+  int32_t client_idx;  // this handle's slot in the client registry
+  Header* hdr() { return reinterpret_cast<Header*>(base); }
+  ClientSlot* clients() {
+    return reinterpret_cast<ClientSlot*>(base + hdr()->clients_off);
+  }
+  Entry* table() { return reinterpret_cast<Entry*>(base + hdr()->table_off); }
+  BlockHeader* block(uint64_t off) {
+    return reinterpret_cast<BlockHeader*>(base + off);
+  }
+  uint64_t* footer(uint64_t off) {
+    return reinterpret_cast<uint64_t*>(base + off + block_size(block(off)->size) -
+                                       kBlockFtr);
+  }
+};
+
+uint64_t round_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 16-byte id.
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kIdLen; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+class Locker {
+ public:
+  explicit Locker(Store* s) : s_(s) {
+    int rc = pthread_mutex_lock(&s_->hdr()->mutex);
+    if (rc == EOWNERDEAD) {
+      // A client died holding the lock. Metadata mutations are small and
+      // ordered; worst case is a leaked created-but-unsealed object, which
+      // rt_store_reap reclaims via the dead client's pin ledger.
+      pthread_mutex_consistent(&s_->hdr()->mutex);
+    }
+  }
+  ~Locker() { pthread_mutex_unlock(&s_->hdr()->mutex); }
+
+ private:
+  Store* s_;
+};
+
+// ---- free-list allocator ------------------------------------------------
+
+void freelist_insert(Store* s, uint64_t off) {
+  Header* h = s->hdr();
+  BlockHeader* b = s->block(off);
+  b->size |= 1ULL;  // mark free
+  *s->footer(off) = b->size;
+  b->next_free = h->free_head;
+  b->prev_free = 0;
+  if (h->free_head) s->block(h->free_head)->prev_free = off;
+  h->free_head = off;
+}
+
+void freelist_remove(Store* s, uint64_t off) {
+  Header* h = s->hdr();
+  BlockHeader* b = s->block(off);
+  if (b->prev_free)
+    s->block(b->prev_free)->next_free = b->next_free;
+  else
+    h->free_head = b->next_free;
+  if (b->next_free) s->block(b->next_free)->prev_free = b->prev_free;
+  b->size &= ~1ULL;
+  *s->footer(off) = b->size;
+}
+
+// Allocate a block with at least `payload` bytes of usable space.
+// Returns data offset (past the header) or 0 on failure.
+uint64_t arena_alloc(Store* s, uint64_t payload) {
+  Header* h = s->hdr();
+  uint64_t need = round_up(payload + kBlockHdr + kBlockFtr, kAlign);
+  if (need < kMinBlock) need = kMinBlock;
+  uint64_t off = h->free_head;
+  while (off) {
+    BlockHeader* b = s->block(off);
+    uint64_t bsz = block_size(b->size);
+    if (bsz >= need) {
+      freelist_remove(s, off);
+      if (bsz - need >= kMinBlock) {
+        // split: tail becomes a new free block
+        uint64_t tail = off + need;
+        b->size = need;
+        *s->footer(off) = need;
+        BlockHeader* t = s->block(tail);
+        t->size = bsz - need;
+        *s->footer(tail) = t->size;
+        freelist_insert(s, tail);
+      }
+      h->used_bytes += block_size(b->size);
+      return off + kBlockHdr;
+    }
+    off = b->next_free;
+  }
+  return 0;
+}
+
+void arena_free(Store* s, uint64_t data_off) {
+  Header* h = s->hdr();
+  uint64_t off = data_off - kBlockHdr;
+  BlockHeader* b = s->block(off);
+  h->used_bytes -= block_size(b->size);
+  // coalesce with next block
+  uint64_t next = off + block_size(b->size);
+  if (next < h->data_off + h->data_size) {
+    BlockHeader* nb = s->block(next);
+    if (block_free(nb->size)) {
+      freelist_remove(s, next);
+      b->size = block_size(b->size) + block_size(nb->size);
+      *s->footer(off) = b->size;
+    }
+  }
+  // coalesce with previous block
+  if (off > h->data_off) {
+    uint64_t prev_tag = *reinterpret_cast<uint64_t*>(s->base + off - kBlockFtr);
+    if (block_free(prev_tag)) {
+      uint64_t prev = off - block_size(prev_tag);
+      freelist_remove(s, prev);
+      s->block(prev)->size = block_size(prev_tag) + block_size(b->size);
+      *s->footer(prev) = s->block(prev)->size;
+      off = prev;
+      b = s->block(off);
+    }
+  }
+  freelist_insert(s, off);
+}
+
+// ---- index --------------------------------------------------------------
+
+Entry* find_entry(Store* s, const uint8_t* id) {
+  Header* h = s->hdr();
+  uint64_t mask = h->table_cap - 1;
+  uint64_t i = hash_id(id) & mask;
+  for (uint64_t probes = 0; probes < h->table_cap; probes++, i = (i + 1) & mask) {
+    Entry* e = &s->table()[i];
+    if (e->state == kEmpty) return nullptr;
+    if (e->state != kTombstone && memcmp(e->id, id, kIdLen) == 0) return e;
+  }
+  return nullptr;
+}
+
+// Rebuild the index without tombstones (uses a transient heap buffer; called
+// under the lock).
+void purge_tombstones(Store* s) {
+  Header* h = s->hdr();
+  uint64_t cap = h->table_cap;
+  Entry* snapshot = static_cast<Entry*>(malloc(cap * sizeof(Entry)));
+  if (!snapshot) return;
+  memcpy(snapshot, s->table(), cap * sizeof(Entry));
+  memset(s->table(), 0, cap * sizeof(Entry));
+  uint64_t mask = cap - 1;
+  uint64_t live = 0;
+  for (uint64_t i = 0; i < cap; i++) {
+    Entry* e = &snapshot[i];
+    if (e->state == kCreated || e->state == kSealed) {
+      uint64_t j = hash_id(e->id) & mask;
+      while (s->table()[j].state != kEmpty) j = (j + 1) & mask;
+      s->table()[j] = *e;
+      live++;
+    }
+  }
+  free(snapshot);
+  h->table_used = live;
+  h->tombstones = 0;
+}
+
+void make_tombstone(Store* s, Entry* e) {
+  e->state = kTombstone;
+  s->hdr()->tombstones++;
+  s->hdr()->live_objects--;
+}
+
+// Find a slot for inserting `id`. Returns existing entry if the id is live.
+Entry* find_slot(Store* s, const uint8_t* id, bool* reused_tombstone) {
+  Header* h = s->hdr();
+  uint64_t mask = h->table_cap - 1;
+  uint64_t i = hash_id(id) & mask;
+  Entry* first_tomb = nullptr;
+  *reused_tombstone = false;
+  for (uint64_t probes = 0; probes < h->table_cap; probes++, i = (i + 1) & mask) {
+    Entry* e = &s->table()[i];
+    if (e->state == kEmpty) {
+      if (first_tomb) {
+        *reused_tombstone = true;
+        return first_tomb;
+      }
+      return e;
+    }
+    if (e->state == kTombstone) {
+      if (!first_tomb) first_tomb = e;
+    } else if (memcmp(e->id, id, kIdLen) == 0) {
+      return e;  // caller checks state
+    }
+  }
+  if (first_tomb) *reused_tombstone = true;
+  return first_tomb;
+}
+
+// Evict least-recently-used sealed, unpinned objects until `needed` payload
+// bytes could plausibly be allocated.
+// (ray: eviction_policy.h LRUCache analogue, done inline.)
+uint64_t evict_lru(Store* s, uint64_t needed) {
+  Header* h = s->hdr();
+  uint64_t freed = 0;
+  while (freed < needed + (needed >> 2)) {
+    Entry* victim = nullptr;
+    for (uint64_t i = 0; i < h->table_cap; i++) {
+      Entry* e = &s->table()[i];
+      if (e->state == kSealed && e->refcnt == 0) {
+        if (!victim || e->last_access < victim->last_access) victim = e;
+      }
+    }
+    if (!victim) break;
+    freed += victim->size;
+    arena_free(s, victim->offset);
+    make_tombstone(s, victim);
+    h->num_evictions++;
+  }
+  return freed;
+}
+
+// ---- client pin ledger --------------------------------------------------
+
+int ledger_add(Store* s, const uint8_t* id) {
+  ClientSlot* c = &s->clients()[s->client_idx];
+  for (uint32_t i = 0; i < c->npins; i++) {
+    if (memcmp(c->pins[i].id, id, kIdLen) == 0) {
+      c->pins[i].count++;
+      return RT_OK;
+    }
+  }
+  if (c->npins >= kMaxPinsPerClient) return RT_TOO_MANY_PINS;
+  memcpy(c->pins[c->npins].id, id, kIdLen);
+  c->pins[c->npins].count = 1;
+  c->npins++;
+  return RT_OK;
+}
+
+void ledger_remove(Store* s, const uint8_t* id) {
+  ClientSlot* c = &s->clients()[s->client_idx];
+  for (uint32_t i = 0; i < c->npins; i++) {
+    if (memcmp(c->pins[i].id, id, kIdLen) == 0) {
+      if (--c->pins[i].count == 0) {
+        c->pins[i] = c->pins[c->npins - 1];  // swap-remove
+        c->npins--;
+      }
+      return;
+    }
+  }
+}
+
+// Release every pin a client slot holds: unpin sealed reads, abort
+// half-created objects. Called on detach and on reaping a dead client.
+void release_client_pins(Store* s, ClientSlot* c) {
+  Header* h = s->hdr();
+  for (uint32_t i = 0; i < c->npins; i++) {
+    Entry* e = find_entry(s, c->pins[i].id);
+    if (!e) continue;
+    if (e->state == kCreated) {
+      // creator died/left before sealing: reclaim the space
+      arena_free(s, e->offset);
+      make_tombstone(s, e);
+    } else {
+      uint32_t n = c->pins[i].count;
+      e->refcnt = (e->refcnt > n) ? e->refcnt - n : 0;
+    }
+  }
+  c->npins = 0;
+  c->pid = 0;
+}
+
+// Reap clients whose pid no longer exists. Returns number reaped.
+int reap_dead_clients(Store* s) {
+  int reaped = 0;
+  ClientSlot* slots = s->clients();
+  for (uint32_t i = 0; i < kMaxClients; i++) {
+    ClientSlot* c = &slots[i];
+    if (c->pid != 0 && kill((pid_t)c->pid, 0) != 0 && errno == ESRCH) {
+      release_client_pins(s, c);
+      reaped++;
+    }
+  }
+  return reaped;
+}
+
+int32_t claim_client_slot(Store* s) {
+  ClientSlot* slots = s->clients();
+  for (int pass = 0; pass < 2; pass++) {
+    for (uint32_t i = 0; i < kMaxClients; i++) {
+      if (slots[i].pid == 0) {
+        slots[i].pid = (uint32_t)getpid();
+        slots[i].npins = 0;
+        return (int32_t)i;
+      }
+    }
+    if (pass == 0 && reap_dead_clients(s) == 0) break;
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Minimum arena size such that metadata plus a useful data region fit.
+uint64_t rt_store_min_size() {
+  uint64_t meta = round_up(sizeof(Header), kAlign) +
+                  round_up(kMaxClients * sizeof(ClientSlot), kAlign) +
+                  4096 * sizeof(Entry);
+  return round_up(meta, kAlign) + (16ULL << 20);  // + 16MB data floor
+}
+
+// Create a new arena file of `size` bytes at `path` and initialize it.
+// Returns an opaque handle or null.
+void* rt_store_create(const char* path, uint64_t size) {
+  if (size < rt_store_min_size()) return nullptr;
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)size) != 0) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  Store* s = new Store{reinterpret_cast<uint8_t*>(base), size, fd, -1};
+  Header* h = s->hdr();
+  memset(h, 0, sizeof(Header));
+  // Size the index at one slot per 4KB of arena, >= 4096 slots, power of 2.
+  uint64_t cap = 4096;
+  while (cap < size / 4096) cap <<= 1;
+  h->magic = kMagic;
+  h->total_size = size;
+  h->clients_off = round_up(sizeof(Header), kAlign);
+  h->table_off =
+      round_up(h->clients_off + kMaxClients * sizeof(ClientSlot), kAlign);
+  h->table_cap = cap;
+  h->data_off = round_up(h->table_off + cap * sizeof(Entry), kAlign);
+  if (size <= h->data_off + kMinBlock) {  // index for this size doesn't fit
+    munmap(base, size);
+    close(fd);
+    unlink(path);
+    delete s;
+    return nullptr;
+  }
+  h->data_size = size - h->data_off;
+  memset(s->clients(), 0, kMaxClients * sizeof(ClientSlot));
+  memset(s->table(), 0, cap * sizeof(Entry));
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  // One giant free block spanning the data region.
+  BlockHeader* b = s->block(h->data_off);
+  b->size = h->data_size;
+  *s->footer(h->data_off) = b->size;
+  b->next_free = b->prev_free = 0;
+  freelist_insert(s, h->data_off);
+
+  s->client_idx = claim_client_slot(s);
+  return s;
+}
+
+// Attach to an existing arena. Returns handle or null.
+void* rt_store_attach(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Store* s =
+      new Store{reinterpret_cast<uint8_t*>(base), (uint64_t)st.st_size, fd, -1};
+  if (s->hdr()->magic != kMagic) {
+    munmap(base, st.st_size);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  {
+    Locker lock(s);
+    s->client_idx = claim_client_slot(s);
+  }
+  if (s->client_idx < 0) {
+    munmap(base, st.st_size);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void rt_store_detach(void* handle) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  if (s->client_idx >= 0) {
+    Locker lock(s);
+    release_client_pins(s, &s->clients()[s->client_idx]);
+  }
+  munmap(s->base, s->map_size);
+  close(s->fd);
+  delete s;
+}
+
+// Allocate space for an object. On success writes the payload offset (from
+// arena base) to *out_offset; the caller memcpys payload there then seals.
+// If the arena is full, evicts LRU sealed unpinned objects to make room.
+int rt_store_create_object(void* handle, const uint8_t* id, uint64_t size,
+                           uint64_t* out_offset) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  if (s->client_idx < 0) return RT_NO_CLIENT_SLOT;
+  Locker lock(s);
+  Header* h = s->hdr();
+  Entry* existing = find_entry(s, id);
+  if (existing) return RT_EXISTS;
+  // Keep the open-addressing table under 3/4 load: first purge tombstones;
+  // if genuinely too many live objects, evict to make index room.
+  if (h->table_used + 1 > (h->table_cap * 3) / 4) {
+    if (h->tombstones > 0) purge_tombstones(s);
+    if (h->live_objects + 1 > (h->table_cap * 3) / 4) {
+      evict_lru(s, size);
+      purge_tombstones(s);
+      if (h->live_objects + 1 > (h->table_cap * 3) / 4) return RT_NO_SPACE;
+    }
+  }
+  uint64_t off = arena_alloc(s, size);
+  if (!off) {
+    evict_lru(s, size);
+    off = arena_alloc(s, size);
+    if (!off) return RT_NO_SPACE;
+  }
+  bool reused_tomb = false;
+  Entry* e = find_slot(s, id, &reused_tomb);
+  if (!e) {
+    arena_free(s, off);
+    return RT_NO_SPACE;
+  }
+  if (ledger_add(s, id) != RT_OK) {  // creator pin, reaped if creator dies
+    arena_free(s, off);
+    return RT_TOO_MANY_PINS;
+  }
+  if (e->state == kEmpty)
+    h->table_used++;
+  else if (reused_tomb)
+    h->tombstones--;
+  memcpy(e->id, id, kIdLen);
+  e->offset = off;
+  e->size = size;
+  e->state = kCreated;
+  e->refcnt = 1;  // creator holds a pin until seal/abort
+  e->last_access = ++h->access_clock;
+  h->live_objects++;
+  *out_offset = off;
+  return RT_OK;
+}
+
+int rt_store_seal(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) return RT_NOT_FOUND;
+  if (e->state != kCreated) return RT_ERR;
+  e->state = kSealed;
+  if (e->refcnt > 0) e->refcnt--;  // drop creator pin
+  ledger_remove(s, id);
+  return RT_OK;
+}
+
+// Abort an in-progress creation (e.g. serialization failed mid-write).
+int rt_store_abort(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) return RT_NOT_FOUND;
+  if (e->state != kCreated) return RT_ERR;
+  arena_free(s, e->offset);
+  make_tombstone(s, e);
+  ledger_remove(s, id);
+  return RT_OK;
+}
+
+// Look up a sealed object; pins it (caller must rt_store_unpin).
+int rt_store_get(void* handle, const uint8_t* id, uint64_t* out_offset,
+                 uint64_t* out_size) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  if (s->client_idx < 0) return RT_NO_CLIENT_SLOT;
+  Locker lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) return RT_NOT_FOUND;
+  if (e->state != kSealed) return RT_NOT_SEALED;
+  int rc = ledger_add(s, id);
+  if (rc != RT_OK) return rc;
+  e->refcnt++;
+  e->last_access = ++s->hdr()->access_clock;
+  *out_offset = e->offset;
+  *out_size = e->size;
+  return RT_OK;
+}
+
+int rt_store_contains(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find_entry(s, id);
+  return (e && e->state == kSealed) ? 1 : 0;
+}
+
+int rt_store_unpin(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) return RT_NOT_FOUND;
+  if (e->refcnt > 0) e->refcnt--;
+  ledger_remove(s, id);
+  return RT_OK;
+}
+
+// Delete a sealed object (refuses if pinned by readers).
+int rt_store_delete(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e || e->state == kTombstone) return RT_NOT_FOUND;
+  if (e->refcnt > 0) return RT_PINNED;
+  arena_free(s, e->offset);
+  make_tombstone(s, e);
+  return RT_OK;
+}
+
+// Release pins of dead clients; returns number of clients reaped.
+int rt_store_reap(void* handle) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  return reap_dead_clients(s);
+}
+
+void rt_store_stats(void* handle, uint64_t* capacity, uint64_t* used,
+                    uint64_t* objects, uint64_t* evictions) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  Header* h = s->hdr();
+  *capacity = h->data_size;
+  *used = h->used_bytes;
+  *objects = h->live_objects;
+  *evictions = h->num_evictions;
+}
+
+// Base address of the mapping in this process (for zero-copy memoryviews).
+void* rt_store_base(void* handle) {
+  return reinterpret_cast<Store*>(handle)->base;
+}
+
+uint64_t rt_store_map_size(void* handle) {
+  return reinterpret_cast<Store*>(handle)->map_size;
+}
+
+}  // extern "C"

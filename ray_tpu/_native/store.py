@@ -1,0 +1,327 @@
+"""Python client for the native shared-memory object store.
+
+ctypes bindings over ``shm_store.cc`` (the plasma-equivalent; see that file's
+header comment).  The C library owns allocation and the object index; the
+data plane is a plain ``mmap`` of the same arena file, giving zero-copy
+``memoryview`` reads of sealed objects (ray: plasma client.cc mmap-and-read
+analogue, minus the socket protocol).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "shm_store.cc")
+_SO = os.path.join(os.path.dirname(__file__), "libshm_store.so")
+
+RT_OK = 0
+RT_EXISTS = -1
+RT_NOT_FOUND = -2
+RT_NO_SPACE = -3
+RT_ERR = -4
+RT_NOT_SEALED = -5
+RT_PINNED = -6
+RT_TOO_MANY_PINS = -7
+RT_NO_CLIENT_SLOT = -8
+
+_RC_NAMES = {
+    RT_OK: "OK",
+    RT_EXISTS: "EXISTS",
+    RT_NOT_FOUND: "NOT_FOUND",
+    RT_NO_SPACE: "NO_SPACE",
+    RT_ERR: "ERR",
+    RT_NOT_SEALED: "NOT_SEALED",
+    RT_PINNED: "PINNED",
+    RT_TOO_MANY_PINS: "TOO_MANY_PINS",
+    RT_NO_CLIENT_SLOT: "NO_CLIENT_SLOT",
+}
+
+
+def _rc_name(rc: int) -> str:
+    return _RC_NAMES.get(rc, str(rc))
+
+
+class StoreError(Exception):
+    pass
+
+
+class ObjectExistsError(StoreError):
+    pass
+
+
+class ObjectNotFoundError(StoreError):
+    pass
+
+
+class StoreFullError(StoreError):
+    pass
+
+
+def _build_library() -> None:
+    """Compile the .so if missing or older than the source (flock-guarded so
+    concurrent workers don't race)."""
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return
+    lock_path = _SO + ".lock"
+    with open(lock_path, "w") as lf:
+        import fcntl
+
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return
+        tmp = _SO + ".tmp"
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+             _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, _SO)
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        with _lib_lock:
+            if _lib is None:
+                _build_library()
+                lib = ctypes.CDLL(_SO)
+                lib.rt_store_create.restype = ctypes.c_void_p
+                lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+                lib.rt_store_attach.restype = ctypes.c_void_p
+                lib.rt_store_attach.argtypes = [ctypes.c_char_p]
+                lib.rt_store_detach.argtypes = [ctypes.c_void_p]
+                lib.rt_store_create_object.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                    ctypes.POINTER(ctypes.c_uint64),
+                ]
+                lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.rt_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.rt_store_get.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+                ]
+                lib.rt_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.rt_store_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.rt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.rt_store_stats.argtypes = [ctypes.c_void_p] + [
+                    ctypes.POINTER(ctypes.c_uint64)
+                ] * 4
+                lib.rt_store_base.restype = ctypes.c_void_p
+                lib.rt_store_base.argtypes = [ctypes.c_void_p]
+                lib.rt_store_map_size.restype = ctypes.c_uint64
+                lib.rt_store_map_size.argtypes = [ctypes.c_void_p]
+                lib.rt_store_reap.argtypes = [ctypes.c_void_p]
+                lib.rt_store_min_size.restype = ctypes.c_uint64
+                lib.rt_store_min_size.argtypes = []
+                _lib = lib
+    return _lib
+
+
+class PinnedBuffer:
+    """Zero-copy view of a sealed object; unpins on release/del."""
+
+    __slots__ = ("store", "object_id", "view", "_released", "__weakref__")
+
+    def __init__(self, store: "ShmStore", object_id: bytes, view: memoryview):
+        self.store = store
+        self.object_id = object_id
+        self.view = view
+        self._released = False
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self.view.release()
+            self.store._unpin(self.object_id)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+class ShmStore:
+    """One node's shared-memory object store (create or attach)."""
+
+    def __init__(self, path: str, capacity_bytes: int = 0, create: bool = False):
+        self.path = path
+        self._lib = _get_lib()
+        if create:
+            min_size = self._lib.rt_store_min_size()
+            if capacity_bytes < min_size:
+                raise StoreError(
+                    f"store capacity {capacity_bytes} below minimum {min_size} "
+                    "(metadata + 16MB data floor)"
+                )
+            self._h = self._lib.rt_store_create(
+                path.encode(), ctypes.c_uint64(capacity_bytes)
+            )
+            if not self._h:
+                raise StoreError(f"failed to create store arena at {path}")
+        else:
+            self._h = self._lib.rt_store_attach(path.encode())
+            if not self._h:
+                raise StoreError(
+                    f"failed to attach store arena at {path} "
+                    "(missing, corrupt, or client slots exhausted)"
+                )
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, 0)
+        finally:
+            os.close(fd)
+        self._mv = memoryview(self._mm)
+        self._closed = False
+        import weakref
+
+        self._live_pins = weakref.WeakSet()
+        self._created_views: dict = {}  # object_id -> writable view until seal
+
+    # -- write path ------------------------------------------------------
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        """Reserve space; returns a writable view. Must seal() or abort()."""
+        off = ctypes.c_uint64()
+        rc = self._lib.rt_store_create_object(
+            self._h, object_id, ctypes.c_uint64(size), ctypes.byref(off)
+        )
+        if rc == RT_EXISTS:
+            raise ObjectExistsError(object_id.hex())
+        if rc == RT_NO_SPACE:
+            raise StoreFullError(
+                f"object of {size} bytes does not fit (capacity {self.capacity})"
+            )
+        if rc != RT_OK:
+            raise StoreError(f"create failed: {_rc_name(rc)}")
+        view = self._mv[off.value : off.value + size]
+        self._created_views[bytes(object_id)] = view
+        return view
+
+    def seal(self, object_id: bytes) -> None:
+        rc = self._lib.rt_store_seal(self._h, object_id)
+        if rc != RT_OK:
+            raise StoreError(f"seal failed: {_rc_name(rc)}")
+        v = self._created_views.pop(bytes(object_id), None)
+        if v is not None:
+            v.release()
+
+    def abort(self, object_id: bytes) -> None:
+        self._lib.rt_store_abort(self._h, object_id)
+        v = self._created_views.pop(bytes(object_id), None)
+        if v is not None:
+            v.release()
+
+    def put(self, object_id: bytes, data) -> None:
+        """Convenience one-shot: create + copy + seal."""
+        data = memoryview(data).cast("B")
+        buf = self.create(object_id, data.nbytes)
+        buf[:] = data
+        self.seal(object_id)
+
+    # -- read path -------------------------------------------------------
+    def get(self, object_id: bytes) -> Optional[PinnedBuffer]:
+        """Zero-copy pinned view of a sealed object, or None if absent."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rt_store_get(
+            self._h, object_id, ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc in (RT_NOT_FOUND, RT_NOT_SEALED):
+            return None
+        if rc != RT_OK:
+            raise StoreError(f"get failed: {_rc_name(rc)}")
+        view = self._mv[off.value : off.value + size.value]
+        pin = PinnedBuffer(self, object_id, view)
+        self._live_pins.add(pin)
+        return pin
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.rt_store_contains(self._h, object_id))
+
+    def delete(self, object_id: bytes) -> bool:
+        rc = self._lib.rt_store_delete(self._h, object_id)
+        return rc == RT_OK
+
+    def _unpin(self, object_id: bytes) -> None:
+        if not self._closed:
+            self._lib.rt_store_unpin(self._h, object_id)
+
+    # -- admin -----------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.stats()["capacity"]
+
+    def stats(self) -> dict:
+        cap, used, objs, evs = (ctypes.c_uint64() for _ in range(4))
+        self._lib.rt_store_stats(
+            self._h, ctypes.byref(cap), ctypes.byref(used),
+            ctypes.byref(objs), ctypes.byref(evs),
+        )
+        return {
+            "capacity": cap.value,
+            "used": used.value,
+            "objects": objs.value,
+            "evictions": evs.value,
+        }
+
+    def reap(self) -> int:
+        """Release pins held by dead client processes; returns clients reaped."""
+        return self._lib.rt_store_reap(self._h)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        # Force-release outstanding pins and unsealed create views so the
+        # mmap can close; the C side additionally reclaims everything via
+        # the client ledger on detach.
+        for pin in list(self._live_pins):
+            pin.release()
+        for v in self._created_views.values():
+            v.release()
+        self._created_views.clear()
+        self._mv.release()
+        self._mm.close()
+        self._lib.rt_store_detach(self._h)
+        self._closed = True
+
+    def destroy(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def default_store_path(node_id_hex: str) -> str:
+    return f"/dev/shm/rt_store_{node_id_hex[:12]}"
+
+
+def default_capacity() -> int:
+    from ray_tpu.common.config import cfg
+
+    if cfg.object_store_bytes:
+        size = cfg.object_store_bytes
+    else:
+        try:
+            st = os.statvfs("/dev/shm")
+            avail = st.f_bavail * st.f_frsize
+        except OSError:
+            avail = 1 << 30
+        size = min(int(avail * 0.3), cfg.object_store_auto_cap_bytes)
+    return max(size, _get_lib().rt_store_min_size())

@@ -1,10 +1,11 @@
 """Unique identifiers for cluster entities.
 
 Equivalent in role to the reference's ID types (ray: src/ray/common/id.h) but
-designed fresh: every ID is a 16-byte value with a 1-byte kind tag so IDs are
-self-describing on the wire.  ObjectIDs are *derived* from the producing
-TaskID plus a return index, which keeps lineage reconstruction possible
-without a separate table (ray: common/id.h ObjectID::FromIndex analogue).
+designed fresh: every ID is a 16-byte value; the kind lives in the Python
+type (and in message field position on the wire), not in the bytes.
+ObjectIDs are *derived* from the producing TaskID plus a return index, which
+keeps lineage reconstruction possible without a separate table (ray:
+common/id.h ObjectID::FromIndex analogue).
 """
 
 from __future__ import annotations

@@ -37,6 +37,11 @@ def _to_fixed(v: float) -> int:
     fp = round(v * GRANULARITY)
     if fp < 0:
         raise ValueError(f"negative resource quantity: {v}")
+    if fp == 0 and v > 0:
+        raise ValueError(
+            f"resource quantity {v} is below the minimum granularity "
+            f"of {1 / GRANULARITY}"
+        )
     return fp
 
 

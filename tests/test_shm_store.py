@@ -1,0 +1,221 @@
+"""Tests for the native shared-memory object store (C++ + ctypes client)."""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._native.store import (
+    ObjectExistsError,
+    ShmStore,
+    StoreFullError,
+)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ShmStore(str(tmp_path / "arena"), capacity_bytes=32 * 1024 * 1024, create=True)
+    yield s
+    s.destroy()
+
+
+def oid(i: int) -> bytes:
+    return i.to_bytes(16, "little")
+
+
+class TestBasics:
+    def test_put_get_roundtrip(self, store):
+        data = os.urandom(1000)
+        store.put(oid(1), data)
+        with store.get(oid(1)) as buf:
+            assert bytes(buf.view) == data
+
+    def test_get_missing_returns_none(self, store):
+        assert store.get(oid(99)) is None
+        assert not store.contains(oid(99))
+
+    def test_create_seal_visibility(self, store):
+        buf = store.create(oid(2), 64)
+        # unsealed objects are invisible to readers
+        assert store.get(oid(2)) is None
+        buf[:] = b"x" * 64
+        store.seal(oid(2))
+        assert store.contains(oid(2))
+
+    def test_duplicate_create_raises(self, store):
+        store.put(oid(3), b"abc")
+        with pytest.raises(ObjectExistsError):
+            store.create(oid(3), 10)
+
+    def test_abort(self, store):
+        store.create(oid(4), 1024)
+        store.abort(oid(4))
+        assert store.get(oid(4)) is None
+        # space is reclaimed: a big object still fits
+        store.put(oid(5), b"y" * (16 * 1024 * 1024))
+
+    def test_delete(self, store):
+        store.put(oid(6), b"z" * 100)
+        assert store.delete(oid(6))
+        assert store.get(oid(6)) is None
+        assert not store.delete(oid(6))
+
+    def test_delete_refused_while_pinned(self, store):
+        store.put(oid(7), b"w" * 100)
+        buf = store.get(oid(7))
+        assert not store.delete(oid(7))  # pinned
+        buf.release()
+        assert store.delete(oid(7))
+
+    def test_zero_copy_numpy(self, store):
+        arr = np.arange(1 << 18, dtype=np.float32)
+        store.put(oid(8), arr.tobytes())
+        with store.get(oid(8)) as buf:
+            out = np.frombuffer(buf.view, dtype=np.float32)
+            np.testing.assert_array_equal(out, arr)
+            del out
+
+    def test_stats(self, store):
+        st0 = store.stats()
+        store.put(oid(9), b"s" * 4096)
+        st1 = store.stats()
+        assert st1["objects"] == st0["objects"] + 1
+        assert st1["used"] > st0["used"]
+
+
+class TestAllocator:
+    def test_fill_free_reuse(self, store):
+        # fill with many blocks, free every other, allocate again
+        n = 100
+        for i in range(n):
+            store.put(oid(100 + i), b"a" * 100_000)
+        for i in range(0, n, 2):
+            assert store.delete(oid(100 + i))
+        for i in range(n // 2):
+            store.put(oid(1000 + i), b"b" * 100_000)
+        st = store.stats()
+        assert st["objects"] == n
+
+    def test_coalescing_allows_large_alloc(self, store):
+        third = 8 * 1024 * 1024
+        for i in range(3):
+            store.put(oid(200 + i), b"c" * third)
+        for i in range(3):
+            store.delete(oid(200 + i))
+        # after freeing all three adjacent blocks a 24MB object must fit
+        store.put(oid(210), b"d" * (3 * third))
+
+    def test_lru_eviction_on_pressure(self, store):
+        # arena 32MB: put 5 x 10MB with eviction allowed
+        for i in range(5):
+            store.put(oid(300 + i), b"e" * (10 * 1024 * 1024))
+        st = store.stats()
+        assert st["evictions"] >= 2
+        # most recent object survives
+        assert store.contains(oid(304))
+
+    def test_oversize_object_raises(self, store):
+        with pytest.raises(StoreFullError):
+            store.put(oid(400), b"f" * (64 * 1024 * 1024))
+
+    def test_pinned_objects_survive_eviction(self, store):
+        store.put(oid(500), b"g" * (10 * 1024 * 1024))
+        pin = store.get(oid(500))
+        for i in range(5):
+            store.put(oid(501 + i), b"h" * (10 * 1024 * 1024))
+        assert store.contains(oid(500))  # pinned → not evicted
+        pin.release()
+
+
+def _crash_holding_pin(path, object_id):
+    s = ShmStore(path)
+    s.get(object_id)  # pin, then die without unpinning
+    os._exit(1)
+
+
+def _crash_mid_create(path):
+    s = ShmStore(path)
+    s.create(b"half" + b"\x00" * 12, 1 << 20)  # never sealed
+    os._exit(1)
+
+
+def _child_reader(path, object_id, expected, q):
+    try:
+        s = ShmStore(path)
+        with s.get(object_id) as buf:
+            q.put(bytes(buf.view) == expected)
+        s.close()
+    except Exception as e:  # pragma: no cover
+        q.put(repr(e))
+
+
+def _child_writer(path, object_id, payload):
+    s = ShmStore(path)
+    s.put(object_id, payload)
+    s.close()
+
+
+class TestRobustness:
+    def test_tiny_capacity_rejected(self, tmp_path):
+        with pytest.raises(Exception, match="minimum"):
+            ShmStore(str(tmp_path / "tiny"), capacity_bytes=65536, create=True)
+
+    def test_tombstone_churn_no_spurious_eviction(self, store):
+        # cycle >table_cap distinct ids through a nearly-empty arena; the
+        # index must purge tombstones rather than evict live data
+        keep = oid(1)
+        store.put(keep, b"k" * 100)
+        for i in range(9000):
+            store.put(oid(10_000 + i), b"t")
+            store.delete(oid(10_000 + i))
+        assert store.stats()["evictions"] == 0
+        assert store.contains(keep)
+
+    def test_dead_client_pins_reaped(self, store):
+        store.put(oid(700), b"p" * (1 << 20))
+        ctx = multiprocessing.get_context("spawn")
+        p = ctx.Process(target=_crash_holding_pin, args=(store.path, oid(700)))
+        p.start()
+        p.join(timeout=30)
+        store.reap()
+        assert store.delete(oid(700))  # pin released → deletable
+
+    def test_dead_client_unsealed_object_reclaimed(self, store):
+        ctx = multiprocessing.get_context("spawn")
+        p = ctx.Process(target=_crash_mid_create, args=(store.path,))
+        p.start()
+        p.join(timeout=30)
+        before = store.stats()["used"]
+        store.reap()
+        assert store.stats()["used"] < before
+
+    def test_close_with_outstanding_pin(self, tmp_path):
+        s = ShmStore(str(tmp_path / "a2"), capacity_bytes=32 * 1024 * 1024,
+                     create=True)
+        s.put(oid(800), b"q" * 100)
+        pin = s.get(oid(800))
+        assert pin is not None
+        s.destroy()  # must not raise BufferError
+
+
+class TestCrossProcess:
+    def test_child_process_reads(self, store):
+        data = os.urandom(2 << 20)
+        store.put(oid(600), data)
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_reader, args=(store.path, oid(600), data, q))
+        p.start()
+        assert q.get(timeout=30) is True
+        p.join(timeout=10)
+
+    def test_child_process_writes_parent_reads(self, store):
+        payload = os.urandom(1 << 20)
+        ctx = multiprocessing.get_context("spawn")
+        p = ctx.Process(target=_child_writer, args=(store.path, oid(601), payload))
+        p.start()
+        p.join(timeout=30)
+        assert p.exitcode == 0
+        with store.get(oid(601)) as buf:
+            assert bytes(buf.view) == payload
