@@ -328,26 +328,45 @@ Entry* find_slot(Store* s, const uint8_t* id, bool* reused_tombstone) {
   return first_tomb;
 }
 
-// Evict least-recently-used sealed, unpinned objects until `needed` payload
-// bytes could plausibly be allocated.
+// Evict least-recently-used sealed, unpinned objects until `needed_bytes`
+// could plausibly be allocated AND at least `needed_entries` index slots are
+// freed.  Single scan: collect candidates, sort by last_access, evict in
+// order — the lock is held, so no O(table_cap x victims) rescans.
 // (ray: eviction_policy.h LRUCache analogue, done inline.)
-uint64_t evict_lru(Store* s, uint64_t needed) {
+uint64_t evict_lru(Store* s, uint64_t needed_bytes, uint64_t needed_entries = 0) {
   Header* h = s->hdr();
-  uint64_t freed = 0;
-  while (freed < needed + (needed >> 2)) {
-    Entry* victim = nullptr;
-    for (uint64_t i = 0; i < h->table_cap; i++) {
-      Entry* e = &s->table()[i];
-      if (e->state == kSealed && e->refcnt == 0) {
-        if (!victim || e->last_access < victim->last_access) victim = e;
-      }
+  uint64_t byte_target = needed_bytes + (needed_bytes >> 2);
+  struct Cand {
+    uint64_t access;
+    uint64_t idx;
+  };
+  Cand* cands = static_cast<Cand*>(malloc(h->table_cap * sizeof(Cand)));
+  if (!cands) return 0;
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < h->table_cap; i++) {
+    Entry* e = &s->table()[i];
+    if (e->state == kSealed && e->refcnt == 0) {
+      cands[n].access = e->last_access;
+      cands[n].idx = i;
+      n++;
     }
-    if (!victim) break;
-    freed += victim->size;
-    arena_free(s, victim->offset);
-    make_tombstone(s, victim);
+  }
+  qsort(cands, n, sizeof(Cand), [](const void* a, const void* b) {
+    uint64_t aa = static_cast<const Cand*>(a)->access;
+    uint64_t bb = static_cast<const Cand*>(b)->access;
+    return (aa < bb) ? -1 : (aa > bb) ? 1 : 0;
+  });
+  uint64_t freed = 0, entries_freed = 0;
+  for (uint64_t i = 0;
+       i < n && (freed < byte_target || entries_freed < needed_entries); i++) {
+    Entry* e = &s->table()[cands[i].idx];
+    freed += e->size;
+    entries_freed++;
+    arena_free(s, e->offset);
+    make_tombstone(s, e);
     h->num_evictions++;
   }
+  free(cands);
   return freed;
 }
 
@@ -465,7 +484,6 @@ void* rt_store_create(const char* path, uint64_t size) {
   // Size the index at one slot per 4KB of arena, >= 4096 slots, power of 2.
   uint64_t cap = 4096;
   while (cap < size / 4096) cap <<= 1;
-  h->magic = kMagic;
   h->total_size = size;
   h->clients_off = round_up(sizeof(Header), kAlign);
   h->table_off =
@@ -498,6 +516,9 @@ void* rt_store_create(const char* path, uint64_t size) {
   freelist_insert(s, h->data_off);
 
   s->client_idx = claim_client_slot(s);
+  // Publish the magic LAST so a concurrent attach never sees a half-built
+  // arena (attach fails cleanly until initialization completes).
+  __atomic_store_n(&h->magic, kMagic, __ATOMIC_RELEASE);
   return s;
 }
 
@@ -564,7 +585,10 @@ int rt_store_create_object(void* handle, const uint8_t* id, uint64_t size,
   if (h->table_used + 1 > (h->table_cap * 3) / 4) {
     if (h->tombstones > 0) purge_tombstones(s);
     if (h->live_objects + 1 > (h->table_cap * 3) / 4) {
-      evict_lru(s, size);
+      // index genuinely full of live objects: evict by entry count (an
+      // eighth of the table), not bytes — small-object stores would
+      // otherwise free one tiny victim and still report NO_SPACE
+      evict_lru(s, size, h->table_cap / 8);
       purge_tombstones(s);
       if (h->live_objects + 1 > (h->table_cap * 3) / 4) return RT_NO_SPACE;
     }

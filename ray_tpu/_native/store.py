@@ -127,6 +127,17 @@ def _get_lib():
     return _lib
 
 
+def _check_id(object_id: bytes) -> bytes:
+    """The C side reads exactly 16 bytes; anything else is an OOB read."""
+    if not isinstance(object_id, (bytes, bytearray)) or len(object_id) != 16:
+        raise ValueError(
+            f"object id must be exactly 16 bytes, got "
+            f"{type(object_id).__name__} of length "
+            f"{len(object_id) if hasattr(object_id, '__len__') else '?'}"
+        )
+    return bytes(object_id)
+
+
 class PinnedBuffer:
     """Zero-copy view of a sealed object; unpins on release/del."""
 
@@ -197,6 +208,7 @@ class ShmStore:
     # -- write path ------------------------------------------------------
     def create(self, object_id: bytes, size: int) -> memoryview:
         """Reserve space; returns a writable view. Must seal() or abort()."""
+        object_id = _check_id(object_id)
         off = ctypes.c_uint64()
         rc = self._lib.rt_store_create_object(
             self._h, object_id, ctypes.c_uint64(size), ctypes.byref(off)
@@ -214,6 +226,7 @@ class ShmStore:
         return view
 
     def seal(self, object_id: bytes) -> None:
+        object_id = _check_id(object_id)
         rc = self._lib.rt_store_seal(self._h, object_id)
         if rc != RT_OK:
             raise StoreError(f"seal failed: {_rc_name(rc)}")
@@ -222,6 +235,7 @@ class ShmStore:
             v.release()
 
     def abort(self, object_id: bytes) -> None:
+        object_id = _check_id(object_id)
         self._lib.rt_store_abort(self._h, object_id)
         v = self._created_views.pop(bytes(object_id), None)
         if v is not None:
@@ -237,6 +251,7 @@ class ShmStore:
     # -- read path -------------------------------------------------------
     def get(self, object_id: bytes) -> Optional[PinnedBuffer]:
         """Zero-copy pinned view of a sealed object, or None if absent."""
+        object_id = _check_id(object_id)
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         rc = self._lib.rt_store_get(
@@ -252,9 +267,11 @@ class ShmStore:
         return pin
 
     def contains(self, object_id: bytes) -> bool:
+        object_id = _check_id(object_id)
         return bool(self._lib.rt_store_contains(self._h, object_id))
 
     def delete(self, object_id: bytes) -> bool:
+        object_id = _check_id(object_id)
         rc = self._lib.rt_store_delete(self._h, object_id)
         return rc == RT_OK
 

@@ -75,7 +75,7 @@ D("sched_spread_threshold", float, 0.5)
 D("sched_max_pending_lease_s", float, 60.0)
 D("worker_pool_prestart", int, 0)
 D("worker_idle_timeout_s", float, 300.0)
-D("max_tasks_in_flight_per_worker", int, 4)
+D("max_tasks_in_flight_per_worker", int, 1)  # >1 pipelines (uniform tasks)
 
 # --- workers ---
 D("worker_start_timeout_s", float, 60.0)

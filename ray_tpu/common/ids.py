@@ -14,7 +14,7 @@ import hashlib
 import os
 import struct
 
-_ID_LEN = 16  # bytes, excluding the kind tag
+_ID_LEN = 16  # bytes on the wire (kind lives in the Python type only)
 
 
 class BaseID:

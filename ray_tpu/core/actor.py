@@ -1,0 +1,121 @@
+"""Actor classes and handles.
+
+Role-equivalent of ray: python/ray/actor.py (ActorClass:563, ActorHandle:1223,
+restart options :75-97).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.common.config import cfg
+from ray_tpu.common.ids import ActorID
+from ray_tpu.core.remote_function import _build_resources, _strategy_dict
+
+
+class ActorMethod:
+    __slots__ = ("_handle", "_name", "_num_returns")
+
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.runtime import get_runtime
+
+        refs = get_runtime().submit_actor_task(
+            self._handle._actor_id,
+            self._name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            retries=self._handle._max_task_retries,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._max_task_retries))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+        )
+
+
+class ActorClass:
+    def __init__(self, cls, **default_opts):
+        self._cls = cls
+        self._opts = default_opts
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return ActorClass(self._cls, **merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu.core.runtime import get_runtime
+
+        o = self._opts
+        # actors default to 0 CPU (like the reference) unless asked
+        resources = _build_resources(
+            o.get("num_cpus", 0), o.get("num_tpus"), o.get("num_gpus"),
+            o.get("memory"), o.get("resources"),
+        )
+        max_task_retries = o.get("max_task_retries", 0)
+        actor_id = get_runtime().create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=o.get("name"),
+            namespace=o.get("namespace", "default"),
+            get_if_exists=o.get("get_if_exists", False),
+            resources=resources,
+            max_restarts=o.get(
+                "max_restarts", cfg.actor_max_restarts_default
+            ),
+            max_task_retries=max_task_retries,
+            detached=(o.get("lifetime") == "detached"),
+            strategy=_strategy_dict(o.get("scheduling_strategy")),
+        )
+        return ActorHandle(actor_id, max_task_retries)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated directly; "
+            "use .remote()"
+        )
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    """Look up a named actor (ray: ray.get_actor)."""
+    from ray_tpu.core.errors import RayTpuError
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    info = rt._run(
+        rt.gcs.call("get_actor", {"name": name, "namespace": namespace})
+    )
+    if info is None or info["state"] == "DEAD":
+        raise RayTpuError(f"no live actor named {name!r} in namespace {namespace!r}")
+    return ActorHandle(ActorID(info["actor_id"]))

@@ -1,0 +1,821 @@
+"""GCS: the cluster-global control plane.
+
+Role-equivalent of the reference's GCS server (ray:
+src/ray/gcs/gcs_server/gcs_server.h:78 and the managers under it —
+GcsNodeManager, GcsActorManager gcs_actor_manager.h:281, GcsJobManager,
+GcsKvManager, GcsHealthCheckManager) plus the *global* half of scheduling.
+
+Design difference from the reference, on purpose: the reference scatters
+scheduling across per-node raylets with spillback (raylet/scheduling/
+cluster_task_manager.h) because its clusters are huge and heterogeneous.
+A TPU cluster is a few hundred hosts arranged in slices, and gang placement
+is the common case — so scheduling here is GCS-centric: submitters lease
+workers from the GCS scheduler (amortized by client-side lease reuse), and
+the raylet is just a worker factory.  This removes the lease-spillback
+round-trips entirely and makes gang (slice) placement a single atomic
+decision.
+
+All state is in-memory; persistence/HA hooks live behind `CheckpointStore`
+(flushed on change, reloadable on restart — the reference's Redis-backed
+StoreClient analogue, gcs/store_client/store_client.h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.common.config import cfg
+from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+from ray_tpu.common.resources import ResourceSet
+from ray_tpu.core import rpc
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# Tables
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class NodeEntry:
+    node_id: NodeID
+    address: str  # raylet rpc address
+    resources_total: ResourceSet
+    resources_available: ResourceSet
+    labels: Dict[str, str]
+    conn: rpc.Connection
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class LeaseEntry:
+    lease_id: int
+    node_id: NodeID
+    worker_id: WorkerID
+    worker_addr: str
+    resources: ResourceSet
+    client_conn: rpc.Connection  # the submitter holding the lease
+    actor_id: Optional[ActorID] = None  # set for actor-dedicated leases
+
+
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+@dataclass
+class ActorEntry:
+    actor_id: ActorID
+    name: Optional[str]
+    namespace: str
+    state: str
+    owner_job: JobID
+    max_restarts: int
+    restarts_used: int = 0
+    creation_spec: Any = None  # serialized class+args, kept for restarts
+    resources: Dict[str, float] = field(default_factory=dict)
+    scheduling: Dict[str, Any] = field(default_factory=dict)
+    worker_addr: Optional[str] = None
+    node_id: Optional[NodeID] = None
+    lease_id: Optional[int] = None
+    detached: bool = False
+    death_cause: Optional[str] = None
+    num_pending_restart_waiters: int = 0
+
+
+@dataclass
+class PendingLease:
+    """A queued lease request waiting for capacity."""
+
+    fut: asyncio.Future
+    demand: ResourceSet
+    strategy: Dict[str, Any]
+    client_conn: rpc.Connection
+    actor_id: Optional[ActorID]
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+# --------------------------------------------------------------------------
+# Scheduler policies (ray: raylet/scheduling/policy/* redesigned global)
+# --------------------------------------------------------------------------
+
+
+class Scheduler:
+    """Global resource accounting + node selection."""
+
+    def __init__(self, gcs: "GcsServer"):
+        self.gcs = gcs
+        self.pending: List[PendingLease] = []
+
+    def feasible_nodes(self, demand: ResourceSet) -> List[NodeEntry]:
+        return [
+            n
+            for n in self.gcs.nodes.values()
+            if n.alive and n.resources_total.covers(demand)
+        ]
+
+    def pick_node(
+        self, demand: ResourceSet, strategy: Dict[str, Any]
+    ) -> Optional[NodeEntry]:
+        """Returns a node with available capacity, or None (queue it)."""
+        stype = strategy.get("type", "default")
+        if stype == "node_affinity":
+            node = self.gcs.nodes.get(NodeID.from_hex(strategy["node_id"]))
+            if node and node.alive and node.resources_available.covers(demand):
+                return node
+            if node and strategy.get("soft", False):
+                pass  # fall through to default placement
+            elif node:
+                return None  # hard affinity: wait for that node
+            # unknown node id with hard affinity -> handled by caller
+        candidates = [
+            n
+            for n in self.gcs.nodes.values()
+            if n.alive and n.resources_available.covers(demand)
+        ]
+        if not candidates:
+            return None
+        if stype == "spread":
+            # least-utilized first
+            return min(
+                candidates,
+                key=lambda n: n.resources_available.utilization(n.resources_total),
+            )
+        # default: hybrid binpack — prefer the most-utilized node that still
+        # fits while below the spread threshold, so small tasks pack and big
+        # clusters don't fragment (ray: hybrid_scheduling_policy.cc in spirit)
+        thresh = cfg.sched_spread_threshold
+        packed = [
+            n
+            for n in candidates
+            if n.resources_available.utilization(n.resources_total) < thresh
+        ]
+        pool = packed or candidates
+        return max(
+            pool, key=lambda n: n.resources_available.utilization(n.resources_total)
+        )
+
+
+# --------------------------------------------------------------------------
+# GCS server
+# --------------------------------------------------------------------------
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = rpc.Server(
+            self._handle, host=host, port=port, on_close=self._conn_closed
+        )
+        self.nodes: Dict[NodeID, NodeEntry] = {}
+        self.actors: Dict[ActorID, ActorEntry] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (ns, name)
+        self.jobs: Dict[JobID, dict] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.leases: Dict[int, LeaseEntry] = {}
+        self._lease_ids = iter(range(1, 1 << 62))
+        self.scheduler = Scheduler(self)
+        # object directory: object_id bytes -> {node_id}
+        self.object_locations: Dict[bytes, Set[NodeID]] = {}
+        self.object_sizes: Dict[bytes, int] = {}
+        self._location_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        # pubsub: channel -> set of conns
+        self.subscribers: Dict[str, Set[rpc.Connection]] = {}
+        # conn bookkeeping
+        self._conn_leases: Dict[rpc.Connection, Set[int]] = {}
+        self._conn_node: Dict[rpc.Connection, NodeID] = {}
+        self._conn_job: Dict[rpc.Connection, JobID] = {}
+        self._worker_conns: Dict[WorkerID, rpc.Connection] = {}
+        self._health_task: Optional[asyncio.Task] = None
+        self._start_time = time.time()
+
+    # ---- lifecycle -----------------------------------------------------
+    async def start(self):
+        await self.server.start()
+        self._health_task = asyncio.get_running_loop().create_task(
+            self._health_loop()
+        )
+        logger.info("GCS listening on %s", self.server.address)
+
+    async def close(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.close()
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    # ---- dispatch ------------------------------------------------------
+    async def _handle(self, conn: rpc.Connection, method: str, p: Any):
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            raise rpc.RpcError(f"GCS: unknown method {method!r}")
+        return await fn(conn, p)
+
+    def _conn_closed(self, conn: rpc.Connection):
+        loop = asyncio.get_event_loop()
+        loop.create_task(self._cleanup_conn(conn))
+
+    async def _cleanup_conn(self, conn: rpc.Connection):
+        # release leases held by a disconnected submitter
+        for lease_id in list(self._conn_leases.pop(conn, ())):
+            await self._release_lease(lease_id)
+        # node connection lost -> node death
+        node_id = self._conn_node.pop(conn, None)
+        if node_id is not None:
+            await self._on_node_death(node_id, "raylet connection lost")
+        job_id = self._conn_job.pop(conn, None)
+        if job_id is not None:
+            await self._on_job_finished(job_id)
+        for wid, c in list(self._worker_conns.items()):
+            if c is conn:
+                del self._worker_conns[wid]
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+
+    # ---- health --------------------------------------------------------
+    async def _health_loop(self):
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
+                    await self._on_node_death(node.node_id, "heartbeat timeout")
+
+    async def _on_node_death(self, node_id: NodeID, reason: str):
+        node = self.nodes.get(node_id)
+        if not node or not node.alive:
+            return
+        node.alive = False
+        logger.warning("node %s died: %s", node_id, reason)
+        # drop object locations on that node
+        for oid, locs in list(self.object_locations.items()):
+            locs.discard(node_id)
+            if not locs:
+                del self.object_locations[oid]
+        # break leases on that node
+        for lease_id, lease in list(self.leases.items()):
+            if lease.node_id == node_id:
+                await self._release_lease(lease_id, broken=True)
+        # restart/kill actors that lived there
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (
+                ACTOR_ALIVE,
+                ACTOR_PENDING,
+            ):
+                await self._maybe_restart_actor(actor, f"node died: {reason}")
+        await self.publish("nodes", {"event": "dead", "node_id": node_id.hex()})
+        self._kick_pending()
+
+    async def _on_job_finished(self, job_id: JobID):
+        self.jobs.get(job_id, {}).update(state="FINISHED")
+        # kill non-detached actors owned by the job
+        for actor in list(self.actors.values()):
+            if actor.owner_job == job_id and not actor.detached:
+                await self._kill_actor(actor, "owner job finished", no_restart=True)
+        await self.publish("jobs", {"event": "finished", "job_id": job_id.hex()})
+
+    # ---- pubsub --------------------------------------------------------
+    async def publish(self, channel: str, message: dict):
+        for conn in list(self.subscribers.get(channel, ())):
+            try:
+                await conn.notify("publish", {"channel": channel, "message": message})
+            except Exception:
+                pass
+
+    async def rpc_subscribe(self, conn, p):
+        self.subscribers.setdefault(p["channel"], set()).add(conn)
+        return True
+
+    async def rpc_unsubscribe(self, conn, p):
+        self.subscribers.get(p["channel"], set()).discard(conn)
+        return True
+
+    # ---- nodes ---------------------------------------------------------
+    async def rpc_register_node(self, conn, p):
+        node_id = NodeID(p["node_id"])
+        entry = NodeEntry(
+            node_id=node_id,
+            address=p["address"],
+            resources_total=ResourceSet(p["resources"]),
+            resources_available=ResourceSet(p["resources"]),
+            labels=p.get("labels", {}),
+            conn=conn,
+        )
+        self.nodes[node_id] = entry
+        self._conn_node[conn] = node_id
+        await self.publish(
+            "nodes",
+            {"event": "alive", "node_id": node_id.hex(), "address": p["address"]},
+        )
+        logger.info(
+            "node %s registered: %s %s",
+            node_id, p["address"], entry.resources_total,
+        )
+        self._kick_pending()
+        return {"gcs_time": time.time()}
+
+    async def rpc_heartbeat(self, conn, p):
+        node = self.nodes.get(NodeID(p["node_id"]))
+        if node:
+            node.last_heartbeat = time.monotonic()
+        return True
+
+    async def rpc_get_nodes(self, conn, p):
+        return [
+            {
+                "node_id": n.node_id.hex(),
+                "address": n.address,
+                "alive": n.alive,
+                "resources_total": n.resources_total.to_dict(),
+                "resources_available": n.resources_available.to_dict(),
+                "labels": n.labels,
+            }
+            for n in self.nodes.values()
+        ]
+
+    async def rpc_cluster_resources(self, conn, p):
+        total: ResourceSet = ResourceSet()
+        avail: ResourceSet = ResourceSet()
+        for n in self.nodes.values():
+            if n.alive:
+                total = total.add(n.resources_total)
+                avail = avail.add(n.resources_available)
+        return {"total": total.to_dict(), "available": avail.to_dict()}
+
+    # ---- jobs ----------------------------------------------------------
+    async def rpc_register_job(self, conn, p):
+        job_id = JobID.random()
+        self.jobs[job_id] = {
+            "state": "RUNNING",
+            "start_time": time.time(),
+            "driver_pid": p.get("pid"),
+        }
+        self._conn_job[conn] = job_id
+        return {"job_id": job_id.binary()}
+
+    # ---- workers (register their duplex conns for GCS-initiated pushes)
+    async def rpc_register_worker(self, conn, p):
+        self._worker_conns[WorkerID(p["worker_id"])] = conn
+        return True
+
+    # ---- kv ------------------------------------------------------------
+    async def rpc_kv_put(self, conn, p):
+        key = p["key"]
+        if p.get("overwrite", True) or key not in self.kv:
+            self.kv[key] = p["value"]
+            return True
+        return False
+
+    async def rpc_kv_get(self, conn, p):
+        return self.kv.get(p["key"])
+
+    async def rpc_kv_del(self, conn, p):
+        return self.kv.pop(p["key"], None) is not None
+
+    async def rpc_kv_exists(self, conn, p):
+        return p["key"] in self.kv
+
+    async def rpc_kv_keys(self, conn, p):
+        prefix = p.get("prefix", "")
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # ---- object directory ---------------------------------------------
+    async def rpc_add_object_location(self, conn, p):
+        oid = p["object_id"]
+        self.object_locations.setdefault(oid, set()).add(NodeID(p["node_id"]))
+        if "size" in p:
+            self.object_sizes[oid] = p["size"]
+        for fut in self._location_waiters.pop(oid, ()):
+            if not fut.done():
+                fut.set_result(True)
+        return True
+
+    async def rpc_remove_object_location(self, conn, p):
+        oid = p["object_id"]
+        locs = self.object_locations.get(oid)
+        if locs:
+            locs.discard(NodeID(p["node_id"]))
+            if not locs:
+                del self.object_locations[oid]
+        return True
+
+    async def rpc_get_object_locations(self, conn, p):
+        oid = p["object_id"]
+        timeout = p.get("timeout", 0)
+        locs = self.object_locations.get(oid)
+        if not locs and timeout:
+            fut = asyncio.get_running_loop().create_future()
+            self._location_waiters.setdefault(oid, []).append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=timeout)
+            except asyncio.TimeoutError:
+                pass
+            locs = self.object_locations.get(oid)
+        out = []
+        for nid in locs or ():
+            node = self.nodes.get(nid)
+            if node and node.alive:
+                out.append({"node_id": nid.hex(), "address": node.address})
+        return {"locations": out, "size": self.object_sizes.get(oid)}
+
+    async def rpc_free_objects(self, conn, p):
+        for oid in p["object_ids"]:
+            locs = self.object_locations.pop(oid, set())
+            self.object_sizes.pop(oid, None)
+            for nid in locs:
+                node = self.nodes.get(nid)
+                if node and node.alive:
+                    try:
+                        await node.conn.notify("delete_objects", {"object_ids": [oid]})
+                    except Exception:
+                        pass
+        return True
+
+    # ---- leases (the scheduling hot path) ------------------------------
+    async def rpc_request_lease(self, conn, p):
+        """Grant a worker lease: pick node, get a worker from its raylet."""
+        demand = ResourceSet(p["resources"])
+        strategy = p.get("strategy", {})
+        actor_id = ActorID(p["actor_id"]) if p.get("actor_id") else None
+        if not self.scheduler.feasible_nodes(demand):
+            raise rpc.RpcError(
+                f"infeasible resource request {demand.to_dict()}: no node in the "
+                f"cluster can ever satisfy it (cluster: "
+                f"{[n.resources_total.to_dict() for n in self.nodes.values()]})"
+            )
+        deadline = time.monotonic() + cfg.sched_max_pending_lease_s
+        while True:
+            node = self.scheduler.pick_node(demand, strategy)
+            if node is None:
+                fut = asyncio.get_running_loop().create_future()
+                entry = PendingLease(fut, demand, strategy, conn, actor_id)
+                self.scheduler.pending.append(entry)
+                try:
+                    # bounded wait: the client re-requests on LEASE_PENDING so
+                    # a vanished client can never leak a queued grant
+                    await asyncio.wait_for(
+                        fut, timeout=deadline - time.monotonic()
+                    )
+                except asyncio.TimeoutError:
+                    if entry in self.scheduler.pending:
+                        self.scheduler.pending.remove(entry)
+                    raise rpc.RpcError(
+                        "LEASE_PENDING: waiting for cluster capacity for "
+                        f"{demand.to_dict()}"
+                    )
+                # woken up: re-pick — capacity may have been taken by another
+                # grant racing this continuation
+                continue
+            if not node.resources_available.covers(demand):
+                continue  # stale pick; loop re-evaluates
+            return await self._grant_lease(node, demand, conn, p)
+
+    async def _grant_lease(self, node: NodeEntry, demand: ResourceSet, conn, p):
+        if getattr(conn, "closed", False):
+            self._kick_pending()
+            raise rpc.RpcError("client disconnected before lease grant")
+        lease_id = next(self._lease_ids)
+        node.resources_available = node.resources_available.subtract(demand)
+        try:
+            reply = await node.conn.call(
+                "lease_worker",
+                {
+                    "lease_id": lease_id,
+                    "resources": demand.to_dict(),
+                    "runtime_env": p.get("runtime_env"),
+                },
+                timeout=cfg.worker_start_timeout_s,
+            )
+        except Exception:
+            node.resources_available = node.resources_available.add(demand)
+            self._kick_pending()
+            raise
+        lease = LeaseEntry(
+            lease_id=lease_id,
+            node_id=node.node_id,
+            worker_id=WorkerID(reply["worker_id"]),
+            worker_addr=reply["worker_addr"],
+            resources=demand,
+            client_conn=conn,
+            actor_id=ActorID(p["actor_id"]) if p.get("actor_id") else None,
+        )
+        self.leases[lease_id] = lease
+        self._conn_leases.setdefault(conn, set()).add(lease_id)
+        return {
+            "lease_id": lease_id,
+            "node_id": node.node_id.hex(),
+            "worker_id": reply["worker_id"],
+            "worker_addr": reply["worker_addr"],
+            "accelerator_env": reply.get("accelerator_env", {}),
+        }
+
+    async def rpc_return_lease(self, conn, p):
+        await self._release_lease(p["lease_id"], broken=p.get("broken", False))
+        return True
+
+    async def _release_lease(self, lease_id: int, broken: bool = False):
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        self._conn_leases.get(lease.client_conn, set()).discard(lease_id)
+        node = self.nodes.get(lease.node_id)
+        if node and node.alive:
+            node.resources_available = node.resources_available.add(lease.resources)
+            try:
+                await node.conn.notify(
+                    "release_worker",
+                    {
+                        "lease_id": lease_id,
+                        "worker_id": lease.worker_id.binary(),
+                        "broken": broken,
+                    },
+                )
+            except Exception:
+                pass
+        self._kick_pending()
+
+    def _kick_pending(self):
+        """Re-try queued lease requests after resources freed / node joined."""
+        still: List[PendingLease] = []
+        for req in self.scheduler.pending:
+            if req.fut.done():
+                continue
+            if req.client_conn.closed:
+                req.fut.cancel()
+                continue
+            node = self.scheduler.pick_node(req.demand, req.strategy)
+            if node is not None:
+                req.fut.set_result(True)  # waker only; requester re-picks
+            else:
+                still.append(req)
+        self.scheduler.pending = still
+
+    # ---- actors --------------------------------------------------------
+    async def rpc_register_actor(self, conn, p):
+        actor_id = ActorID(p["actor_id"])
+        name = p.get("name")
+        ns = p.get("namespace", "default")
+        if name:
+            key = (ns, name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing and existing.state != ACTOR_DEAD:
+                    if p.get("get_if_exists"):
+                        return {"existing": True, "actor_id": existing.actor_id.binary()}
+                    raise rpc.RpcError(f"actor name {name!r} already taken")
+            self.named_actors[key] = actor_id
+        job_id = JobID(p["job_id"])
+        entry = ActorEntry(
+            actor_id=actor_id,
+            name=name,
+            namespace=ns,
+            state=ACTOR_PENDING,
+            owner_job=job_id,
+            max_restarts=p.get("max_restarts", 0),
+            creation_spec=p.get("creation_spec"),
+            resources=p["resources"],
+            scheduling=p.get("strategy", {}),
+            detached=p.get("detached", False),
+        )
+        self.actors[actor_id] = entry
+        return {"existing": False, "actor_id": actor_id.binary()}
+
+    async def rpc_actor_started(self, conn, p):
+        """Creator reports the actor's worker is up and __init__ succeeded."""
+        actor = self.actors.get(ActorID(p["actor_id"]))
+        if not actor:
+            return False
+        actor.state = ACTOR_ALIVE
+        actor.worker_addr = p["worker_addr"]
+        actor.node_id = NodeID.from_hex(p["node_id"])
+        actor.lease_id = p.get("lease_id")
+        # the actor's lease is now owned by the actor lifetime, not the client
+        lease = self.leases.get(actor.lease_id)
+        if lease:
+            self._conn_leases.get(lease.client_conn, set()).discard(actor.lease_id)
+            lease.actor_id = actor.actor_id
+        await self.publish(
+            f"actor:{actor.actor_id.hex()}",
+            {"state": ACTOR_ALIVE, "worker_addr": actor.worker_addr},
+        )
+        return True
+
+    async def rpc_actor_creation_failed(self, conn, p):
+        actor = self.actors.get(ActorID(p["actor_id"]))
+        if actor:
+            await self._kill_actor(actor, p.get("reason", "creation failed"),
+                                   no_restart=True)
+        return True
+
+    async def rpc_get_actor(self, conn, p):
+        if "name" in p:
+            key = (p.get("namespace", "default"), p["name"])
+            actor_id = self.named_actors.get(key)
+            if actor_id is None:
+                return None
+            actor = self.actors.get(actor_id)
+        else:
+            actor = self.actors.get(ActorID(p["actor_id"]))
+        if actor is None:
+            return None
+        # If restarting, optionally wait for the new address
+        if actor.state in (ACTOR_PENDING, ACTOR_RESTARTING) and p.get("wait", 0):
+            deadline = time.monotonic() + p["wait"]
+            while (
+                actor.state in (ACTOR_PENDING, ACTOR_RESTARTING)
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.05)
+        return {
+            "actor_id": actor.actor_id.binary(),
+            "state": actor.state,
+            "worker_addr": actor.worker_addr,
+            "name": actor.name,
+            "death_cause": actor.death_cause,
+            "resources": actor.resources,
+        }
+
+    async def rpc_kill_actor(self, conn, p):
+        actor = self.actors.get(ActorID(p["actor_id"]))
+        if actor:
+            await self._kill_actor(
+                actor, "killed via ray_tpu.kill", no_restart=p.get("no_restart", True)
+            )
+        return True
+
+    async def _kill_actor(self, actor: ActorEntry, reason: str, no_restart: bool):
+        if actor.state == ACTOR_DEAD:
+            return
+        actor.state = ACTOR_DEAD
+        actor.death_cause = reason
+        if actor.name:
+            self.named_actors.pop((actor.namespace, actor.name), None)
+        if actor.worker_addr:
+            # tell the worker to exit
+            wid_conn = None
+            lease = self.leases.get(actor.lease_id)
+            if lease:
+                wid_conn = self._worker_conns.get(lease.worker_id)
+            if wid_conn:
+                try:
+                    await wid_conn.notify("exit_worker", {"reason": reason})
+                except Exception:
+                    pass
+        if actor.lease_id is not None:
+            await self._release_lease(actor.lease_id, broken=True)
+        await self.publish(
+            f"actor:{actor.actor_id.hex()}",
+            {"state": ACTOR_DEAD, "death_cause": reason},
+        )
+
+    async def _maybe_restart_actor(self, actor: ActorEntry, reason: str):
+        if (
+            actor.max_restarts != 0
+            and (actor.max_restarts < 0 or actor.restarts_used < actor.max_restarts)
+            and actor.creation_spec is not None
+        ):
+            actor.restarts_used += 1
+            actor.state = ACTOR_RESTARTING
+            actor.worker_addr = None
+            await self.publish(
+                f"actor:{actor.actor_id.hex()}", {"state": ACTOR_RESTARTING}
+            )
+            asyncio.get_running_loop().create_task(self._restart_actor(actor, reason))
+        else:
+            await self._kill_actor(actor, reason, no_restart=True)
+
+    async def _restart_actor(self, actor: ActorEntry, reason: str):
+        """GCS-driven actor restart: lease a fresh worker, replay creation."""
+        try:
+            demand = ResourceSet(actor.resources)
+            while True:
+                node = self.scheduler.pick_node(demand, actor.scheduling)
+                if node is not None and node.resources_available.covers(demand):
+                    break
+                fut = asyncio.get_running_loop().create_future()
+                self.scheduler.pending.append(
+                    PendingLease(fut, demand, actor.scheduling,
+                                 actor_id=actor.actor_id,
+                                 client_conn=_GCS_SELF_CONN)
+                )
+                await fut
+            grant = await self._grant_lease(
+                node, demand, _GCS_SELF_CONN,
+                {"actor_id": actor.actor_id.binary()},
+            )
+            worker_conn = None
+            deadline = time.monotonic() + cfg.worker_start_timeout_s
+            wid = WorkerID(grant["worker_id"])
+            while time.monotonic() < deadline:
+                worker_conn = self._worker_conns.get(wid)
+                if worker_conn:
+                    break
+                await asyncio.sleep(0.02)
+            if worker_conn is None:
+                raise rpc.RpcError("restarted worker never registered with GCS")
+            await worker_conn.call(
+                "create_actor",
+                {
+                    "actor_id": actor.actor_id.binary(),
+                    "creation_spec": actor.creation_spec,
+                    "accelerator_env": grant.get("accelerator_env", {}),
+                },
+                timeout=cfg.worker_start_timeout_s,
+            )
+            actor.state = ACTOR_ALIVE
+            actor.worker_addr = grant["worker_addr"]
+            actor.node_id = NodeID.from_hex(grant["node_id"])
+            actor.lease_id = grant["lease_id"]
+            lease = self.leases.get(actor.lease_id)
+            if lease:
+                lease.actor_id = actor.actor_id
+            await self.publish(
+                f"actor:{actor.actor_id.hex()}",
+                {"state": ACTOR_ALIVE, "worker_addr": actor.worker_addr},
+            )
+        except Exception as e:
+            logger.exception("actor restart failed")
+            await self._kill_actor(actor, f"restart failed: {e}", no_restart=True)
+
+    async def rpc_worker_died(self, conn, p):
+        """Raylet reports a worker process exited."""
+        wid = WorkerID(p["worker_id"])
+        self._worker_conns.pop(wid, None)
+        for lease_id, lease in list(self.leases.items()):
+            if lease.worker_id == wid:
+                actor_id = lease.actor_id
+                await self._release_lease(lease_id, broken=True)
+                if actor_id:
+                    actor = self.actors.get(actor_id)
+                    if actor and actor.state in (ACTOR_ALIVE, ACTOR_PENDING):
+                        await self._maybe_restart_actor(
+                            actor, f"worker died: {p.get('reason', 'unknown')}"
+                        )
+        return True
+
+    async def rpc_list_actors(self, conn, p):
+        return [
+            {
+                "actor_id": a.actor_id.hex(),
+                "name": a.name,
+                "state": a.state,
+                "node_id": a.node_id.hex() if a.node_id else None,
+                "resources": a.resources,
+                "restarts_used": a.restarts_used,
+            }
+            for a in self.actors.values()
+        ]
+
+    async def rpc_ping(self, conn, p):
+        return {"time": time.time(), "uptime": time.time() - self._start_time}
+
+
+class _SelfConn:
+    """Placeholder 'connection' for GCS-originated leases (actor restarts)."""
+
+    closed = False
+
+
+_GCS_SELF_CONN: Any = _SelfConn()
+
+
+# --------------------------------------------------------------------------
+# Entrypoint (run as the head's GCS process)
+# --------------------------------------------------------------------------
+
+
+def main():
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[gcs] %(levelname)s %(message)s")
+
+    async def run():
+        gcs = GcsServer(host=args.host, port=args.port)
+        await gcs.start()
+        # report the bound address to the parent on stdout
+        print(f"GCS_ADDRESS={gcs.address}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

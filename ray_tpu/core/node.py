@@ -1,0 +1,163 @@
+"""Node bring-up: spawn GCS and raylet processes for a head or worker node.
+
+Role-equivalent of ray: python/ray/_private/node.py:37 and services.py
+(start_gcs_server:1432, start_raylet:1496).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.common.ids import NodeID
+
+
+def _read_tagged_line(proc: subprocess.Popen, tag: str, timeout: float) -> str:
+    """Read lines from proc stdout until `tag=` appears."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"process exited (code {proc.returncode}) before reporting {tag}"
+            )
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.01)
+            continue
+        line = line.decode() if isinstance(line, bytes) else line
+        if line.startswith(tag + "="):
+            return line.strip().split("=", 1)[1]
+    raise TimeoutError(f"timed out waiting for {tag} from subprocess")
+
+
+def default_session_dir() -> str:
+    return os.path.join(
+        "/tmp", "ray_tpu", f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}"
+    )
+
+
+@dataclass
+class NodeProcessGroup:
+    """Handles to the subprocesses composing one logical node (plus the GCS
+    when this is the head)."""
+
+    session_dir: str
+    gcs_address: str
+    raylet_address: str
+    node_id: str
+    store_path: str
+    gcs_proc: Optional[subprocess.Popen] = None
+    raylet_proc: Optional[subprocess.Popen] = None
+
+    def kill(self):
+        for proc in (self.raylet_proc, self.gcs_proc):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        for proc in (self.raylet_proc, self.gcs_proc):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+def start_gcs(session_dir: str, host: str = "127.0.0.1") -> tuple:
+    os.makedirs(session_dir, exist_ok=True)
+    log = open(os.path.join(session_dir, "gcs.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.gcs", "--host", host],
+        stdout=subprocess.PIPE,
+        stderr=log,
+        env=_control_plane_env(),
+    )
+    log.close()
+    address = _read_tagged_line(proc, "GCS_ADDRESS", 30)
+    return proc, address
+
+
+def start_raylet(
+    gcs_address: str,
+    session_dir: str,
+    resources: Dict[str, float],
+    labels: Optional[Dict[str, str]] = None,
+    host: str = "127.0.0.1",
+    store_capacity: int = 0,
+    node_id: Optional[str] = None,
+) -> tuple:
+    os.makedirs(session_dir, exist_ok=True)
+    log = open(os.path.join(session_dir, "raylet.log"), "ab")
+    cmd = [
+        sys.executable,
+        "-m",
+        "ray_tpu.core.raylet",
+        "--gcs", gcs_address,
+        "--host", host,
+        "--resources", json.dumps(resources),
+        "--labels", json.dumps(labels or {}),
+        "--store-capacity", str(store_capacity),
+        "--session-dir", session_dir,
+    ]
+    if node_id:
+        cmd += ["--node-id", node_id]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=log, env=_control_plane_env()
+    )
+    log.close()
+    address = _read_tagged_line(proc, "RAYLET_ADDRESS", 60)
+    nid = _read_tagged_line(proc, "RAYLET_NODE_ID", 10)
+    store_path = f"/dev/shm/rt_store_{nid[:12]}"
+    return proc, address, nid, store_path
+
+
+def _pythonpath_with_pkg() -> str:
+    """PYTHONPATH that lets subprocesses import ray_tpu even when the driver
+    added the repo to sys.path manually."""
+    import ray_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    parts = [pkg_root] + ([existing] if existing else [])
+    return os.pathsep.join(parts)
+
+
+def _control_plane_env() -> dict:
+    """Control-plane processes must never touch the TPU (one process owns the
+    chips); pin them to CPU-only jax in case anything imports it."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _pythonpath_with_pkg()
+    return env
+
+
+def detect_resources(num_cpus=None, num_tpus=None, extra=None) -> Dict[str, float]:
+    res: Dict[str, float] = dict(extra or {})
+    res["CPU"] = num_cpus if num_cpus is not None else float(os.cpu_count() or 1)
+    if num_tpus is None:
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+        mgr = TPUAcceleratorManager()
+        num_tpus = mgr.num_chips()
+        if num_tpus:
+            res.update(mgr.extra_resources())
+    if num_tpus:
+        res["TPU"] = num_tpus
+    res.setdefault("memory", float(_total_memory_bytes()))
+    return res
+
+
+def _total_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 8 << 30

@@ -1,0 +1,62 @@
+"""ObjectRef: a future handle to a (possibly remote) object.
+
+Role-equivalent of ray: python/ray/_raylet.pyx ObjectRef.  Serializing a ref
+(into task args or any container) goes through a custom reducer registered by
+the runtime, which promotes the value to the shared store so any process can
+resolve it (ray's borrowing protocol, collapsed to promote-on-escape).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.common.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("object_id", "_owner_hint", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hint: Optional[str] = None):
+        self.object_id = object_id
+        self._owner_hint = owner_hint  # node hint for locality-aware pulls
+
+    def hex(self) -> str:
+        return self.object_id.hex()
+
+    def binary(self) -> bytes:
+        return self.object_id.binary()
+
+    def __hash__(self):
+        return hash(self.object_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.object_id == self.object_id
+
+    def __repr__(self):
+        return f"ObjectRef({self.object_id.hex()[:16]})"
+
+    def future(self):
+        """concurrent.futures.Future resolving to the object's value."""
+        from ray_tpu.core.runtime import get_runtime
+
+        return get_runtime().as_future(self)
+
+    def __await__(self):
+        """Allow `await ref` inside async actors."""
+        from ray_tpu.core.runtime import get_runtime
+
+        return get_runtime().await_ref(self).__await__()
+
+    def __reduce__(self):
+        # Plain pickle path (no runtime mediation): carry id + hint.
+        return (ObjectRef, (self.object_id, self._owner_hint))
+
+    def __del__(self):
+        try:
+            from ray_tpu.core import runtime as _rt
+
+            rt = _rt._global_runtime
+            if rt is not None:
+                rt.on_ref_deleted(self.object_id)
+        except Exception:
+            pass  # interpreter teardown

@@ -1,0 +1,466 @@
+"""Raylet: the per-node daemon — worker pool, store owner, object transfer.
+
+Role-equivalent of the reference's raylet (ray: src/ray/raylet/raylet.h:37,
+node_manager.h:125, worker_pool.h:156, object_manager/object_manager.h:117)
+with a deliberately smaller job: scheduling decisions live in the GCS (see
+gcs.py header), so the raylet is (1) a worker process factory with
+accelerator-aware reuse, (2) the owner of the node's shm object store, and
+(3) the node-to-node object transfer endpoint (PullManager/PushManager
+analogue, pull-based).
+
+TPU ownership model: libtpu allows one process per chip set, so TPU leases
+carry an explicit chip assignment (TPU_VISIBLE_CHIPS) decided here.  A worker
+is forever bound to the first accelerator env it receives (jax initializes
+once); idle workers are reused only on exact-match bindings, and idle workers
+whose chips conflict with a new allocation are killed (ray's env-var dance at
+python/ray/_private/accelerators/tpu.py:174-196 is per-task; here it is a
+lease-time contract).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+from ray_tpu._native.store import ShmStore, default_capacity
+from ray_tpu.common.config import cfg
+from ray_tpu.common.ids import NodeID, WorkerID
+from ray_tpu.core import rpc
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerEntry:
+    worker_id: WorkerID
+    proc: subprocess.Popen
+    conn: Optional[rpc.Connection] = None  # worker's connection to us
+    addr: Optional[str] = None  # worker's own rpc server address
+    bound_env: Optional[Dict[str, str]] = None  # accelerator env, once set
+    lease_id: Optional[int] = None
+    tpu_chips: tuple = ()
+    started_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def idle(self) -> bool:
+        return self.lease_id is None and self.conn is not None
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_address: str,
+        node_id: Optional[NodeID] = None,
+        host: str = "127.0.0.1",
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        store_capacity: int = 0,
+        session_dir: str = "/tmp/ray_tpu",
+    ):
+        self.gcs_address = gcs_address
+        self.node_id = node_id or NodeID.random()
+        self.host = host
+        self.labels = labels or {}
+        self.session_dir = session_dir
+        self.resources = resources or {}
+        self.store_path = os.path.join(
+            "/dev/shm", f"rt_store_{self.node_id.hex()[:12]}"
+        )
+        self.store_capacity = store_capacity or default_capacity()
+        self.store: Optional[ShmStore] = None
+        self.server = rpc.Server(self._handle, host=host, port=0)
+        self.gcs: Optional[rpc.Connection] = None
+        self.workers: Dict[WorkerID, WorkerEntry] = {}
+        self._idle_by_env: Dict[tuple, List[WorkerEntry]] = {}
+        self._tpu_chips_free: Set[int] = set(
+            range(int(self.resources.get("TPU", 0)))
+        )
+        self._peer_conns: Dict[str, rpc.Connection] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._closing = False
+
+    # ---- lifecycle -----------------------------------------------------
+    async def start(self):
+        os.makedirs(self.session_dir, exist_ok=True)
+        if os.path.exists(self.store_path):
+            os.unlink(self.store_path)
+        self.store = ShmStore(self.store_path, self.store_capacity, create=True)
+        await self.server.start()
+        self.gcs = await rpc.connect(
+            self.gcs_address, self._handle, name="raylet->gcs",
+            on_close=self._on_gcs_lost,
+        )
+        await self.gcs.call(
+            "register_node",
+            {
+                "node_id": self.node_id.binary(),
+                "address": self.server.address,
+                "resources": self.resources,
+                "labels": self.labels,
+            },
+        )
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._heartbeat_loop()))
+        self._tasks.append(loop.create_task(self._reaper_loop()))
+        n_prestart = min(int(self.resources.get("CPU", 0)), cfg.worker_pool_prestart)
+        for _ in range(n_prestart):
+            self._spawn_worker()
+        logger.info(
+            "raylet %s up at %s (store %s, %d bytes)",
+            self.node_id, self.server.address, self.store_path, self.store_capacity,
+        )
+
+    def _on_gcs_lost(self, conn):
+        if not self._closing:
+            logger.error("raylet %s lost GCS connection; shutting down", self.node_id)
+            for w in self.workers.values():
+                w.proc.terminate()
+            os._exit(1)
+
+    async def close(self):
+        self._closing = True
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self.workers.values()):
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        for w in list(self.workers.values()):
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        if self.gcs:
+            await self.gcs.close()
+        await self.server.close()
+        if self.store:
+            self.store.destroy()
+
+    async def _heartbeat_loop(self):
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            try:
+                await self.gcs.notify(
+                    "heartbeat", {"node_id": self.node_id.binary()}
+                )
+            except Exception:
+                pass
+            # collect dead worker processes
+            for w in list(self.workers.values()):
+                if w.proc.poll() is not None:
+                    await self._on_worker_exit(w)
+
+    async def _reaper_loop(self):
+        while True:
+            await asyncio.sleep(5.0)
+            try:
+                self.store.reap()
+            except Exception:
+                pass
+
+    # ---- dispatch ------------------------------------------------------
+    async def _handle(self, conn: rpc.Connection, method: str, p: Any):
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            raise rpc.RpcError(f"raylet: unknown method {method!r}")
+        return await fn(conn, p)
+
+    # ---- worker pool ---------------------------------------------------
+    def _spawn_worker(self) -> WorkerEntry:
+        worker_id = WorkerID.random()
+        env = dict(os.environ)
+        env["RT_WORKER_ID"] = worker_id.hex()
+        env["RT_RAYLET_ADDR"] = self.server.address
+        env["RT_GCS_ADDR"] = self.gcs_address
+        env["RT_NODE_ID"] = self.node_id.hex()
+        env["RT_STORE_PATH"] = self.store_path
+        env["RT_SESSION_DIR"] = self.session_dir
+        log_path = os.path.join(self.session_dir, f"worker-{worker_id.hex()[:12]}.log")
+        logf = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+        )
+        logf.close()
+        entry = WorkerEntry(worker_id=worker_id, proc=proc)
+        self.workers[worker_id] = entry
+        return entry
+
+    async def rpc_worker_ready(self, conn: rpc.Connection, p):
+        """A spawned worker reports in with its own server address."""
+        wid = WorkerID(p["worker_id"])
+        w = self.workers.get(wid)
+        if w is None:
+            raise rpc.RpcError("unknown worker")
+        w.conn = conn
+        w.addr = p["address"]
+        conn.peer_info["worker_id"] = wid
+        key = _env_key(w.bound_env)
+        self._idle_by_env.setdefault(key, []).append(w)
+        return True
+
+    async def _wait_for_worker(self, w: WorkerEntry):
+        deadline = time.monotonic() + cfg.worker_start_timeout_s
+        while w.conn is None:
+            if time.monotonic() > deadline:
+                raise rpc.RpcError("worker failed to start in time")
+            if w.proc.poll() is not None:
+                raise rpc.RpcError(
+                    f"worker process exited at startup (code {w.proc.returncode}); "
+                    f"see {self.session_dir}/worker-{w.worker_id.hex()[:12]}.log"
+                )
+            await asyncio.sleep(0.01)
+
+    def _accel_env_for(self, resources: Dict[str, float]) -> Dict[str, str]:
+        """Accelerator visibility env for a lease (TPU chips or CPU-only)."""
+        n_tpu = int(resources.get("TPU", 0))
+        if n_tpu <= 0 and resources.get("TPU", 0) > 0:
+            n_tpu = 1  # fractional chip -> whole chip visibility
+        if n_tpu > 0:
+            if len(self._tpu_chips_free) < n_tpu:
+                raise rpc.RpcError(
+                    f"TPU chips exhausted: want {n_tpu}, free {len(self._tpu_chips_free)}"
+                )
+            chips = sorted(self._tpu_chips_free)[:n_tpu]
+            for c in chips:
+                self._tpu_chips_free.discard(c)
+            return {
+                "TPU_VISIBLE_CHIPS": ",".join(map(str, chips)),
+                "_RT_TPU_CHIPS": ",".join(map(str, chips)),
+            }
+        return {"JAX_PLATFORMS": "cpu"}
+
+    def _release_accel_env(self, env: Dict[str, str]):
+        chips = env.get("_RT_TPU_CHIPS")
+        if chips:
+            for c in chips.split(","):
+                self._tpu_chips_free.add(int(c))
+
+    async def rpc_lease_worker(self, conn: rpc.Connection, p):
+        """GCS asks for a worker bound to `resources`. Returns its address."""
+        resources = p["resources"]
+        accel_env = self._accel_env_for(resources)
+        key = _env_key(accel_env)
+        # exact-match idle worker?
+        w: Optional[WorkerEntry] = None
+        pool = self._idle_by_env.get(key, [])
+        while pool:
+            cand = pool.pop()
+            if cand.proc.poll() is None and cand.conn and not cand.conn.closed:
+                w = cand
+                break
+        if w is None:
+            # fresh workers (no binding yet) can take any env
+            pool = self._idle_by_env.get(_env_key(None), [])
+            while pool:
+                cand = pool.pop()
+                if cand.proc.poll() is None and cand.conn and not cand.conn.closed:
+                    w = cand
+                    break
+        if w is None:
+            w = self._spawn_worker()
+            await self._wait_for_worker(w)
+            # worker_ready put the fresh worker in the idle pool; it is being
+            # handed out right now, so pull it back out
+            for pool in self._idle_by_env.values():
+                if w in pool:
+                    pool.remove(w)
+        if w.bound_env is None:
+            await w.conn.call("bind_env", {"env": accel_env})
+            w.bound_env = accel_env
+            w.tpu_chips = tuple(
+                int(c)
+                for c in accel_env.get("_RT_TPU_CHIPS", "").split(",")
+                if c
+            )
+        else:
+            # reused exact-match worker: give back the duplicate allocation
+            self._release_accel_env(accel_env)
+        w.lease_id = p["lease_id"]
+        return {
+            "worker_id": w.worker_id.binary(),
+            "worker_addr": w.addr,
+            "accelerator_env": {
+                k: v for k, v in (w.bound_env or {}).items() if not k.startswith("_")
+            },
+        }
+
+    async def rpc_release_worker(self, conn: rpc.Connection, p):
+        wid = WorkerID(p["worker_id"])
+        w = self.workers.get(wid)
+        if w is None:
+            return True
+        w.lease_id = None
+        if p.get("broken") or w.proc.poll() is not None or (
+            w.conn is None or w.conn.closed
+        ):
+            await self._on_worker_exit(w, kill=True)
+            return True
+        self._idle_by_env.setdefault(_env_key(w.bound_env), []).append(w)
+        return True
+
+    async def _on_worker_exit(self, w: WorkerEntry, kill: bool = False):
+        self.workers.pop(w.worker_id, None)
+        for pool in self._idle_by_env.values():
+            if w in pool:
+                pool.remove(w)
+        if w.bound_env:
+            self._release_accel_env(w.bound_env)
+        if kill and w.proc.poll() is None:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        reason = f"exit code {w.proc.poll()}"
+        try:
+            await self.gcs.notify(
+                "worker_died",
+                {"worker_id": w.worker_id.binary(), "reason": reason},
+            )
+        except Exception:
+            pass
+
+    # ---- object plane --------------------------------------------------
+    async def rpc_pull_object(self, conn: rpc.Connection, p):
+        """Local runtime asks us to fetch an object into the node store.
+
+        (ray: object_manager pull_manager.h:52 analogue, pull-based only.)
+        """
+        oid: bytes = p["object_id"]
+        if self.store.contains(oid):
+            return True
+        reply = await self.gcs.call(
+            "get_object_locations",
+            {"object_id": oid, "timeout": p.get("timeout", 30.0)},
+        )
+        locations = reply["locations"]
+        if not locations:
+            return False
+        last_err = None
+        for loc in locations:
+            if loc["node_id"] == self.node_id.hex():
+                # registered on this very node — the owner wrote it into our
+                # shared arena after the caller's first check
+                if self.store.contains(oid):
+                    return True
+                continue  # stale directory entry
+            try:
+                peer = await self._peer(loc["address"])
+                data = await peer.call(
+                    "fetch_object", {"object_id": oid},
+                    timeout=cfg.rpc_call_timeout_s,
+                )
+                if data is None:
+                    continue
+                try:
+                    self.store.put(oid, data)
+                except Exception as e:
+                    from ray_tpu._native.store import ObjectExistsError
+
+                    if not isinstance(e, ObjectExistsError):
+                        raise
+                await self.gcs.notify(
+                    "add_object_location",
+                    {
+                        "object_id": oid,
+                        "node_id": self.node_id.binary(),
+                        "size": len(data),
+                    },
+                )
+                return True
+            except Exception as e:
+                last_err = e
+                continue
+        if last_err:
+            logger.warning("pull of %s failed: %r", oid.hex()[:12], last_err)
+        return False
+
+    async def rpc_fetch_object(self, conn: rpc.Connection, p):
+        """A remote raylet asks for an object's bytes."""
+        pin = self.store.get(p["object_id"])
+        if pin is None:
+            return None
+        try:
+            return bytes(pin.view)
+        finally:
+            pin.release()
+
+    async def rpc_delete_objects(self, conn: rpc.Connection, p):
+        for oid in p["object_ids"]:
+            self.store.delete(oid)
+        return True
+
+    async def rpc_store_stats(self, conn: rpc.Connection, p):
+        return self.store.stats()
+
+    async def _peer(self, address: str) -> rpc.Connection:
+        c = self._peer_conns.get(address)
+        if c is None or c.closed:
+            c = await rpc.connect(address, name=f"raylet->{address}")
+            self._peer_conns[address] = c
+        return c
+
+
+def _env_key(env: Optional[Dict[str, str]]) -> tuple:
+    if env is None:
+        return ()
+    return tuple(sorted(env.items()))
+
+
+# --------------------------------------------------------------------------
+# Entrypoint
+# --------------------------------------------------------------------------
+
+
+def main():
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gcs", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--node-id", default="")
+    ap.add_argument("--resources", default="{}")
+    ap.add_argument("--labels", default="{}")
+    ap.add_argument("--store-capacity", type=int, default=0)
+    ap.add_argument("--session-dir", default="/tmp/ray_tpu")
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[raylet] %(levelname)s %(message)s")
+
+    async def run():
+        raylet = Raylet(
+            gcs_address=args.gcs,
+            node_id=NodeID.from_hex(args.node_id) if args.node_id else None,
+            host=args.host,
+            resources=json.loads(args.resources),
+            labels=json.loads(args.labels),
+            store_capacity=args.store_capacity,
+            session_dir=args.session_dir,
+        )
+        await raylet.start()
+        print(f"RAYLET_ADDRESS={raylet.server.address}", flush=True)
+        print(f"RAYLET_NODE_ID={raylet.node_id.hex()}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""@remote functions.
+
+Role-equivalent of ray: python/ray/remote_function.py:40 (RemoteFunction,
+_remote:266).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu.common.config import cfg
+from ray_tpu.common.resources import validate_task_resources
+
+
+def _build_resources(
+    num_cpus=None, num_tpus=None, num_gpus=None, memory=None, resources=None
+) -> Dict[str, float]:
+    out: Dict[str, float] = dict(resources or {})
+    out["CPU"] = num_cpus if num_cpus is not None else out.get("CPU", 1)
+    if num_tpus:
+        out["TPU"] = num_tpus
+    if num_gpus:
+        out["GPU"] = num_gpus
+    if memory:
+        out["memory"] = memory
+    if out.get("CPU") == 0:
+        out.pop("CPU")
+    validate_task_resources(out)
+    return out
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_opts):
+        self._fn = fn
+        self._opts = default_opts
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._opts)
+        merged.update(opts)
+        return RemoteFunction(self._fn, **merged)
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.runtime import get_runtime
+
+        o = self._opts
+        resources = _build_resources(
+            o.get("num_cpus"), o.get("num_tpus"), o.get("num_gpus"),
+            o.get("memory"), o.get("resources"),
+        )
+        num_returns = o.get("num_returns", 1)
+        strategy = _strategy_dict(o.get("scheduling_strategy"))
+        refs = get_runtime().submit_task(
+            self._fn,
+            args,
+            kwargs,
+            name=o.get("name") or self._fn.__qualname__,
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=o.get(
+                "max_retries", cfg.task_max_retries_default
+            ),
+            strategy=strategy,
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__qualname__} cannot be called directly; "
+            "use .remote()"
+        )
+
+
+def _strategy_dict(strategy) -> dict:
+    if strategy is None:
+        return {}
+    if isinstance(strategy, dict):
+        return strategy
+    # scheduling_strategies objects expose to_dict()
+    return strategy.to_dict()

@@ -1,0 +1,315 @@
+"""Asyncio message transport: symmetric request/response/notify over TCP.
+
+Role-equivalent of the reference's RPC layer (ray: src/ray/rpc/grpc_server.h,
+client_call.h) redesigned for a Python-asyncio control plane: one duplex
+connection per peer pair carries requests in both directions (so GCS can push
+pubsub messages down the same pipe a client calls up on), frames are
+length-prefixed pickles, and large binary payloads ride pickle5 out-of-band
+buffers to avoid copies.
+
+Wire frame:  [u32 nbufs][u32 len_0]...[u32 len_{n-1}][buf_0]...[buf_{n-1}]
+where buf_0 is the message pickle and buf_1.. are out-of-band buffers.
+Message: (kind, msg_id, method, payload)  kind: 0=req, 1=resp-ok, 2=resp-err,
+3=notify.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from ray_tpu.common.config import cfg
+
+logger = logging.getLogger(__name__)
+
+_U32 = struct.Struct("<I")
+
+REQUEST = 0
+RESPONSE_OK = 1
+RESPONSE_ERR = 2
+NOTIFY = 3
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class RemoteCallError(RpcError):
+    """The peer's handler raised; carries the remote exception."""
+
+    def __init__(self, exc):
+        super().__init__(f"remote handler raised: {exc!r}")
+        self.remote_exception = exc
+
+
+def _dump(msg) -> list:
+    bufs: list = [None]
+    meta = pickle.dumps(
+        msg, protocol=5, buffer_callback=lambda pb: bufs.append(pb.raw())
+    )
+    bufs[0] = meta
+    return bufs
+
+
+def _load(bufs: list):
+    return pickle.loads(bufs[0], buffers=bufs[1:])
+
+
+class Connection:
+    """One duplex peer connection. Both sides can call() and notify()."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Callable[["Connection", str, Any], Awaitable[Any]],
+        name: str = "",
+        on_close: Optional[Callable[["Connection"], None]] = None,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.name = name
+        self.on_close = on_close
+        self._msg_ids = itertools.count()
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self._recv_task: Optional[asyncio.Task] = None
+        # peers can stash identity here after a hello exchange
+        self.peer_info: dict = {}
+
+    def start(self) -> None:
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+
+    # -- sending ---------------------------------------------------------
+    async def _send(self, msg) -> None:
+        bufs = _dump(msg)
+        header = bytearray(_U32.pack(len(bufs)))
+        for b in bufs:
+            header += _U32.pack(len(b) if isinstance(b, bytes) else b.nbytes)
+        async with self._send_lock:
+            if self._closed:
+                raise ConnectionLost(f"connection {self.name} is closed")
+            self.writer.write(bytes(header))
+            for b in bufs:
+                self.writer.write(b)
+            await self.writer.drain()
+
+    async def call(self, method: str, payload: Any = None, timeout: float = None):
+        """timeout=None → config default; timeout<0 → wait forever."""
+        if timeout is None:
+            timeout = cfg.rpc_call_timeout_s
+        elif timeout < 0:
+            timeout = None
+        msg_id = next(self._msg_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            await self._send((REQUEST, msg_id, method, payload))
+            return await asyncio.wait_for(fut, timeout=timeout)
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def notify(self, method: str, payload: Any = None) -> None:
+        await self._send((NOTIFY, 0, method, payload))
+
+    # -- receiving -------------------------------------------------------
+    async def _read_frame(self):
+        hdr = await self.reader.readexactly(_U32.size)
+        (nbufs,) = _U32.unpack(hdr)
+        if nbufs == 0 or nbufs > 1024:
+            raise RpcError(f"bad frame: nbufs={nbufs}")
+        lens_raw = await self.reader.readexactly(_U32.size * nbufs)
+        lens = [_U32.unpack_from(lens_raw, i * _U32.size)[0] for i in range(nbufs)]
+        total = sum(lens)
+        if total > cfg.rpc_max_frame_bytes:
+            raise RpcError(f"frame too large: {total}")
+        bufs = []
+        for ln in lens:
+            bufs.append(await self.reader.readexactly(ln))
+        return bufs
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                bufs = await self._read_frame()
+                kind, msg_id, method, payload = _load(bufs)
+                if kind == REQUEST:
+                    asyncio.get_running_loop().create_task(
+                        self._handle_request(msg_id, method, payload)
+                    )
+                elif kind == NOTIFY:
+                    asyncio.get_running_loop().create_task(
+                        self._handle_notify(method, payload)
+                    )
+                else:
+                    fut = self._pending.get(msg_id)
+                    if fut is not None and not fut.done():
+                        if kind == RESPONSE_OK:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(RemoteCallError(payload))
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+            OSError,
+        ):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("rpc recv loop error on %s", self.name)
+        finally:
+            await self._shutdown()
+
+    async def _handle_request(self, msg_id, method, payload):
+        try:
+            result = await self.handler(self, method, payload)
+            await self._send((RESPONSE_OK, msg_id, method, result))
+        except ConnectionLost:
+            pass
+        except Exception as e:
+            logger.debug("handler %s raised: %r", method, e)
+            try:
+                await self._send((RESPONSE_ERR, msg_id, method, _safe_exc(e)))
+            except ConnectionLost:
+                pass
+
+    async def _handle_notify(self, method, payload):
+        try:
+            await self.handler(self, method, payload)
+        except Exception:
+            logger.exception("notify handler %s raised", method)
+
+    async def _shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            try:
+                self.on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    async def close(self):
+        if self._recv_task:
+            self._recv_task.cancel()
+        await self._shutdown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+def _safe_exc(e: Exception):
+    """Make an exception picklable; fall back to a generic RpcError."""
+    try:
+        pickle.dumps(e)
+        return e
+    except Exception:
+        return RpcError(f"{type(e).__name__}: {e}")
+
+
+class Server:
+    """Accepts connections; each gets the shared handler."""
+
+    def __init__(
+        self,
+        handler: Callable[[Connection, str, Any], Awaitable[Any]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_connect: Optional[Callable[[Connection], None]] = None,
+        on_close: Optional[Callable[[Connection], None]] = None,
+    ):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self.on_connect = on_connect
+        self.on_close = on_close
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set[Connection] = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._accept, host=self.host, port=self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def _accept(self, reader, writer):
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        conn = Connection(
+            reader, writer, self.handler,
+            name=f"server@{self.port}", on_close=self._conn_closed,
+        )
+        self.connections.add(conn)
+        if self.on_connect:
+            self.on_connect(conn)
+        conn.start()
+
+    def _conn_closed(self, conn):
+        self.connections.discard(conn)
+        if self.on_close:
+            self.on_close(conn)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def close(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(
+    address: str,
+    handler: Callable[[Connection, str, Any], Awaitable[Any]] = None,
+    name: str = "",
+    on_close: Optional[Callable[[Connection], None]] = None,
+    timeout: float = None,
+) -> Connection:
+    if timeout is None:
+        timeout = cfg.rpc_connect_timeout_s
+    host, port_s = address.rsplit(":", 1)
+
+    async def _null_handler(conn, method, payload):
+        raise RpcError(f"unexpected inbound {method!r} on client-only connection")
+
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, int(port_s)), timeout=timeout
+    )
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        import socket as _socket
+
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    conn = Connection(
+        reader, writer, handler or _null_handler, name=name or address,
+        on_close=on_close,
+    )
+    conn.start()
+    return conn

@@ -1,0 +1,270 @@
+"""Worker process: executes tasks and hosts actors.
+
+Role-equivalent of the reference's worker-side CoreWorker task execution
+(ray: core_worker.cc ExecuteTask:2852, HandlePushTask:3424, the scheduling
+queues in core_worker/transport/, and _raylet.pyx execute_task:1721).
+
+Execution model: the runtime's asyncio loop owns all I/O; user code runs on
+a single executor thread (sync tasks and sync actor methods — which also
+gives per-worker FIFO) or directly on the loop (async actor methods, with a
+max_concurrency semaphore).  Actor calls from one caller execute in
+submission order via per-caller sequence gating, like the reference's
+ActorSchedulingQueue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ray_tpu.common.config import cfg
+from ray_tpu.common.ids import ActorID, NodeID, WorkerID
+from ray_tpu.core import rpc
+from ray_tpu.core.errors import TaskCancelledError, TaskError
+from ray_tpu.core.runtime import Runtime, set_runtime
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerServer:
+    def __init__(self, runtime: Runtime):
+        self.rt = runtime
+        self.server = rpc.Server(self._handle, host="127.0.0.1", port=0)
+        self._exec = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rt-exec")
+        self._exec_thread_id: Optional[int] = None
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self._actor_is_async = False
+        self._actor_sem: Optional[asyncio.Semaphore] = None
+        self._running_task_threads: Dict[bytes, int] = {}  # task_id -> thread id
+        self._cancelled: set = set()
+
+    async def start(self):
+        await self.server.start()
+        # capture the executor thread id for cancellation
+        fut = self._exec.submit(threading.get_ident)
+        self._exec_thread_id = fut.result()
+
+    async def _handle(self, conn: rpc.Connection, method: str, p: Any):
+        if method == "push_task":
+            return await self.handle_push_task(p)
+        if method == "push_actor_task":
+            return await self.handle_push_actor_task(p)
+        if method == "create_actor":
+            return await self.handle_create_actor(p)
+        if method == "bind_env":
+            os.environ.update(p["env"])
+            return True
+        if method == "cancel_task":
+            return self._cancel(p["task_id"])
+        if method == "exit_worker":
+            logger.info("exit requested: %s", p.get("reason"))
+            threading.Thread(target=_exit_soon, daemon=True).start()
+            return True
+        if method == "ping":
+            return {"pid": os.getpid(), "actor": bool(self.actor_instance)}
+        raise rpc.RpcError(f"worker: unknown method {method!r}")
+
+    # ---- normal tasks --------------------------------------------------
+    async def handle_push_task(self, spec) -> dict:
+        try:
+            fn = await self.rt.resolve_fn(spec["fn_hash"])
+            args, kwargs = await self.rt.unpack_args(spec["args"])
+        except Exception as e:
+            return self._error_reply(e, spec)
+        if inspect.iscoroutinefunction(fn):
+            try:
+                result = await fn(*args, **kwargs)
+                return self._exec_pack(spec, result)
+            except Exception as e:
+                return self._error_reply(e, spec)
+        return await asyncio.get_running_loop().run_in_executor(
+            self._exec, self._execute_sync, fn, args, kwargs, spec
+        )
+
+    def _execute_sync(self, fn, args, kwargs, spec) -> dict:
+        tid = spec["task_id"]
+        self._running_task_threads[tid] = threading.get_ident()
+        try:
+            result = fn(*args, **kwargs)
+            return self._exec_pack(spec, result)
+        except TaskCancelledError as e:
+            return self._error_reply(e, spec)
+        except BaseException as e:
+            if tid in self._cancelled:
+                return self._error_reply(TaskCancelledError(str(e)), spec)
+            return self._error_reply(e, spec)
+        finally:
+            self._running_task_threads.pop(tid, None)
+            self._cancelled.discard(tid)
+
+    def _exec_pack(self, spec, result) -> dict:
+        n = spec["num_returns"]
+        if n == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != n:
+                raise ValueError(
+                    f"task declared num_returns={n} but returned {len(values)}"
+                )
+        from ray_tpu.common.ids import ObjectID, TaskID
+
+        task_id = TaskID(spec["task_id"])
+        returns = []
+        for i, v in enumerate(values):
+            s = self.rt.serialize(v)
+            if s.total_bytes <= cfg.inline_object_max_bytes:
+                returns.append(("inline", s.to_bytes()))
+            else:
+                oid = ObjectID.for_task_return(task_id, i).binary()
+                self.rt._write_to_store(oid, s)
+                returns.append(("stored", s.total_bytes))
+        return {"status": "ok", "returns": returns}
+
+    def _error_reply(self, e, spec) -> dict:
+        if isinstance(e, TaskError):
+            err = e
+        else:
+            err = TaskError.from_exception(
+                e, task_desc=spec.get("name") or spec.get("method", "task")
+            )
+        return {"status": "error", "error": self.rt.serialize(err).to_bytes()}
+
+    def _cancel(self, task_id: bytes) -> bool:
+        thread_id = self._running_task_threads.get(task_id)
+        self._cancelled.add(task_id)
+        if thread_id is not None:
+            import ctypes
+
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(thread_id), ctypes.py_object(TaskCancelledError)
+            )
+            return True
+        return False
+
+    # ---- actors --------------------------------------------------------
+    async def handle_create_actor(self, p) -> bool:
+        spec = p["creation_spec"]
+        if p.get("accelerator_env"):
+            os.environ.update(p["accelerator_env"])
+        cls = await self.rt.resolve_fn(spec["cls_hash"])
+        args, kwargs = await self.rt.unpack_args(spec["args"])
+        self.actor_id = ActorID(p["actor_id"])
+        self.rt.actor_id = self.actor_id
+        # async actor iff any public method is a coroutine function
+        self._actor_is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(cls, predicate=inspect.isfunction)
+        )
+        self._actor_sem = asyncio.Semaphore(spec.get("max_concurrency") or 1000)
+        loop = asyncio.get_running_loop()
+        self.actor_instance = await loop.run_in_executor(
+            self._exec, lambda: cls(*args, **kwargs)
+        )
+        logger.info("actor %s created (%s)", self.actor_id, cls.__name__)
+        return True
+
+    async def handle_push_actor_task(self, spec) -> dict:
+        """Execution order: calls arrive FIFO on the caller's TCP connection
+        and sync methods enter a single executor thread in arrival order —
+        together that gives per-caller submission ordering (including
+        head-of-line blocking on ref args, resolved inside the executor).
+        Async methods run concurrently under the semaphore instead."""
+        if self.actor_instance is None:
+            return self._error_reply(
+                RuntimeError("actor instance not created on this worker"), spec
+            )
+        try:
+            method = getattr(self.actor_instance, spec["method"])
+        except AttributeError as e:
+            return self._error_reply(e, spec)
+        if inspect.iscoroutinefunction(method):
+            try:
+                args, kwargs = await self.rt.unpack_args(spec["args"])
+            except Exception as e:
+                return self._error_reply(e, spec)
+            async with self._actor_sem:
+                try:
+                    result = await method(*args, **kwargs)
+                    return self._exec_pack(spec, result)
+                except Exception as e:
+                    return self._error_reply(e, spec)
+        return await asyncio.get_running_loop().run_in_executor(
+            self._exec, self._execute_sync_method, method, spec
+        )
+
+    def _execute_sync_method(self, method, spec) -> dict:
+        tid = spec["task_id"]
+        self._running_task_threads[tid] = threading.get_ident()
+        try:
+            args, kwargs = self.rt._run(self.rt.unpack_args(spec["args"]))
+            result = method(*args, **kwargs)
+            return self._exec_pack(spec, result)
+        except BaseException as e:
+            return self._error_reply(e, spec)
+        finally:
+            self._running_task_threads.pop(tid, None)
+            self._cancelled.discard(tid)
+
+
+def _exit_soon():
+    time.sleep(0.1)
+    os._exit(0)
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO, format="[worker %(process)d] %(levelname)s %(message)s"
+    )
+    worker_id = WorkerID.from_hex(os.environ["RT_WORKER_ID"])
+    raylet_addr = os.environ["RT_RAYLET_ADDR"]
+    gcs_addr = os.environ["RT_GCS_ADDR"]
+    node_id = os.environ["RT_NODE_ID"]
+    store_path = os.environ["RT_STORE_PATH"]
+
+    rt = Runtime(
+        gcs_address=gcs_addr,
+        node_id=node_id,
+        raylet_address=raylet_addr,
+        store_path=store_path,
+        mode="worker",
+        worker_id=worker_id,
+    )
+    set_runtime(rt)
+    server = WorkerServer(rt)
+    rt._worker_server = server
+
+    async def boot():
+        await server.start()
+        raylet_conn = await rpc.connect(
+            raylet_addr, server._handle, name="worker->raylet"
+        )
+        await raylet_conn.call(
+            "worker_ready",
+            {"worker_id": worker_id.binary(), "address": server.server.address},
+        )
+        return raylet_conn
+
+    rt.connect()
+    raylet_conn = asyncio.run_coroutine_threadsafe(boot(), rt._loop).result(30)
+
+    # Block the main thread forever; exit when the raylet connection drops
+    # (our parent died) — a worker must never outlive its raylet.
+    try:
+        while not raylet_conn.closed and not rt.raylet.closed:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
