@@ -1,0 +1,3 @@
+"""Model zoo: TPU-first JAX implementations used by train/serve/rllib."""
+
+from ray_tpu.models import gpt2  # noqa: F401

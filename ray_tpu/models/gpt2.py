@@ -1,0 +1,233 @@
+"""GPT-2 decoder-only transformer, TPU-first.
+
+The flagship model for the framework's Train path (SURVEY.md §7 config 3:
+GPT-2-124M with FSDP-style sharding).  Design choices for the MXU/XLA:
+
+- bf16 activations & matmuls, f32 params and softmax/layernorm numerics;
+- layers stacked into one pytree and iterated with `lax.scan` (one
+  compiled block body, O(1) HLO size in depth);
+- every weight and activation carries a logical axis name so the same
+  model runs pure-DP, FSDP, TP, SP or any combination via the rule table
+  in ray_tpu.parallel.sharding;
+- attention is pluggable ("dense" einsum or "ring" over the sp axis).
+
+Functional API (params in, arrays out) — no Module system, so the whole
+step is a single traced function for pjit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import dense_attention as _dense_attention
+from ray_tpu.parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # 50257 padded to a multiple of 128 for the MXU
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16  # activation/matmul dtype
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "dense"  # "dense" | "ring" (sp-sharded)
+    remat: bool = True  # rematerialize each block in the backward pass
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.mlp_ratio * self.embed_dim
+
+    @staticmethod
+    def gpt2_124m(**kw) -> "GPTConfig":
+        return GPTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "GPTConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("embed_dim", 64)
+        return GPTConfig(**kw)
+
+
+def param_logical_axes(config: GPTConfig) -> Params:
+    """Logical axis names for every param (see parallel.sharding rules).
+
+    Block params carry a leading "layers" axis (scan-stacked).
+    """
+    blk = {
+        "ln1_scale": ("layers", "embed"),
+        "ln1_bias": ("layers", "embed"),
+        "qkv_kernel": ("layers", "embed", "heads", "kv"),
+        "qkv_bias": ("layers", "heads", "kv"),
+        "proj_kernel": ("layers", "heads", "kv", "embed"),
+        "proj_bias": ("layers", "embed"),
+        "ln2_scale": ("layers", "embed"),
+        "ln2_bias": ("layers", "embed"),
+        "fc_kernel": ("layers", "embed", "mlp"),
+        "fc_bias": ("layers", "mlp"),
+        "out_kernel": ("layers", "mlp", "embed"),
+        "out_bias": ("layers", "embed"),
+    }
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": blk,
+        "lnf_scale": ("embed",),
+        "lnf_bias": ("embed",),
+    }
+
+
+def init(rng, config: GPTConfig) -> Params:
+    """GPT-2 initialization: N(0, 0.02), residual projections scaled by
+    1/sqrt(2*num_layers)."""
+    c = config
+    dt = c.param_dtype
+    k = jax.random.split(rng, 8)
+    std = 0.02
+    resid_std = std / math.sqrt(2 * c.num_layers)
+    L, E, H, D, M = c.num_layers, c.embed_dim, c.num_heads, c.head_dim, c.mlp_dim
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dt)
+
+    blocks = {
+        "ln1_scale": jnp.ones((L, E), dt),
+        "ln1_bias": jnp.zeros((L, E), dt),
+        "qkv_kernel": norm(k[0], (L, E, 3 * H, D), std),
+        "qkv_bias": jnp.zeros((L, 3 * H, D), dt),
+        "proj_kernel": norm(k[1], (L, H, D, E), resid_std),
+        "proj_bias": jnp.zeros((L, E), dt),
+        "ln2_scale": jnp.ones((L, E), dt),
+        "ln2_bias": jnp.zeros((L, E), dt),
+        "fc_kernel": norm(k[2], (L, E, M), std),
+        "fc_bias": jnp.zeros((L, M), dt),
+        "out_kernel": norm(k[3], (L, M, E), resid_std),
+        "out_bias": jnp.zeros((L, E), dt),
+    }
+    return {
+        "wte": norm(k[4], (c.vocab_size, E), std),
+        "wpe": norm(k[5], (c.max_seq_len, E), 0.01),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((E,), dt),
+        "lnf_bias": jnp.zeros((E,), dt),
+    }
+
+
+def _layernorm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + 1e-5)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def _attention(q, k, v, config: GPTConfig):
+    if config.attention_impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v)
+    return _dense_attention(q, k, v)
+
+
+def _block(x, p, config: GPTConfig):
+    """One transformer block. x: (B, S, E); p: per-layer param slice."""
+    c = config
+    h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = (
+        jnp.einsum("bse,ehd->bshd", h, p["qkv_kernel"].astype(c.dtype))
+        + p["qkv_bias"].astype(c.dtype)
+    )
+    q, k, v = jnp.split(qkv, 3, axis=2)
+    q = constrain(q, ("batch", "seq", "heads", None))
+    k = constrain(k, ("batch", "seq", "heads", None))
+    attn = _attention(q, k, v, c)
+    x = x + jnp.einsum(
+        "bshd,hde->bse", attn, p["proj_kernel"].astype(c.dtype)
+    ) + p["proj_bias"].astype(c.dtype)
+    x = constrain(x, ("batch", "seq", "embed"))
+    h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
+    h = jnp.einsum("bse,em->bsm", h, p["fc_kernel"].astype(c.dtype))
+    h = jax.nn.gelu(h + p["fc_bias"].astype(c.dtype))
+    h = constrain(h, ("batch", "seq", "mlp"))
+    x = x + jnp.einsum(
+        "bsm,me->bse", h, p["out_kernel"].astype(c.dtype)
+    ) + p["out_bias"].astype(c.dtype)
+    return constrain(x, ("batch", "seq", "embed"))
+
+
+def forward(params: Params, tokens, config: GPTConfig):
+    """tokens (B, S) int32 → logits (B, S, vocab) in f32."""
+    c = config
+    B, S = tokens.shape
+    x = params["wte"].astype(c.dtype)[tokens]
+    x = x + params["wpe"].astype(c.dtype)[:S]
+    x = constrain(x, ("batch", "seq", "embed"))
+
+    def body(carry, layer_params):
+        fn = _block
+        if c.remat:
+            fn = jax.checkpoint(_block, static_argnums=(2,))
+        return fn(carry, layer_params, c), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+    logits = jnp.einsum(
+        "bse,ve->bsv",
+        x,
+        params["wte"].astype(c.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def loss_fn(params: Params, batch, config: GPTConfig):
+    """Next-token cross-entropy.  batch: {"tokens": (B, S+1) int32} or
+    {"inputs", "targets"} each (B, S)."""
+    if "tokens" in batch:
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = forward(params, inputs, config)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is None:
+        return -ll.mean()
+    mask = mask.astype(jnp.float32)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def num_params(config: GPTConfig) -> int:
+    shapes = jax.eval_shape(partial(init, config=config), jax.random.key(0))
+    return sum(
+        math.prod(a.shape) for a in jax.tree.leaves(shapes)
+    )
+
+
+def flops_per_token(config: GPTConfig, seq_len: Optional[int] = None) -> float:
+    """Approximate training FLOPs/token (6N + attention term)."""
+    c = config
+    s = seq_len or c.max_seq_len
+    n = num_params(c) - c.vocab_size * c.embed_dim  # non-embedding
+    return 6 * n + 12 * c.num_layers * c.embed_dim * s

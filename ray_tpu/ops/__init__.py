@@ -1,0 +1,6 @@
+"""TPU compute kernels: ring/flash attention, fused ops (Pallas + XLA)."""
+
+from ray_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_manual,
+)

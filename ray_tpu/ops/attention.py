@@ -1,0 +1,30 @@
+"""Baseline attention kernels (XLA einsum path).
+
+The dense causal kernel lives here — not in the model zoo — so both
+models and the ring/flash variants share one implementation.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_attention(q, k, v, *, start_pos: int = 0):
+    """Causal attention, f32 softmax.  q,k,v: (B, S, H, D).
+
+    ``start_pos`` offsets query positions for decode-time use (queries
+    are a suffix of the key sequence).
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(D)
+    q_pos = jnp.arange(Sq)[:, None] + start_pos
+    k_pos = jnp.arange(Sk)[None, :]
+    scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,25 @@
+"""SPMD parallelism over JAX device meshes (dp / fsdp / sp / tp).
+
+See SURVEY.md §2.4: the reference delegates model sharding to external
+libraries; here it is native.  Mesh construction (`mesh`), logical-axis
+sharding rules (`sharding`), and ICI collective wrappers (`collectives`).
+"""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    DATA_AXES,
+    DP_AXIS,
+    FSDP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    MeshConfig,
+    make_mesh,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    constrain,
+    logical_to_spec,
+    replicated,
+    tree_shardings,
+)
+from ray_tpu.parallel import collectives  # noqa: F401

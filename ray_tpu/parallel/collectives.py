@@ -1,0 +1,67 @@
+"""ICI collective primitives.
+
+The TPU replacement for ray.util.collective's NCCL backend
+(ray: python/ray/util/collective/collective_group/nccl_collective_group.py):
+collectives are not runtime calls between processes but XLA ops compiled
+into the program, executing over ICI links of the mesh.  These wrappers
+exist so library code (ring attention, gradient sync, MoE dispatch)
+names the axis it communicates over instead of hard-coding lax calls.
+
+All of these must run inside `shard_map` / pjit-manual contexts where the
+named axes of the mesh are bound.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def allreduce_sum(x, axis: AxisName):
+    return lax.psum(x, axis)
+
+
+def allreduce_mean(x, axis: AxisName):
+    return lax.pmean(x, axis)
+
+
+def allgather(x, axis: str, *, dim: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=dim, tiled=tiled)
+
+
+def reducescatter_sum(x, axis: str, *, dim: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+
+
+def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int):
+    return lax.all_to_all(
+        x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True
+    )
+
+
+def ring_permute(x, axis: str, *, shift: int = 1):
+    """Send ``x`` to the neighbor ``shift`` steps ahead on the axis ring.
+
+    The building block of ring attention and pipeline schedules; XLA
+    lowers it to a ppermute over ICI neighbors.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def broadcast_from(x, axis: str, *, root: int = 0):
+    """Replicate the value held at ``root`` to all shards on ``axis``."""
+    idx = lax.axis_index(axis)
+    zeroed = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(zeroed, axis)
+
+
+def barrier(axis: AxisName):
+    """Cross-shard rendezvous: a 1-element psum nothing depends on."""
+    return lax.psum(jnp.ones((), jnp.int32), axis)

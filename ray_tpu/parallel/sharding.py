@@ -1,0 +1,100 @@
+"""Logical-axis sharding rules.
+
+Model code annotates every parameter and activation with *logical* axis
+names ("embed", "heads", "batch", ...); a rule table maps logical names
+to mesh axes.  Switching between pure-DP, FSDP, TP, and combinations is
+then a rule-table swap — no model changes.  This is the TPU-native
+counterpart of the reference delegating sharding to torch FSDP/DeepSpeed
+inside the user's train loop (ray: python/ray/train/torch/train_loop_utils.py:158,
+SURVEY.md §2.4 item 4): here sharding is a first-class framework concept
+compiled by XLA rather than a wrapper library.
+
+A *spec* is a tuple of logical axis names (or None), one per array dim:
+
+    ("batch", "seq", "embed")       activations
+    ("embed", "mlp")                MLP kernel
+    (None,)                         bias replicated everywhere
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ray_tpu.parallel.mesh import DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS
+
+LogicalSpec = Tuple[Optional[str], ...]
+Rules = Tuple[Tuple[str, Union[str, Tuple[str, ...], None]], ...]
+
+#: Default rule table, tuned for a decoder-only LM:
+#:  - batch splits over both data axes,
+#:  - params shard their largest dim over fsdp and their "parallel" dim
+#:    (heads / mlp / vocab) over tp — the Megatron layout,
+#:  - sequence dims of activations split over sp for context parallelism.
+DEFAULT_RULES: Rules = (
+    ("batch", (DP_AXIS, FSDP_AXIS)),
+    ("seq", SP_AXIS),
+    ("embed", FSDP_AXIS),
+    ("heads", TP_AXIS),
+    ("kv", None),
+    ("mlp", TP_AXIS),
+    ("vocab", TP_AXIS),
+    ("layers", None),
+    ("expert", None),
+)
+
+
+def logical_to_spec(
+    logical: Sequence[Optional[str]], rules: Rules = DEFAULT_RULES
+) -> PartitionSpec:
+    """Map a tuple of logical axis names to a PartitionSpec via ``rules``."""
+    table = dict(rules)
+    used = set()
+    out = []
+    for name in logical:
+        mesh_axes = table.get(name) if name is not None else None
+        if mesh_axes is None:
+            out.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        # A mesh axis may only shard one dim of a given array.
+        free = tuple(a for a in mesh_axes if a not in used)
+        used.update(free)
+        if not free:
+            out.append(None)
+        elif len(free) == 1:
+            out.append(free[0])
+        else:
+            out.append(free)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def tree_shardings(mesh: Mesh, logical_tree, rules: Rules = DEFAULT_RULES):
+    """Map a pytree of logical specs to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, logical_to_spec(spec, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def constrain(x, logical: Sequence[Optional[str]], rules: Rules = DEFAULT_RULES):
+    """with_sharding_constraint by logical names (no-op outside a mesh).
+
+    Only the "no mesh in scope" case is treated as identity; genuine
+    spec errors (rank mismatch etc.) propagate.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or getattr(mesh, "empty", True):
+        return x
+    return jax.lax.with_sharding_constraint(x, logical_to_spec(logical, rules))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -8,13 +8,28 @@ fixtures + cluster_utils.Cluster).
 
 import os
 
-# Must be set before jax is imported anywhere in the test process tree.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Forced (not setdefault): the outer environment may point JAX at a real
+# TPU, but tests need the 8-device virtual CPU mesh.  The env vars cover
+# child processes (workers); jax.config covers THIS process, where
+# sitecustomize may already have imported jax with the TPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:
+    # Backends already initialized (something probed jax.devices() before
+    # conftest ran).  The XLA_FLAGS env var above can no longer take
+    # effect either, so surface a clear failure only if the mesh is
+    # actually too small when tests run.
+    pass
 
 import pytest  # noqa: E402
 
