@@ -1,0 +1,60 @@
+"""Microbenchmark entry point for the driver.
+
+Measures the framework's headline control-plane number — sync 1:1 actor
+calls/s — the same metric as the reference's `ray_perf.py`
+`1_1_actor_calls_sync` (baseline 2,056/s on a 64-vCPU host, BASELINE.md).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+BASELINE_ACTOR_CALLS_SYNC = 2056.0
+
+
+def bench_actor_calls_sync(duration_s: float = 5.0) -> float:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return b"ok"
+
+    a = Echo.remote()
+    for _ in range(50):  # warmup: actor start + code paths hot
+        ray_tpu.get(a.ping.remote(), timeout=60)
+
+    n = 0
+    t0 = time.perf_counter()
+    while True:
+        for _ in range(100):
+            ray_tpu.get(a.ping.remote(), timeout=60)
+        n += 100
+        elapsed = time.perf_counter() - t0
+        if elapsed >= duration_s:
+            break
+    return n / elapsed
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        calls_per_s = bench_actor_calls_sync()
+    finally:
+        ray_tpu.shutdown()
+    print(
+        json.dumps(
+            {
+                "metric": "actor_calls_sync_1_1",
+                "value": round(calls_per_s, 1),
+                "unit": "calls/s",
+                "vs_baseline": round(calls_per_s / BASELINE_ACTOR_CALLS_SYNC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

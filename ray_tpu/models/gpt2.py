@@ -160,6 +160,7 @@ def _block(x, p, config: GPTConfig):
     q, k, v = jnp.split(qkv, 3, axis=2)
     q = constrain(q, ("batch", "seq", "heads", None))
     k = constrain(k, ("batch", "seq", "heads", None))
+    v = constrain(v, ("batch", "seq", "heads", None))
     attn = _attention(q, k, v, c)
     x = x + jnp.einsum(
         "bshd,hde->bse", attn, p["proj_kernel"].astype(c.dtype)

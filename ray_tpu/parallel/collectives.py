@@ -62,6 +62,15 @@ def broadcast_from(x, axis: str, *, root: int = 0):
     return lax.psum(zeroed, axis)
 
 
-def barrier(axis: AxisName):
-    """Cross-shard rendezvous: a 1-element psum nothing depends on."""
-    return lax.psum(jnp.ones((), jnp.int32), axis)
+def barrier(x, axis: AxisName):
+    """Cross-shard rendezvous threaded through ``x``.
+
+    Returns ``x`` with a data dependency on a 1-element psum over
+    ``axis`` — the caller MUST use the returned value, otherwise XLA
+    dead-code-eliminates the collective and no rendezvous happens
+    (which is why this takes and returns a carrier instead of being a
+    bare statement).
+    """
+    tick = lax.psum(jnp.ones((), jnp.int32), axis)
+    # (tick - tick) == 0 always, but keeps the psum live in the graph.
+    return jax.tree.map(lambda a: a + (tick - tick).astype(a.dtype), x)

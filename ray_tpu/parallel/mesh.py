@@ -127,7 +127,18 @@ def make_mesh(
         dev_array = mesh_utils.create_device_mesh(
             config.shape, devices=devices
         )
-    except Exception:
+    except ImportError:
+        dev_array = np.asarray(devices).reshape(config.shape)
+    except Exception as e:
+        # A failed topology-aware layout on real hardware means sp/tp
+        # neighbors may not be ICI-adjacent — degraded, not incorrect,
+        # so warn loudly instead of failing or silently falling back.
+        import warnings
+
+        warnings.warn(
+            f"mesh_utils.create_device_mesh failed ({e!r}); falling back "
+            f"to flat device order — collective bandwidth may suffer"
+        )
         dev_array = np.asarray(devices).reshape(config.shape)
     mesh = Mesh(dev_array, AXIS_ORDER)
     set_current_mesh(mesh)

@@ -40,15 +40,32 @@ def shard_batch(mesh: Mesh, batch, *, shard_seq: bool = False):
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
 
 
-def _match_param_subtrees(state_shape, default_shardings, param_shardings):
+def _match_param_subtrees(
+    state_shape, default_shardings, param_shardings, param_shape
+):
     """Replace any opt-state subtree structurally identical to the param
     tree with the param shardings, so adam mu/nu (etc.) shard like their
-    params; everything else keeps ``default_shardings`` (replicated)."""
+    params; everything else keeps ``default_shardings`` (replicated).
+
+    A subtree must match the param tree's structure AND its leaf shapes:
+    structure alone misfires when params is a single bare array, because
+    every leaf (e.g. adam's scalar step count) has the same leaf treedef.
+    """
     param_struct = jax.tree.structure(param_shardings)
+    param_leaf_shapes = [a.shape for a in jax.tree.leaves(param_shape)]
+
+    def _shapes_match(node):
+        leaves = jax.tree.leaves(node)
+        return len(leaves) == len(param_leaf_shapes) and all(
+            getattr(a, "shape", None) == s
+            for a, s in zip(leaves, param_leaf_shapes)
+        )
 
     def rec(shape_node, shard_node):
         try:
-            if jax.tree.structure(shape_node) == param_struct:
+            if jax.tree.structure(shape_node) == param_struct and _shapes_match(
+                shape_node
+            ):
                 return param_shardings
         except Exception:
             pass
@@ -97,7 +114,8 @@ def state_shardings(
     state_shape = jax.eval_shape(_full_init(init_fn, optimizer), rng)
     opt_shardings = jax.tree.map(lambda _: rep, state_shape.opt_state)
     opt_shardings = _match_param_subtrees(
-        state_shape.opt_state, opt_shardings, param_shardings
+        state_shape.opt_state, opt_shardings, param_shardings,
+        state_shape.params,
     )
     return TrainState(rep, param_shardings, opt_shardings)
 
