@@ -1,0 +1,11 @@
+"""Shared constants used by both the control plane and client APIs."""
+
+# Placement-group bundle strategies (ray: python/ray/util/placement_group.py
+# `strategy` arg; src/ray/protobuf/common.proto PlacementStrategy).
+PG_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+# Placement-group lifecycle states.
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_RESCHEDULING = "RESCHEDULING"
+PG_REMOVED = "REMOVED"

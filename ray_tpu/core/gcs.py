@@ -29,6 +29,13 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.common.config import cfg
+from ray_tpu.common.constants import (
+    PG_CREATED,
+    PG_PENDING,
+    PG_REMOVED,
+    PG_RESCHEDULING,
+    PG_STRATEGIES,
+)
 from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu.common.resources import ResourceSet
 from ray_tpu.core import rpc
@@ -62,12 +69,39 @@ class LeaseEntry:
     resources: ResourceSet
     client_conn: rpc.Connection  # the submitter holding the lease
     actor_id: Optional[ActorID] = None  # set for actor-dedicated leases
+    # (pg_id, bundle_index) when the lease draws from a placement-group
+    # bundle instead of the node's general pool
+    pg_ref: Optional[Tuple[PlacementGroupID, int]] = None
 
 
 ACTOR_PENDING = "PENDING_CREATION"
 ACTOR_ALIVE = "ALIVE"
 ACTOR_RESTARTING = "RESTARTING"
 ACTOR_DEAD = "DEAD"
+
+@dataclass
+class PlacementGroupEntry:
+    """A gang reservation: bundles of resources carved out of nodes.
+
+    Role-equivalent of ray: src/ray/gcs/gcs_server/gcs_placement_group_manager.h:230.
+    Because all scheduling is GCS-centric here, "prepare/commit 2-phase
+    protocol across raylets" (gcs_placement_group_scheduler.cc) collapses
+    to an atomic in-memory reservation: bundle resources move from the
+    node's pool into the PG at creation, and leases inside the PG draw
+    from the bundle instead of the node.
+    """
+
+    pg_id: PlacementGroupID
+    name: Optional[str]
+    strategy: str
+    bundles: List[ResourceSet]
+    state: str
+    owner_job: Optional[JobID]
+    detached: bool
+    bundle_nodes: List[Optional[NodeID]]
+    bundle_available: List[ResourceSet]
+    namespace: str = "default"
+    created_at: float = field(default_factory=time.time)
 
 
 @dataclass
@@ -181,6 +215,11 @@ class GcsServer:
         self.leases: Dict[int, LeaseEntry] = {}
         self._lease_ids = iter(range(1, 1 << 62))
         self.scheduler = Scheduler(self)
+        # placement groups
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupEntry] = {}
+        self.named_pgs: Dict[Tuple[str, str], PlacementGroupID] = {}
+        self._pending_pgs: List[PlacementGroupID] = []
+        self._pg_state_waiters: Dict[PlacementGroupID, List[asyncio.Future]] = {}
         # object directory: object_id bytes -> {node_id}
         self.object_locations: Dict[bytes, Set[NodeID]] = {}
         self.object_sizes: Dict[bytes, int] = {}
@@ -271,6 +310,25 @@ class GcsServer:
                 ACTOR_PENDING,
             ):
                 await self._maybe_restart_actor(actor, f"node died: {reason}")
+        # reschedule placement-group bundles that lived there
+        for pg in list(self.placement_groups.values()):
+            if pg.state not in (PG_CREATED, PG_RESCHEDULING):
+                continue
+            lost = [
+                i for i, nid in enumerate(pg.bundle_nodes) if nid == node_id
+            ]
+            if not lost:
+                continue
+            for i in lost:
+                pg.bundle_nodes[i] = None
+                pg.bundle_available[i] = ResourceSet()
+            pg.state = PG_RESCHEDULING
+            if pg.pg_id not in self._pending_pgs:
+                self._pending_pgs.append(pg.pg_id)
+            await self.publish(
+                "placement_groups",
+                {"event": "rescheduling", "pg_id": pg.pg_id.hex()},
+            )
         await self.publish("nodes", {"event": "dead", "node_id": node_id.hex()})
         self._kick_pending()
 
@@ -280,6 +338,10 @@ class GcsServer:
         for actor in list(self.actors.values()):
             if actor.owner_job == job_id and not actor.detached:
                 await self._kill_actor(actor, "owner job finished", no_restart=True)
+        # remove non-detached placement groups owned by the job
+        for pg in list(self.placement_groups.values()):
+            if pg.owner_job == job_id and not pg.detached and pg.state != PG_REMOVED:
+                await self._remove_pg(pg)
         await self.publish("jobs", {"event": "finished", "job_id": job_id.hex()})
 
     # ---- pubsub --------------------------------------------------------
@@ -439,11 +501,284 @@ class GcsServer:
                         pass
         return True
 
+    # ---- placement groups ----------------------------------------------
+    def _bundle_order(self, pg: PlacementGroupEntry, indices: List[int]) -> List[int]:
+        """Place big bundles first (first-fit-decreasing)."""
+        return sorted(
+            indices,
+            key=lambda i: -sum(pg.bundles[i]._fp.values()),
+        )
+
+    def _place_bundles(self, pg: PlacementGroupEntry) -> Optional[Dict[int, NodeID]]:
+        """Choose a node for every unplaced bundle, or None if impossible now.
+
+        Works against a scratch copy of availability so the decision is
+        atomic: either every missing bundle fits, or nothing is reserved.
+        (The reference does this with a 2-phase prepare/commit across
+        raylets — bundle_scheduling_policy.cc; here one atomic pass.)
+        """
+        alive = {n.node_id: n for n in self.nodes.values() if n.alive}
+        avail = {nid: n.resources_available for nid, n in alive.items()}
+        missing = [i for i in range(len(pg.bundles)) if pg.bundle_nodes[i] is None]
+        used: Set[NodeID] = {nid for nid in pg.bundle_nodes if nid is not None}
+        assignment: Dict[int, NodeID] = {}
+
+        def util(nid: NodeID) -> float:
+            return avail[nid].utilization(alive[nid].resources_total)
+
+        if pg.strategy == "STRICT_PACK":
+            total = ResourceSet()
+            for b in pg.bundles:
+                total = total.add(b)
+            cands = [nid for nid, a in avail.items() if a.covers(total)]
+            if not cands:
+                return None
+            nid = max(cands, key=util)  # binpack: densest feasible node
+            return {i: nid for i in missing}
+
+        for i in self._bundle_order(pg, missing):
+            b = pg.bundles[i]
+            feas = [nid for nid, a in avail.items() if a.covers(b)]
+            fresh = [nid for nid in feas if nid not in used]
+            if pg.strategy == "STRICT_SPREAD":
+                if not fresh:
+                    return None
+                nid = min(fresh, key=util)  # emptiest distinct node
+            elif pg.strategy == "SPREAD":
+                pool = fresh or feas
+                if not pool:
+                    return None
+                nid = min(pool, key=util)
+            else:  # PACK: fewest nodes — prefer nodes this pg already uses
+                pool = [nid for nid in feas if nid in used] or feas
+                if not pool:
+                    return None
+                nid = max(pool, key=util)
+            assignment[i] = nid
+            avail[nid] = avail[nid].subtract(b)
+            used.add(nid)
+        return assignment
+
+    def _try_place_pg(self, pg: PlacementGroupEntry) -> bool:
+        assignment = self._place_bundles(pg)
+        if assignment is None:
+            return False
+        for i, nid in assignment.items():
+            node = self.nodes[nid]
+            node.resources_available = node.resources_available.subtract(
+                pg.bundles[i]
+            )
+            pg.bundle_nodes[i] = nid
+            pg.bundle_available[i] = pg.bundles[i]
+        pg.state = PG_CREATED
+        self._wake_pg_waiters(pg.pg_id)
+        return True
+
+    def _wake_pg_waiters(self, pg_id: PlacementGroupID):
+        for fut in self._pg_state_waiters.pop(pg_id, ()):
+            if not fut.done():
+                fut.set_result(True)
+
+    async def _pg_state_wait(self, pg_id: PlacementGroupID, timeout: float) -> bool:
+        fut = asyncio.get_running_loop().create_future()
+        self._pg_state_waiters.setdefault(pg_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout=timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def rpc_create_placement_group(self, conn, p):
+        pg_id = PlacementGroupID(p["pg_id"])
+        strategy = p.get("strategy", "PACK")
+        if strategy not in PG_STRATEGIES:
+            raise rpc.RpcError(f"unknown placement strategy {strategy!r}")
+        bundles = [ResourceSet(b) for b in p["bundles"]]
+        if not bundles or any(b.is_empty() for b in bundles):
+            raise rpc.RpcError("placement group bundles must be non-empty")
+        name = p.get("name") or None
+        ns = p.get("namespace", "default")
+        if name:
+            key = (ns, name)
+            if key in self.named_pgs:
+                existing = self.placement_groups.get(self.named_pgs[key])
+                if existing and existing.state != PG_REMOVED:
+                    raise rpc.RpcError(f"placement group name {name!r} already taken")
+            self.named_pgs[key] = pg_id
+        pg = PlacementGroupEntry(
+            pg_id=pg_id,
+            name=name,
+            strategy=strategy,
+            bundles=bundles,
+            state=PG_PENDING,
+            owner_job=JobID(p["job_id"]) if p.get("job_id") else None,
+            detached=p.get("detached", False),
+            bundle_nodes=[None] * len(bundles),
+            bundle_available=[ResourceSet() for _ in bundles],
+            namespace=ns,
+        )
+        self.placement_groups[pg_id] = pg
+        if not self._try_place_pg(pg):
+            self._pending_pgs.append(pg_id)
+        await self.publish(
+            "placement_groups", {"event": "created", "pg_id": pg_id.hex()}
+        )
+        return {"state": pg.state}
+
+    async def rpc_wait_placement_group_ready(self, conn, p):
+        pg = self.placement_groups.get(PlacementGroupID(p["pg_id"]))
+        if pg is None:
+            raise rpc.RpcError("placement group not found")
+        deadline = time.monotonic() + p.get("timeout", 30.0)
+        while pg.state not in (PG_CREATED, PG_REMOVED):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"state": pg.state}
+            await self._pg_state_wait(pg.pg_id, remaining)
+        if pg.state == PG_REMOVED:
+            raise rpc.RpcError("placement group was removed while waiting")
+        return {"state": pg.state}
+
+    async def rpc_remove_placement_group(self, conn, p):
+        pg = self.placement_groups.get(PlacementGroupID(p["pg_id"]))
+        if pg is None or pg.state == PG_REMOVED:
+            return True
+        await self._remove_pg(pg)
+        return True
+
+    async def _remove_pg(self, pg: PlacementGroupEntry):
+        # State first: _release_lease consults it to decide where freed
+        # resources go (bundle vs node pool).
+        pg.state = PG_REMOVED
+        if pg.name:
+            self.named_pgs.pop((pg.namespace, pg.name), None)
+        # Kill actors and break leases living in the group (the reference
+        # kills workers of removed PGs: gcs_placement_group_manager.cc).
+        for lease in list(self.leases.values()):
+            if lease.pg_ref and lease.pg_ref[0] == pg.pg_id:
+                if lease.actor_id:
+                    actor = self.actors.get(lease.actor_id)
+                    if actor:
+                        await self._kill_actor(
+                            actor, "placement group removed", no_restart=True
+                        )
+                        continue  # _kill_actor released the lease
+                await self._release_lease(lease.lease_id, broken=True)
+        # Return unleased bundle remainders to their nodes.
+        for i, nid in enumerate(pg.bundle_nodes):
+            if nid is not None:
+                node = self.nodes.get(nid)
+                if node and node.alive:
+                    node.resources_available = node.resources_available.add(
+                        pg.bundle_available[i]
+                    )
+            pg.bundle_nodes[i] = None
+            pg.bundle_available[i] = ResourceSet()
+        if pg.pg_id in self._pending_pgs:
+            self._pending_pgs.remove(pg.pg_id)
+        self._wake_pg_waiters(pg.pg_id)
+        await self.publish(
+            "placement_groups", {"event": "removed", "pg_id": pg.pg_id.hex()}
+        )
+        self._kick_pending()
+
+    async def rpc_get_placement_group(self, conn, p):
+        if "name" in p:
+            key = (p.get("namespace", "default"), p["name"])
+            pg_id = self.named_pgs.get(key)
+            pg = self.placement_groups.get(pg_id) if pg_id else None
+        else:
+            pg = self.placement_groups.get(PlacementGroupID(p["pg_id"]))
+        if pg is None:
+            return None
+        return self._pg_info(pg)
+
+    def _pg_info(self, pg: PlacementGroupEntry) -> dict:
+        return {
+            "pg_id": pg.pg_id.binary(),
+            "name": pg.name,
+            "strategy": pg.strategy,
+            "state": pg.state,
+            "bundles": [b.to_dict() for b in pg.bundles],
+            "bundle_nodes": [
+                nid.hex() if nid else None for nid in pg.bundle_nodes
+            ],
+            "bundles_available": [b.to_dict() for b in pg.bundle_available],
+            "created_at": pg.created_at,
+        }
+
+    async def rpc_list_placement_groups(self, conn, p):
+        return [self._pg_info(pg) for pg in self.placement_groups.values()]
+
+    def _pg_bundle_candidates(
+        self, pg: PlacementGroupEntry, idx: int, demand: ResourceSet
+    ) -> List[int]:
+        """Bundle indices this lease may draw from; validates feasibility.
+
+        Raises immediately (like the non-PG infeasibility path) when the
+        demand can never fit the targeted bundle(s), instead of letting the
+        caller wait forever on LEASE_PENDING.
+        """
+        if idx >= len(pg.bundles):
+            raise rpc.RpcError(
+                f"bundle_index {idx} out of range ({len(pg.bundles)} bundles)"
+            )
+        cands = [idx] if idx >= 0 else list(range(len(pg.bundles)))
+        if not any(pg.bundles[i].covers(demand) for i in cands):
+            raise rpc.RpcError(
+                f"infeasible placement-group request {demand.to_dict()}: no "
+                f"targeted bundle is large enough "
+                f"(bundles: {[pg.bundles[i].to_dict() for i in cands]})"
+            )
+        return cands
+
+    async def _try_grant_pg_lease(
+        self, pg: PlacementGroupEntry, cands: List[int], demand: ResourceSet,
+        conn, p,
+    ):
+        """Grant from the first bundle with room on an alive node, else None."""
+        if pg.state != PG_CREATED:
+            return None
+        for i in cands:
+            nid = pg.bundle_nodes[i]
+            node = self.nodes.get(nid) if nid else None
+            if node and node.alive and pg.bundle_available[i].covers(demand):
+                return await self._grant_lease(
+                    node, demand, conn, p, pg_ref=(pg.pg_id, i)
+                )
+        return None
+
+    async def _request_pg_lease(self, conn, p, demand: ResourceSet, strategy):
+        pg = self.placement_groups.get(
+            PlacementGroupID.from_hex(strategy["pg_id"])
+        )
+        if pg is None:
+            raise rpc.RpcError("placement group not found")
+        idx = strategy.get("bundle_index", -1)
+        cands = self._pg_bundle_candidates(pg, idx, demand)
+        deadline = time.monotonic() + cfg.sched_max_pending_lease_s
+        while True:
+            if pg.state == PG_REMOVED:
+                raise rpc.RpcError("placement group was removed")
+            grant = await self._try_grant_pg_lease(pg, cands, demand, conn, p)
+            if grant is not None:
+                return grant
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not await self._pg_state_wait(
+                pg.pg_id, remaining
+            ):
+                raise rpc.RpcError(
+                    f"LEASE_PENDING: waiting for placement-group capacity for "
+                    f"{demand.to_dict()} (bundle_index={idx}, state={pg.state})"
+                )
+
     # ---- leases (the scheduling hot path) ------------------------------
     async def rpc_request_lease(self, conn, p):
         """Grant a worker lease: pick node, get a worker from its raylet."""
         demand = ResourceSet(p["resources"])
         strategy = p.get("strategy", {})
+        if strategy.get("type") == "placement_group":
+            return await self._request_pg_lease(conn, p, demand, strategy)
         actor_id = ActorID(p["actor_id"]) if p.get("actor_id") else None
         if not self.scheduler.feasible_nodes(demand):
             raise rpc.RpcError(
@@ -478,12 +813,22 @@ class GcsServer:
                 continue  # stale pick; loop re-evaluates
             return await self._grant_lease(node, demand, conn, p)
 
-    async def _grant_lease(self, node: NodeEntry, demand: ResourceSet, conn, p):
+    async def _grant_lease(
+        self, node: NodeEntry, demand: ResourceSet, conn, p, pg_ref=None
+    ):
         if getattr(conn, "closed", False):
             self._kick_pending()
             raise rpc.RpcError("client disconnected before lease grant")
         lease_id = next(self._lease_ids)
-        node.resources_available = node.resources_available.subtract(demand)
+        if pg_ref is not None:
+            # PG leases draw from the bundle's reservation, not the node
+            # pool (the node pool was already debited at PG creation).
+            pg = self.placement_groups[pg_ref[0]]
+            pg.bundle_available[pg_ref[1]] = pg.bundle_available[
+                pg_ref[1]
+            ].subtract(demand)
+        else:
+            node.resources_available = node.resources_available.subtract(demand)
         try:
             reply = await node.conn.call(
                 "lease_worker",
@@ -495,7 +840,21 @@ class GcsServer:
                 timeout=cfg.worker_start_timeout_s,
             )
         except Exception:
-            node.resources_available = node.resources_available.add(demand)
+            if pg_ref is not None:
+                pg = self.placement_groups[pg_ref[0]]
+                # refund only if the bundle still lives on this node — it
+                # may have been rescheduled elsewhere (already back at full
+                # availability) while the lease_worker RPC was in flight
+                if (
+                    pg.state != PG_REMOVED
+                    and pg.bundle_nodes[pg_ref[1]] == node.node_id
+                ):
+                    pg.bundle_available[pg_ref[1]] = pg.bundle_available[
+                        pg_ref[1]
+                    ].add(demand)
+                    self._wake_pg_waiters(pg.pg_id)
+            else:
+                node.resources_available = node.resources_available.add(demand)
             self._kick_pending()
             raise
         lease = LeaseEntry(
@@ -506,6 +865,7 @@ class GcsServer:
             resources=demand,
             client_conn=conn,
             actor_id=ActorID(p["actor_id"]) if p.get("actor_id") else None,
+            pg_ref=pg_ref,
         )
         self.leases[lease_id] = lease
         self._conn_leases.setdefault(conn, set()).add(lease_id)
@@ -527,8 +887,27 @@ class GcsServer:
             return
         self._conn_leases.get(lease.client_conn, set()).discard(lease_id)
         node = self.nodes.get(lease.node_id)
+        returned_to_bundle = False
+        if lease.pg_ref is not None:
+            pg = self.placement_groups.get(lease.pg_ref[0])
+            i = lease.pg_ref[1]
+            if (
+                pg is not None
+                and pg.state != PG_REMOVED
+                and pg.bundle_nodes[i] == lease.node_id
+            ):
+                # bundle still lives where the lease ran: capacity returns
+                # to the bundle, not the node pool
+                pg.bundle_available[i] = pg.bundle_available[i].add(
+                    lease.resources
+                )
+                returned_to_bundle = True
+                self._wake_pg_waiters(pg.pg_id)
         if node and node.alive:
-            node.resources_available = node.resources_available.add(lease.resources)
+            if not returned_to_bundle:
+                node.resources_available = node.resources_available.add(
+                    lease.resources
+                )
             try:
                 await node.conn.notify(
                     "release_worker",
@@ -543,7 +922,18 @@ class GcsServer:
         self._kick_pending()
 
     def _kick_pending(self):
-        """Re-try queued lease requests after resources freed / node joined."""
+        """Re-try queued placement groups and lease requests after
+        resources freed / node joined.  PGs go first: gang reservations
+        are all-or-nothing and would otherwise starve behind a stream of
+        small leases."""
+        still_pgs: List[PlacementGroupID] = []
+        for pg_id in self._pending_pgs:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state in (PG_CREATED, PG_REMOVED):
+                continue
+            if not self._try_place_pg(pg):
+                still_pgs.append(pg_id)
+        self._pending_pgs = still_pgs
         still: List[PendingLease] = []
         for req in self.scheduler.pending:
             if req.fut.done():
@@ -696,21 +1086,46 @@ class GcsServer:
         """GCS-driven actor restart: lease a fresh worker, replay creation."""
         try:
             demand = ResourceSet(actor.resources)
-            while True:
-                node = self.scheduler.pick_node(demand, actor.scheduling)
-                if node is not None and node.resources_available.covers(demand):
-                    break
-                fut = asyncio.get_running_loop().create_future()
-                self.scheduler.pending.append(
-                    PendingLease(fut, demand, actor.scheduling,
-                                 actor_id=actor.actor_id,
-                                 client_conn=_GCS_SELF_CONN)
+            grant = None
+            if actor.scheduling.get("type") == "placement_group":
+                # A gang actor restarts into its own bundle (which may
+                # itself be rescheduling after the node death).
+                pg = self.placement_groups.get(
+                    PlacementGroupID.from_hex(actor.scheduling["pg_id"])
                 )
-                await fut
-            grant = await self._grant_lease(
-                node, demand, _GCS_SELF_CONN,
-                {"actor_id": actor.actor_id.binary()},
-            )
+                if pg is None:
+                    raise rpc.RpcError("actor's placement group not found")
+                idx = actor.scheduling.get("bundle_index", -1)
+                cands = self._pg_bundle_candidates(pg, idx, demand)
+                while grant is None:
+                    if pg.state == PG_REMOVED:
+                        raise rpc.RpcError(
+                            "actor's placement group was removed"
+                        )
+                    grant = await self._try_grant_pg_lease(
+                        pg, cands, demand, _GCS_SELF_CONN,
+                        {"actor_id": actor.actor_id.binary()},
+                    )
+                    if grant is None:
+                        await self._pg_state_wait(pg.pg_id, 5.0)
+            else:
+                while True:
+                    node = self.scheduler.pick_node(demand, actor.scheduling)
+                    if node is not None and node.resources_available.covers(
+                        demand
+                    ):
+                        break
+                    fut = asyncio.get_running_loop().create_future()
+                    self.scheduler.pending.append(
+                        PendingLease(fut, demand, actor.scheduling,
+                                     actor_id=actor.actor_id,
+                                     client_conn=_GCS_SELF_CONN)
+                    )
+                    await fut
+                grant = await self._grant_lease(
+                    node, demand, _GCS_SELF_CONN,
+                    {"actor_id": actor.actor_id.binary()},
+                )
             worker_conn = None
             deadline = time.monotonic() + cfg.worker_start_timeout_s
             wid = WorkerID(grant["worker_id"])

@@ -442,6 +442,15 @@ def main():
                         format="[raylet] %(levelname)s %(message)s")
 
     async def run():
+        import signal
+
+        # Graceful SIGTERM: kill workers and unlink the shm arena — node
+        # removal must not leak /dev/shm store files.  Installed BEFORE
+        # start(): the parent can observe the node's GCS registration (made
+        # inside start()) and send SIGTERM before this coroutine resumes.
+        stop = asyncio.Event()
+        asyncio.get_running_loop().add_signal_handler(signal.SIGTERM, stop.set)
+
         raylet = Raylet(
             gcs_address=args.gcs,
             node_id=NodeID.from_hex(args.node_id) if args.node_id else None,
@@ -454,7 +463,8 @@ def main():
         await raylet.start()
         print(f"RAYLET_ADDRESS={raylet.server.address}", flush=True)
         print(f"RAYLET_NODE_ID={raylet.node_id.hex()}", flush=True)
-        await asyncio.Event().wait()
+        await stop.wait()
+        await raylet.close()
 
     try:
         asyncio.run(run())

@@ -508,7 +508,8 @@ class Runtime:
         self._task_index += 1
         task_id = TaskID.random()
         fn_hash = self.fn_hash_and_register(fn)
-        resources = dict(resources or {"CPU": 1})
+        # {} is a valid demand (zero-resource tasks, e.g. PG probes)
+        resources = dict(resources) if resources is not None else {"CPU": 1}
         spec = {
             "task_id": task_id.binary(),
             "name": name,

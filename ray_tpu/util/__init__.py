@@ -1,0 +1,17 @@
+"""ray_tpu.util: placement groups, scheduling strategies, collectives, state.
+
+Role-equivalent of ray: python/ray/util/.
+"""
+
+from ray_tpu.util.placement_group import (  # noqa: F401
+    PlacementGroup,
+    get_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+)

@@ -1,0 +1,150 @@
+"""Placement groups on a real multi-raylet cluster.
+
+Mirrors the reference's PG test areas (ray: python/ray/tests/
+test_placement_group*.py) — gang reservation, strategies, pending→ready,
+capacity accounting, removal semantics, bundle-scoped scheduling.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """3 nodes x 2 CPU."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+class NodeReporter:
+    def node(self):
+        return ray_tpu.get_runtime_context().node_id
+
+
+def _spawn_in_bundle(pg, index):
+    return NodeReporter.options(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=index
+        ),
+    ).remote()
+
+
+class TestStrategies:
+    def test_strict_spread_lands_on_distinct_nodes(self, cluster):
+        pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+        assert pg.wait(30)
+        actors = [_spawn_in_bundle(pg, i) for i in range(3)]
+        nodes = ray_tpu.get([a.node.remote() for a in actors], timeout=60)
+        assert len(set(nodes)) == 3
+        for a in actors:
+            ray_tpu.kill(a)
+        remove_placement_group(pg)
+
+    def test_strict_pack_lands_on_one_node(self, cluster):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+        assert pg.wait(30)
+        actors = [_spawn_in_bundle(pg, i) for i in range(2)]
+        nodes = ray_tpu.get([a.node.remote() for a in actors], timeout=60)
+        assert len(set(nodes)) == 1
+        for a in actors:
+            ray_tpu.kill(a)
+        remove_placement_group(pg)
+
+    def test_ready_ref(self, cluster):
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert ray_tpu.get(pg.ready(), timeout=60) is True
+        remove_placement_group(pg)
+
+
+class TestLifecycle:
+    def test_pending_until_capacity(self, cluster):
+        # 4 x 2-CPU bundles strictly spread need 4 nodes; only 3 exist.
+        pg = placement_group([{"CPU": 2}] * 4, strategy="STRICT_SPREAD")
+        assert not pg.wait(1.5)
+        table = placement_group_table()[pg.id.hex()]
+        assert table["state"] == "PENDING"
+        new_node = cluster.add_node(num_cpus=2)
+        try:
+            assert pg.wait(30)
+        finally:
+            remove_placement_group(pg)
+            cluster.remove_node(new_node)
+
+    def test_capacity_reserved_and_released(self, cluster):
+        before = ray_tpu.available_resources().get("CPU", 0)
+        pg = placement_group([{"CPU": 2}] * 3, strategy="SPREAD")
+        assert pg.wait(30)
+        assert ray_tpu.available_resources().get("CPU", 0) == before - 6
+        remove_placement_group(pg)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if ray_tpu.available_resources().get("CPU", 0) == before:
+                break
+            time.sleep(0.2)
+        assert ray_tpu.available_resources().get("CPU", 0) == before
+
+    def test_remove_kills_inhabitants(self, cluster):
+        from ray_tpu.core.errors import ActorDiedError
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(30)
+        a = _spawn_in_bundle(pg, 0)
+        ray_tpu.get(a.node.remote(), timeout=60)
+        remove_placement_group(pg)
+        with pytest.raises(ActorDiedError):
+            ray_tpu.get(a.node.remote(), timeout=60)
+
+    def test_named_pg(self, cluster):
+        from ray_tpu.util import get_placement_group
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK", name="trainers")
+        assert pg.wait(30)
+        found = get_placement_group("trainers")
+        assert found.id == pg.id
+        remove_placement_group(pg)
+
+    def test_bundle_index_out_of_range(self, cluster):
+        from ray_tpu.core.errors import TaskError
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(30)
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ref = f.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=5
+            ),
+            max_retries=0,
+        ).remote()
+        with pytest.raises(TaskError):
+            ray_tpu.get(ref, timeout=60)
+        remove_placement_group(pg)
+
+    def test_invalid_args(self, cluster):
+        with pytest.raises(ValueError):
+            placement_group([{"CPU": 1}], strategy="DIAGONAL")
+        with pytest.raises(ValueError):
+            placement_group([])
+        with pytest.raises(ValueError):
+            placement_group([{}])
