@@ -1,0 +1,21 @@
+"""ray_tpu.train: distributed training orchestration for TPU gangs.
+
+Role-equivalent of ray: python/ray/train/.  Worker-side API (report /
+get_checkpoint / get_context) + driver-side JaxTrainer.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig  # noqa: F401
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (  # noqa: F401
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401

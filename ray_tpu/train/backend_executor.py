@@ -1,0 +1,139 @@
+"""Drives the worker gang through a training run.
+
+Role-equivalent of ray: python/ray/train/_internal/backend_executor.py:66
+(BackendExecutor — start:124, start_training:436) plus the report-polling
+loop of train/trainer.py:31 (TrainingIterator).
+
+Report flow: each round, one report is taken from EVERY worker (soft
+barrier, like the reference); rank-0's metrics win; any worker's
+checkpoint is persisted to run storage.  Worker failure surfaces as
+TrainWorkerGroupError for the trainer's gang-restart policy.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.errors import ActorDiedError, GetTimeoutError, TaskError
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.session import TrainContext
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainWorkerGroupError(RuntimeError):
+    """A worker died or errored; the gang must restart."""
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+        run_config: RunConfig,
+    ):
+        self.backend_config = backend_config
+        self.backend = backend_config.backend_cls()
+        self.scaling = scaling_config
+        self.run_config = run_config
+        self.worker_group: Optional[WorkerGroup] = None
+        self.experiment_name = run_config.name or "train_run"
+        self.trial_dir = os.path.join(
+            run_config.resolved_storage_path(), self.experiment_name
+        )
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        self.worker_group = WorkerGroup(
+            self.scaling.num_workers,
+            self.scaling.bundle(),
+            placement_strategy=self.scaling.placement_strategy,
+        )
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group, self.backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+
+    # -- training --------------------------------------------------------
+    def start_training(
+        self,
+        train_fn: Callable[[Dict[str, Any]], Any],
+        config: Dict[str, Any],
+        latest_checkpoint: Optional[Checkpoint],
+    ):
+        os.makedirs(self.trial_dir, exist_ok=True)
+        self.backend.on_training_start(self.worker_group, self.backend_config)
+        wg = self.worker_group
+        node_count = len({w.node_id for w in wg.workers})
+        local_sizes: Dict[str, int] = {}
+        for w in wg.workers:
+            local_sizes[w.node_id] = local_sizes.get(w.node_id, 0) + 1
+        starts = []
+        for w in wg.workers:
+            ctx = TrainContext(
+                world_size=len(wg.workers),
+                world_rank=w.rank,
+                local_rank=w.local_rank,
+                local_world_size=local_sizes[w.node_id],
+                node_rank=w.node_rank,
+                experiment_name=self.experiment_name,
+                trial_dir=self.trial_dir,
+            )
+            starts.append(
+                w.actor.start_training.remote(
+                    train_fn, config, ctx, latest_checkpoint
+                )
+            )
+        try:
+            ray_tpu.get(starts, timeout=120)
+        except (ActorDiedError, TaskError) as e:
+            raise TrainWorkerGroupError(f"worker failed to start: {e}") from e
+
+    def next_reports(self, timeout: float = 600.0) -> Optional[List[dict]]:
+        """One report from every worker, or None when all loops finished.
+
+        Raises TrainWorkerGroupError if any worker errored or died.
+        """
+        wg = self.worker_group
+        try:
+            reports = ray_tpu.get(
+                [
+                    w.actor.next_report.remote(timeout=timeout)
+                    for w in wg.workers
+                ],
+                timeout=timeout + 60,
+            )
+        except ActorDiedError as e:
+            raise TrainWorkerGroupError(f"worker died mid-training: {e}") from e
+        except TaskError as e:
+            raise TrainWorkerGroupError(f"training loop failed: {e}") from e
+        except GetTimeoutError as e:
+            raise TrainWorkerGroupError(f"workers unresponsive: {e}") from e
+        done = [r is None for r in reports]
+        if all(done):
+            return None
+        if any(done):
+            raise TrainWorkerGroupError(
+                "training loops finished out of step: some workers reported "
+                "while others already returned — SPMD loops must report the "
+                "same number of times"
+            )
+        return reports
+
+    def finish(self) -> List[Any]:
+        wg = self.worker_group
+        try:
+            return ray_tpu.get(
+                [w.actor.get_result.remote() for w in wg.workers], timeout=600
+            )
+        except (ActorDiedError, TaskError, GetTimeoutError) as e:
+            raise TrainWorkerGroupError(str(e)) from e

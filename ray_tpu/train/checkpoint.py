@@ -1,0 +1,94 @@
+"""Checkpoint handle: a directory of files, wherever it lives.
+
+Role-equivalent of ray: python/ray/train/_checkpoint.py:56 (Checkpoint) and
+the storage layer (train/_internal/storage.py:349), collapsed to a
+filesystem-path abstraction: TPU pods mount shared storage (GCS fuse /
+NFS), so "upload" is a directory copy and zero-copy restore is a path.
+
+For model state prefer orbax/msgpack inside the directory; `from_dict` /
+`to_dict` cover small python-object checkpoints (pickle).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+_DICT_FILE = "_dict_checkpoint.pkl"
+
+
+class Checkpoint:
+    """Handle to a checkpoint directory.
+
+    ``_temp=True`` marks a scratch directory owned by this handle:
+    ``persist()`` *moves* it into run storage instead of copying, so
+    per-step ``from_dict`` checkpoints don't accumulate in /tmp.
+    """
+
+    def __init__(self, path: str, _temp: bool = False):
+        self.path = os.path.abspath(path)
+        self._temp = _temp
+
+    # -- constructors ----------------------------------------------------
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="rt_ckpt_")
+        with open(os.path.join(d, _DICT_FILE), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d, _temp=True)
+
+    # -- accessors -------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _DICT_FILE)
+        if not os.path.exists(p):
+            raise ValueError(
+                f"checkpoint at {self.path} was not created with from_dict"
+            )
+        with open(p, "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Copy the checkpoint into ``path`` (or a fresh temp dir)."""
+        dest = path or tempfile.mkdtemp(prefix="rt_ckpt_")
+        os.makedirs(dest, exist_ok=True)
+        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self):
+        """Local read access without copying (path is already local/mounted)."""
+        yield self.path
+
+    def persist(self, storage_dir: str, name: Optional[str] = None) -> "Checkpoint":
+        """Move/copy into run storage and return the durable handle.
+
+        Scratch checkpoints (from_dict) are moved; user-owned directories
+        are copied.
+        """
+        name = name or f"checkpoint_{uuid.uuid4().hex[:8]}"
+        dest = os.path.join(storage_dir, name)
+        if os.path.abspath(self.path) == os.path.abspath(dest):
+            return self
+        os.makedirs(storage_dir, exist_ok=True)
+        if self._temp and not os.path.exists(dest):
+            shutil.move(self.path, dest)
+        else:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+            if self._temp:
+                shutil.rmtree(self.path, ignore_errors=True)
+        return Checkpoint(dest)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))

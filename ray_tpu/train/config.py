@@ -1,0 +1,66 @@
+"""Train configuration dataclasses.
+
+Role-equivalent of ray: python/ray/air/config.py (ScalingConfig:103,
+RunConfig:617, FailureConfig) and ray: python/ray/train/_checkpoint
+CheckpointConfig — reshaped for TPU: scaling is expressed in workers
+(processes) × chips per worker, and maps onto a placement group whose
+bundles follow slice topology.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many training workers and what each one holds.
+
+    A worker is one process that owns ``tpus_per_worker`` chips (libtpu:
+    one process per chip set).  On a v5e-8 host, 1 worker × 8 chips is
+    the canonical layout; a v5e-256 pod is 32 workers × 8 chips.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: Optional[float] = None  # default: all chips of a host
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"  # workers per reference default
+
+    def bundle(self) -> Dict[str, float]:
+        b = dict(self.resources_per_worker or {})
+        b["CPU"] = b.get("CPU", self.cpus_per_worker)
+        if self.use_tpu:
+            b.setdefault("TPU", self.tpus_per_worker or 1)
+        return b
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Gang restart policy: an SPMD group is all-or-nothing, so any worker
+    failure restarts the whole group from the latest checkpoint
+    (SURVEY.md §7 "hard parts": one host dies ⇒ whole mesh restarts).
+
+    max_failures < 0 means retry forever.
+    """
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None  # None = keep all
+    checkpoint_frequency: int = 0  # informational; loops decide when
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # default: /tmp/ray_tpu_results
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or "/tmp/ray_tpu_results"

@@ -1,0 +1,176 @@
+"""Train library: worker gangs, session reporting, checkpoints, gang restart.
+
+Mirrors the reference's Train test areas (ray: python/ray/train/tests/
+test_data_parallel_trainer.py, test_backend.py, test_session.py).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_single_worker_basic(cluster, tmp_path):
+    def loop(config):
+        for i in range(3):
+            train.report({"step": i, "loss": 1.0 / (i + 1)})
+        return "done"
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="basic", storage_path=str(tmp_path)),
+    ).fit()
+    assert r.error is None
+    assert r.metrics["step"] == 2
+    assert len(r.metrics_dataframe) == 3
+
+
+def test_multi_worker_context_and_barrier(cluster, tmp_path):
+    def loop(config):
+        ctx = train.get_context()
+        for i in range(2):
+            train.report(
+                {
+                    "step": i,
+                    "rank": ctx.get_world_rank(),
+                    "world": ctx.get_world_size(),
+                }
+            )
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(name="multi", storage_path=str(tmp_path)),
+    ).fit()
+    assert r.error is None
+    # rank-0 metrics are canonical
+    assert r.metrics["rank"] == 0
+    assert r.metrics["world"] == 2
+
+
+def test_coordinator_env_published(cluster, tmp_path):
+    def loop(config):
+        train.report(
+            {
+                "coord": os.environ.get("RT_COORDINATOR_ADDRESS", ""),
+                "nproc": os.environ.get("RT_NUM_PROCESSES", ""),
+                "pid_rank": os.environ.get("RT_PROCESS_ID", ""),
+            }
+        )
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(name="env", storage_path=str(tmp_path)),
+    ).fit()
+    assert r.error is None
+    assert r.metrics["nproc"] == "2"
+    assert r.metrics["coord"].count(":") == 1
+    assert r.metrics["pid_rank"] == "0"
+
+
+def test_checkpoint_roundtrip(cluster, tmp_path):
+    def loop(config):
+        ctx = train.get_context()
+        for step in range(3):
+            if ctx.get_world_rank() == 0:
+                ckpt = Checkpoint.from_dict({"step": step, "weights": [step] * 4})
+                train.report({"step": step}, checkpoint=ckpt)
+            else:
+                train.report({"step": step})
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(name="ckpt", storage_path=str(tmp_path)),
+    ).fit()
+    assert r.error is None
+    assert r.checkpoint is not None
+    data = r.checkpoint.to_dict()
+    assert data["step"] == 2
+    # persisted under the trial dir
+    assert r.checkpoint.path.startswith(str(tmp_path))
+
+
+def test_worker_error_propagates(cluster, tmp_path):
+    def loop(config):
+        train.report({"step": 0})
+        raise RuntimeError("loop exploded")
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="err",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=0),
+        ),
+    ).fit()
+    assert r.error is not None
+    assert "loop exploded" in str(r.error)
+
+
+def test_gang_restart_resumes_from_checkpoint(cluster, tmp_path):
+    marker = str(tmp_path / "crashed_once")
+
+    def loop(config):
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = ckpt.to_dict()["step"] + 1 if ckpt is not None else 0
+        for step in range(start, 4):
+            if step == 2 and not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)  # kill this worker process mid-training
+            if ctx.get_world_rank() == 0:
+                train.report(
+                    {"step": step, "resumed": start > 0},
+                    checkpoint=Checkpoint.from_dict({"step": step}),
+                )
+            else:
+                train.report({"step": step})
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(
+            name="restart",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert r.error is None
+    assert r.metrics["step"] == 3
+    assert r.metrics["resumed"] is True  # second gang started from ckpt step 1
+
+
+def test_resume_from_checkpoint_arg(cluster, tmp_path):
+    def loop(config):
+        ckpt = train.get_checkpoint()
+        base = ckpt.to_dict()["base"] if ckpt else 0
+        train.report({"value": base + 1})
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="resume", storage_path=str(tmp_path)),
+        resume_from_checkpoint=Checkpoint.from_dict({"base": 41}),
+    ).fit()
+    assert r.error is None
+    assert r.metrics["value"] == 42
