@@ -962,7 +962,9 @@ class GcsServer:
                         return {"existing": True, "actor_id": existing.actor_id.binary()}
                     raise rpc.RpcError(f"actor name {name!r} already taken")
             self.named_actors[key] = actor_id
-        job_id = JobID(p["job_id"])
+        # actors created from worker processes have no owning job; they die
+        # with the cluster (or explicitly), not with any job
+        job_id = JobID(p["job_id"]) if p.get("job_id") else None
         entry = ActorEntry(
             actor_id=actor_id,
             name=name,

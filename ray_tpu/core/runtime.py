@@ -350,7 +350,11 @@ class Runtime:
             # a task from this process produces it → wait for completion
             fut = self.result_futures.get(oid)
             if fut is not None:
-                remaining = None if deadline is None else deadline - time.monotonic()
+                remaining = (
+                    None
+                    if deadline is None or deadline == float("inf")
+                    else deadline - time.monotonic()
+                )
                 if remaining is not None and remaining <= 0:
                     raise GetTimeoutError(f"timed out waiting for {oid.hex()[:16]}")
                 try:
@@ -385,7 +389,7 @@ class Runtime:
                     raise ObjectLostError(
                         f"object {oid.hex()[:16]} not found anywhere in the cluster"
                     )
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(0.05)  # inf/finite deadline: retry
 
     def _read_from_store(self, oid: bytes) -> Tuple[Any, bool]:
         pin = self.store.get(oid)
@@ -406,8 +410,16 @@ class Runtime:
     async def _wait_async(self, refs, num_returns, deadline):
         pending = list(refs)
         ready: List[ObjectRef] = []
+        # Per-ref resolution runs with an INFINITE deadline: the wait
+        # timeout is enforced by asyncio.wait below.  A real deadline here
+        # would complete futures with GetTimeoutError at the cutoff and
+        # misreport timed-out refs as ready; deadline=None would convert a
+        # slow cross-owner pull into ObjectLostError (also "ready").  inf
+        # keeps retrying the pull until the ref truly resolves or errors.
         futs = {
-            r: asyncio.ensure_future(self._resolve_one(r.object_id.binary(), deadline))
+            r: asyncio.ensure_future(
+                self._resolve_one(r.object_id.binary(), float("inf"))
+            )
             for r in pending
         }
         try:
@@ -747,7 +759,7 @@ class Runtime:
                 "register_actor",
                 {
                     "actor_id": actor_id.binary(),
-                    "job_id": self.job_id.binary(),
+                    "job_id": self.job_id.binary() if self.job_id else None,
                     "name": name,
                     "namespace": namespace,
                     "get_if_exists": get_if_exists,

@@ -1,0 +1,25 @@
+"""ray_tpu.tune: experiment execution and hyperparameter search.
+
+Role-equivalent of ray: python/ray/tune/.  Trials are single-actor
+training loops sharing the Train session API (report/get_checkpoint);
+Tuner resolves a param space into trials, runs them through the
+TuneController with an optional scheduler (ASHA), and returns a
+ResultGrid.
+"""
+
+from ray_tpu.train.session import get_checkpoint, report  # noqa: F401
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    with_resources,
+)

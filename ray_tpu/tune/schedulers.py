@@ -1,0 +1,68 @@
+"""Trial schedulers: decide per-result whether a trial lives on.
+
+Role-equivalent of ray: python/ray/tune/schedulers/ — FIFOScheduler
+(trial_scheduler.py) and ASHA (async_hyperband.py AsyncHyperBandScheduler):
+asynchronous successive halving with geometric rungs; a trial reaching a
+rung must be in the top 1/reduction_factor of that rung's recorded scores
+or it stops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_trial_result(self, trial_id: str, result: dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung value -> list of recorded scores (sign-normalized: higher=better)
+        self._rungs: Dict[int, List[float]] = {}
+        rung = grace_period
+        self._rung_levels: List[int] = []
+        while rung < max_t:
+            self._rung_levels.append(rung)
+            rung *= reduction_factor
+
+    def _score(self, result: dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial_id: str, result: dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP  # budget exhausted (scheduler-complete, not failure)
+        decision = CONTINUE
+        for rung in self._rung_levels:
+            if t != rung:
+                continue
+            scores = self._rungs.setdefault(rung, [])
+            score = self._score(result)
+            scores.append(score)
+            # top 1/rf quantile survives: k = ceil(n / rf)
+            k = max(1, (len(scores) + self.rf - 1) // self.rf)
+            cutoff = sorted(scores, reverse=True)[k - 1]
+            if score < cutoff:
+                decision = STOP
+        return decision

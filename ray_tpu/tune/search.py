@@ -1,0 +1,153 @@
+"""Search spaces and the basic variant generator.
+
+Role-equivalent of ray: python/ray/tune/search/ (sample.py domains,
+basic_variant.py BasicVariantGenerator): grid_search cross-products,
+random distributions for sampled dimensions, num_samples repetitions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+# -- domains ---------------------------------------------------------------
+
+
+@dataclass
+class Domain:
+    sampler: Callable[[random.Random], Any]
+
+    def sample(self, rng: random.Random) -> Any:
+        return self.sampler(rng)
+
+
+def uniform(low: float, high: float) -> Domain:
+    return Domain(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> Domain:
+    import math
+
+    lo, hi = math.log(low), math.log(high)
+    return Domain(lambda rng: math.exp(rng.uniform(lo, hi)))
+
+
+def randint(low: int, high: int) -> Domain:
+    """Uniform integer in [low, high) (reference semantics)."""
+    return Domain(lambda rng: rng.randrange(low, high))
+
+
+def choice(options: List[Any]) -> Domain:
+    opts = list(options)
+    return Domain(lambda rng: rng.choice(opts))
+
+
+def sample_from(fn: Callable[[Dict[str, Any]], Any]) -> Domain:
+    """Sample from a callable receiving the partially-resolved config."""
+    d = Domain(None)  # type: ignore[arg-type]
+    d.needs_config = fn  # type: ignore[attr-defined]
+    return d
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}
+
+
+# -- variant generation ----------------------------------------------------
+
+
+def _walk(space: Any, path=()):
+    """Yield (path, spec) for every grid/domain leaf in a nested dict."""
+    if isinstance(space, dict):
+        if set(space.keys()) == {"grid_search"}:
+            yield path, space
+            return
+        for k, v in space.items():
+            yield from _walk(v, path + (k,))
+    elif isinstance(space, Domain):
+        yield path, space
+
+
+def _set_path(cfg: dict, path, value):
+    node = cfg
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
+
+
+def _contains_domain(value) -> bool:
+    if isinstance(value, Domain):
+        return True
+    if isinstance(value, dict):
+        return any(_contains_domain(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return any(_contains_domain(v) for v in value)
+    return False
+
+
+def _deep_copy_plain(space):
+    if isinstance(space, dict):
+        return {k: _deep_copy_plain(v) for k, v in space.items()}
+    return space
+
+
+def generate_variants(
+    param_space: Dict[str, Any],
+    num_samples: int = 1,
+    seed: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Resolve the space: full grid cross-product × num_samples random draws."""
+    rng = random.Random(seed)
+    leaves = list(_walk(param_space))
+    grid_leaves = [(p, s["grid_search"]) for p, s in leaves if isinstance(s, dict)]
+    domain_leaves = [(p, s) for p, s in leaves if isinstance(s, Domain)]
+
+    grids = (
+        itertools.product(*[vals for _, vals in grid_leaves])
+        if grid_leaves
+        else [()]
+    )
+    samplers = [
+        (p, d) for p, d in domain_leaves if getattr(d, "needs_config", None) is None
+    ]
+    dependent = [
+        (p, d) for p, d in domain_leaves if getattr(d, "needs_config", None) is not None
+    ]
+    configs: List[Dict[str, Any]] = []
+    for combo in grids:
+        for _ in range(num_samples):
+            cfg = _deep_copy_plain(param_space)
+            for (path, _), val in zip(grid_leaves, combo):
+                _set_path(cfg, path, val)
+            for path, dom in samplers:
+                _set_path(cfg, path, dom.sample(rng))
+            # sample_from callables may reference other sampled values:
+            # resolve in passes, deferring ones whose inputs aren't ready
+            # (reference: BasicVariantGenerator iterative resolution)
+            todo = list(dependent)
+            for _pass in range(len(todo) + 1):
+                if not todo:
+                    break
+                deferred, last_err = [], None
+                for path, dom in todo:
+                    try:
+                        val = dom.needs_config(cfg)
+                        if _contains_domain(val):
+                            # fn read a still-unresolved Domain: not ready
+                            deferred.append((path, dom))
+                            continue
+                        _set_path(cfg, path, val)
+                    except Exception as e:  # inputs unresolved yet
+                        deferred.append((path, dom))
+                        last_err = e
+                if len(deferred) == len(todo):
+                    raise ValueError(
+                        f"could not resolve sample_from at {deferred[0][0]}: "
+                        f"circular or invalid reference ({last_err!r})"
+                    )
+                todo = deferred
+            configs.append(cfg)
+    return configs

@@ -1,0 +1,156 @@
+"""The trial event loop.
+
+Role-equivalent of ray: python/ray/tune/execution/tune_controller.py:68
+(TuneController) + trial.py.  Trials run as single worker actors reusing
+the Train session machinery (report/get_checkpoint are the same API in
+both libraries, like the reference).  The loop multiplexes outstanding
+next_report calls with ray_tpu.wait, feeds results to the scheduler, and
+kills trials it stops early.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.errors import ActorDiedError, TaskError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext
+from ray_tpu.train.worker_group import TrainWorkerActor
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    resources: Dict[str, float]
+    status: str = PENDING
+    results: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    early_stopped: bool = False
+    actor: Any = None
+
+    @property
+    def last_result(self) -> Dict[str, Any]:
+        return self.results[-1] if self.results else {}
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable: Callable[[Dict[str, Any]], Any],
+        trials: List[Trial],
+        *,
+        scheduler=None,
+        max_concurrent: int = 0,
+        experiment_dir: str = "/tmp/ray_tpu_results/tune",
+        experiment_name: str = "tune",
+    ):
+        self.trainable = trainable
+        self.trials = trials
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent  # 0 = unlimited
+        self.experiment_dir = experiment_dir
+        self.experiment_name = experiment_name
+
+    # -- trial lifecycle -------------------------------------------------
+    def _launch(self, trial: Trial):
+        res = dict(trial.resources)
+        extra = {k: v for k, v in res.items() if k != "CPU"}
+        trial.actor = TrainWorkerActor.options(
+            num_cpus=res.get("CPU", 1), resources=extra or None
+        ).remote()
+        ctx = TrainContext(
+            world_size=1,
+            world_rank=0,
+            local_rank=0,
+            local_world_size=1,
+            node_rank=0,
+            experiment_name=self.experiment_name,
+            trial_dir=f"{self.experiment_dir}/{trial.trial_id}",
+        )
+        trial.actor.start_training.remote(
+            self.trainable, trial.config, ctx, None
+        )
+        trial.status = RUNNING
+
+    def _finalize(self, trial: Trial, status: str, error: Optional[str] = None):
+        trial.status = status
+        trial.error = error
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    # -- the loop --------------------------------------------------------
+    def run(self) -> List[Trial]:
+        try:
+            return self._run_inner()
+        except BaseException:
+            # don't leak live trial actors past an unexpected controller
+            # failure (e.g. a scheduler bug)
+            for t in self.trials:
+                if t.status == RUNNING:
+                    self._finalize(t, ERROR, "tune controller failed")
+            raise
+
+    def _run_inner(self) -> List[Trial]:
+        pending = [t for t in self.trials if t.status == PENDING]
+        outstanding: Dict[Any, Trial] = {}  # next_report ref -> trial
+
+        def capacity() -> int:
+            running = sum(1 for t in self.trials if t.status == RUNNING)
+            if self.max_concurrent <= 0:
+                return len(pending)
+            return max(0, self.max_concurrent - running)
+
+        while pending or outstanding:
+            for _ in range(min(capacity(), len(pending))):
+                trial = pending.pop(0)
+                self._launch(trial)
+                ref = trial.actor.next_report.remote(timeout=600.0)
+                outstanding[ref] = trial
+            if not outstanding:
+                time.sleep(0.05)
+                continue
+            ready, _ = ray_tpu.wait(
+                list(outstanding.keys()), num_returns=1, timeout=5.0
+            )
+            for ref in ready:
+                trial = outstanding.pop(ref)
+                try:
+                    report = ray_tpu.get(ref, timeout=60)
+                except (TaskError, ActorDiedError) as e:
+                    self._finalize(trial, ERROR, str(e))
+                    continue
+                if report is None:  # loop finished cleanly
+                    self._finalize(trial, TERMINATED)
+                    continue
+                result = report["metrics"]
+                result.setdefault("training_iteration", len(trial.results) + 1)
+                result.setdefault("_timestamp", time.time())
+                trial.results.append(result)
+                if report["checkpoint"] is not None:
+                    trial.checkpoint = report["checkpoint"]
+                decision = self.scheduler.on_trial_result(
+                    trial.trial_id, result
+                )
+                if decision == STOP:
+                    trial.early_stopped = True
+                    self._finalize(trial, TERMINATED)
+                else:
+                    assert decision == CONTINUE
+                    nref = trial.actor.next_report.remote(timeout=600.0)
+                    outstanding[nref] = trial
+        return self.trials
