@@ -341,6 +341,7 @@ class Runtime:
         return [results[oid] for oid in oids]
 
     async def _resolve_one(self, oid: bytes, deadline) -> Any:
+        failed_pulls = 0
         while True:
             if oid in self.memory_store:
                 value = self.memory_store[oid]
@@ -385,11 +386,18 @@ class Runtime:
                 value, found = self._read_from_store(oid)
                 if found:
                     return value
-                if deadline is None:
+                failed_pulls += 1
+                if deadline is None or (
+                    deadline == float("inf") and failed_pulls >= 4
+                ):
+                    # no-timeout get fails fast; an infinite-deadline wait
+                    # (ray_tpu.wait) retries a few ~30s location rounds so
+                    # an in-flight cross-owner ref isn't misreported, then
+                    # surfaces genuinely lost objects as errored (= ready)
                     raise ObjectLostError(
                         f"object {oid.hex()[:16]} not found anywhere in the cluster"
                     )
-                await asyncio.sleep(0.05)  # inf/finite deadline: retry
+                await asyncio.sleep(0.05)  # retry until deadline
 
     def _read_from_store(self, oid: bytes) -> Tuple[Any, bool]:
         pin = self.store.get(oid)
@@ -544,13 +552,24 @@ class Runtime:
             tuple(sorted((strategy or {}).items(), key=lambda kv: kv[0])),
         )
         pending = PendingTask(spec, return_ids, max_retries)
+        # Dependencies this process itself is producing.  They must resolve
+        # BEFORE the task may occupy a lease — a worker blocking on an
+        # in-flight upstream result while holding the worker that upstream
+        # task needs is a scheduling deadlock (reference:
+        # LocalDependencyResolver, core_worker/transport/dependency_resolver.h).
+        dep_oids = [
+            item[1] if item[0] == "ref" else item[2]
+            for item in spec["args"]
+            if item[0] in ("ref", "kwref")
+        ]
         # Register result futures before the task can possibly complete, then
         # hand off to the io loop without blocking (safe to call from the io
         # thread itself, e.g. async actor methods submitting sub-tasks).
         for oid in return_ids:
             self.result_futures[oid] = asyncio.Future(loop=self._loop)
         self._call_on_loop(
-            self._enqueue_task, class_key, pending, dict(resources), strategy or {}
+            self._enqueue_after_deps, class_key, pending, dict(resources),
+            strategy or {}, dep_oids,
         )
         return [ObjectRef(ObjectID(oid), self.node_id) for oid in return_ids]
 
@@ -559,6 +578,43 @@ class Runtime:
             fn(*args)
         else:
             self._loop.call_soon_threadsafe(fn, *args)
+
+    def _enqueue_after_deps(
+        self, class_key, pending: PendingTask, resources, strategy, dep_oids
+    ):
+        """Queue the task once locally-produced ref args have resolved."""
+        waits = [
+            self.result_futures[oid]
+            for oid in dep_oids
+            if oid in self.result_futures and not self.result_futures[oid].done()
+        ]
+        if not waits:
+            failed = self._failed_dep(dep_oids)
+            if failed is not None:
+                self._fail_task(pending, failed)
+                return
+            self._enqueue_task(class_key, pending, resources, strategy)
+            return
+
+        async def wait_then_enqueue():
+            await asyncio.gather(
+                *(asyncio.shield(f) for f in waits), return_exceptions=True
+            )
+            failed = self._failed_dep(dep_oids)
+            if failed is not None:
+                self._fail_task(pending, failed)
+            else:
+                self._enqueue_task(class_key, pending, resources, strategy)
+
+        self._loop.create_task(wait_then_enqueue())
+
+    def _failed_dep(self, dep_oids) -> Optional[Exception]:
+        """If a locally-owned dependency errored, its error (else None)."""
+        for oid in dep_oids:
+            value = self.memory_store.get(oid)
+            if isinstance(value, _RaiseOnGet):
+                return value.exc
+        return None
 
     def _enqueue_task(self, class_key, pending: PendingTask, resources, strategy):
         st = self._classes.get(class_key)

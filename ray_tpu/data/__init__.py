@@ -1,0 +1,21 @@
+"""ray_tpu.data: distributed datasets over Arrow blocks.
+
+Role-equivalent of ray: python/ray/data/.  Lazy transform plans with
+fused per-block task execution; TPU ingest via iter_jax_batches.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
+from ray_tpu.data.dataset import Dataset, GroupedData  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)

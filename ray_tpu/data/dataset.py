@@ -1,0 +1,446 @@
+"""Dataset: lazy transformation plan over distributed Arrow blocks.
+
+Role-equivalent of ray: python/ray/data/dataset.py:137 (Dataset) with the
+plan layer (data/_internal/logical/) collapsed to a fused-stage executor:
+consecutive row/batch transforms fuse into ONE task per block (the
+optimization the reference's rule optimizer does for map chains), with
+shuffle ops (repartition / random_shuffle / sort / groupby) as stage
+boundaries.  Blocks are ObjectRefs to pyarrow Tables, processed by
+@remote tasks, so transform parallelism and locality come from the core
+scheduler.
+
+The TPU-facing consumption path is iter_jax_batches(): dict-of-device
+arrays, optionally laid out onto a mesh sharding for SPMD ingest.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as block_mod
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
+
+BatchFormat = Union[str]  # "pyarrow" | "numpy" | "pandas"
+
+
+# -- transform ops ---------------------------------------------------------
+
+
+class _Op:
+    pass
+
+
+class _MapBatches(_Op):
+    def __init__(self, fn, batch_format="numpy", fn_kwargs=None):
+        self.fn = fn
+        self.batch_format = batch_format
+        self.fn_kwargs = fn_kwargs or {}
+
+    def apply(self, block: Block) -> Block:
+        batch = _from_block(block, self.batch_format)
+        out = self.fn(batch, **self.fn_kwargs)
+        return _to_block(out)
+
+
+class _MapRows(_Op):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, block: Block) -> Block:
+        rows = [self.fn(r) for r in BlockAccessor(block).iter_rows()]
+        return block_mod.from_rows(rows)
+
+
+class _FlatMap(_Op):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, block: Block) -> Block:
+        rows = []
+        for r in BlockAccessor(block).iter_rows():
+            rows.extend(self.fn(r))
+        return block_mod.from_rows(rows)
+
+
+class _Filter(_Op):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, block: Block) -> Block:
+        mask = [bool(self.fn(r)) for r in BlockAccessor(block).iter_rows()]
+        return block.filter(pa.array(mask)) if len(mask) else block
+
+
+def _from_block(block: Block, fmt: str):
+    if fmt == "pyarrow":
+        return block
+    if fmt == "pandas":
+        return BlockAccessor(block).to_pandas()
+    return BlockAccessor(block).to_numpy()
+
+
+def _to_block(batch) -> Block:
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        return block_mod.from_numpy(batch)
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return block_mod.from_pandas(batch)
+    except ImportError:
+        pass
+    raise TypeError(
+        f"map_batches fn must return dict/pyarrow.Table/DataFrame, got "
+        f"{type(batch)}"
+    )
+
+
+def _apply_ops(block: Block, ops: List[_Op]) -> Block:
+    for op in ops:
+        block = op.apply(block)
+    return block
+
+
+# -- the dataset -----------------------------------------------------------
+
+
+class Dataset:
+    def __init__(self, block_refs: List[Any], ops: Optional[List[_Op]] = None):
+        self._input_refs = block_refs
+        self._ops: List[_Op] = ops or []
+        self._materialized: Optional[List[Any]] = None  # refs post-ops
+
+    # -- plan building ---------------------------------------------------
+    def _chain(self, op: _Op) -> "Dataset":
+        return Dataset(self._input_refs, self._ops + [op])
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_format: str = "numpy",
+        fn_kwargs: Optional[dict] = None,
+        **_ignored,
+    ) -> "Dataset":
+        return self._chain(_MapBatches(fn, batch_format, fn_kwargs))
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        return self._chain(_MapRows(fn))
+
+    def flat_map(self, fn: Callable[[dict], List[dict]]) -> "Dataset":
+        return self._chain(_FlatMap(fn))
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        return self._chain(_Filter(fn))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda t: t.select(cols), batch_format="pyarrow"
+        )
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda t: t.drop_columns(cols), batch_format="pyarrow"
+        )
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(t: pa.Table) -> pa.Table:
+            return t.append_column(name, pa.array(fn(t)))
+
+        return self.map_batches(add, batch_format="pyarrow")
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def rename(t: pa.Table) -> pa.Table:
+            return t.rename_columns(
+                [mapping.get(c, c) for c in t.column_names]
+            )
+
+        return self.map_batches(rename, batch_format="pyarrow")
+
+    # -- execution -------------------------------------------------------
+    def _execute(self) -> List[Any]:
+        """Run pending ops: one fused task per block (cached)."""
+        if self._materialized is not None:
+            return self._materialized
+        if not self._ops:
+            self._materialized = list(self._input_refs)
+            return self._materialized
+
+        @ray_tpu.remote
+        def run_stage(ops, block):
+            return _apply_ops(block, ops)
+
+        ops = self._ops
+        self._materialized = [
+            run_stage.remote(ops, ref) for ref in self._input_refs
+        ]
+        return self._materialized
+
+    def _blocks(self) -> List[Block]:
+        return ray_tpu.get(self._execute(), timeout=600)
+
+    def materialize(self) -> "Dataset":
+        """Execute and pin the result (ray: Dataset.materialize)."""
+        refs = self._execute()
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=600,
+                     fetch_local=False)
+        return Dataset(refs)
+
+    # -- shuffle-boundary ops -------------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        blocks = self._blocks()
+        whole = concat_blocks(blocks)
+        total = whole.num_rows
+        step = (total + num_blocks - 1) // num_blocks if total else 0
+        out = []
+        for i in range(num_blocks):
+            lo = min(i * step, total)
+            hi = min((i + 1) * step, total)
+            out.append(ray_tpu.put(whole.slice(lo, hi - lo)))
+        return Dataset(out)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        blocks = self._blocks()
+        whole = concat_blocks(blocks)
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(whole.num_rows)
+        shuffled = whole.take(pa.array(idx))
+        n = max(1, len(blocks))
+        step = (whole.num_rows + n - 1) // n
+        return Dataset(
+            [
+                ray_tpu.put(shuffled.slice(i * step, step))
+                for i in range(n)
+            ]
+        )
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        whole = concat_blocks(self._blocks())
+        order = "descending" if descending else "ascending"
+        out = whole.sort_by([(key, order)])
+        return Dataset([ray_tpu.put(out)])
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._execute())
+        for o in others:
+            refs.extend(o._execute())
+        return Dataset(refs)
+
+    def limit(self, n: int) -> "Dataset":
+        taken, out = 0, []
+        for ref in self._execute():
+            if taken >= n:
+                break
+            b = ray_tpu.get(ref, timeout=600)
+            keep = min(b.num_rows, n - taken)
+            out.append(ray_tpu.put(b.slice(0, keep)))
+            taken += keep
+        return Dataset(out)
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Split into n datasets by whole blocks (per-worker ingest)."""
+        refs = self._execute()
+        if equal:
+            ds = self.repartition(n)
+            return [Dataset([r]) for r in ds._execute()]
+        out: List[List[Any]] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            out[i % n].append(ref)
+        return [Dataset(rs) for rs in out]
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -- consumption -----------------------------------------------------
+    def count(self) -> int:
+        @ray_tpu.remote
+        def count_block(b):
+            return b.num_rows
+
+        return sum(
+            ray_tpu.get(
+                [count_block.remote(r) for r in self._execute()], timeout=600
+            )
+        )
+
+    def num_blocks(self) -> int:
+        return len(self._execute())
+
+    def schema(self):
+        for ref in self._execute():
+            b = ray_tpu.get(ref, timeout=600)
+            if b.num_rows or b.column_names:
+                return b.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def take(self, n: int = 20) -> List[dict]:
+        rows: List[dict] = []
+        for ref in self._execute():
+            b = ray_tpu.get(ref, timeout=600)
+            for r in BlockAccessor(b).iter_rows():
+                rows.append(r)
+                if len(rows) >= n:
+                    return rows
+        return rows
+
+    def take_all(self) -> List[dict]:
+        return [
+            r
+            for b in self._blocks()
+            for r in BlockAccessor(b).iter_rows()
+        ]
+
+    def show(self, n: int = 20) -> None:
+        for r in self.take(n):
+            print(r)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for ref in self._execute():
+            b = ray_tpu.get(ref, timeout=600)
+            yield from BlockAccessor(b).iter_rows()
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        """Stream batches, re-chunking across block boundaries."""
+        carry: Optional[Block] = None
+        for ref in self._execute():
+            b = ray_tpu.get(ref, timeout=600)
+            if carry is not None and carry.num_rows:
+                b = concat_blocks([carry, b])
+                carry = None
+            if batch_size is None:
+                if b.num_rows:
+                    yield _from_block(b, batch_format)
+                continue
+            off = 0
+            while b.num_rows - off >= batch_size:
+                yield _from_block(
+                    b.slice(off, batch_size), batch_format
+                )
+                off += batch_size
+            if off < b.num_rows:
+                carry = b.slice(off)
+        if carry is not None and carry.num_rows and not drop_last:
+            yield _from_block(carry, batch_format)
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        sharding=None,
+        drop_last: bool = True,
+        dtypes: Optional[Dict[str, Any]] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as device arrays, optionally placed onto a mesh sharding.
+
+        The TPU ingest path: host Arrow blocks → numpy → jax.device_put
+        (with a NamedSharding this feeds an SPMD step directly).  TPU
+        wants static shapes, so drop_last defaults True.
+        """
+        import jax
+
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy", drop_last=drop_last
+        ):
+            if dtypes:
+                batch = {
+                    k: v.astype(dtypes[k]) if k in dtypes else v
+                    for k, v in batch.items()
+                }
+            if sharding is not None:
+                yield {
+                    k: jax.device_put(v, sharding) for k, v in batch.items()
+                }
+            else:
+                yield {k: jax.device_put(v) for k, v in batch.items()}
+
+    def to_pandas(self):
+        return concat_blocks(self._blocks()).to_pandas()
+
+    # -- stats / misc ----------------------------------------------------
+    def sum(self, col: str):
+        return self._agg(col, "sum")
+
+    def min(self, col: str):
+        return self._agg(col, "min")
+
+    def max(self, col: str):
+        return self._agg(col, "max")
+
+    def mean(self, col: str):
+        import pyarrow.compute as pc
+
+        total, count = 0.0, 0
+        for b in self._blocks():
+            if b.num_rows:
+                total += pc.sum(b.column(col)).as_py() or 0
+                count += b.num_rows
+        return total / count if count else None
+
+    def _agg(self, col: str, kind: str):
+        import pyarrow.compute as pc
+
+        vals = []
+        for b in self._blocks():
+            if b.num_rows:
+                vals.append(getattr(pc, kind)(b.column(col)).as_py())
+        if not vals:
+            return None
+        return getattr(builtins, kind)(vals)
+
+    def __repr__(self):
+        return (
+            f"Dataset(num_blocks={len(self._input_refs)}, "
+            f"pending_ops={len(self._ops)})"
+        )
+
+
+class GroupedData:
+    """Hash-partitioned groupby (ray: data/grouped_data.py analogue)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _aggregate(self, aggs: Dict[str, str]) -> Dataset:
+        """aggs: {column: 'sum'|'mean'|'min'|'max'|'count'}"""
+        key = self._key
+        whole = concat_blocks(self._ds._blocks())
+        tbl = whole.group_by(key).aggregate(
+            [(c, k) for c, k in aggs.items()]
+        )
+        return Dataset([ray_tpu.put(tbl)])
+
+    def sum(self, col: str) -> Dataset:
+        return self._aggregate({col: "sum"})
+
+    def mean(self, col: str) -> Dataset:
+        return self._aggregate({col: "mean"})
+
+    def min(self, col: str) -> Dataset:
+        return self._aggregate({col: "min"})
+
+    def max(self, col: str) -> Dataset:
+        return self._aggregate({col: "max"})
+
+    def count(self) -> Dataset:
+        key = self._key
+        whole = concat_blocks(self._ds._blocks())
+        tbl = whole.group_by(key).aggregate([(key, "count")])
+        return Dataset([ray_tpu.put(tbl)])

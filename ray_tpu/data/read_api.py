@@ -1,0 +1,204 @@
+"""Dataset creation: in-memory sources and file IO.
+
+Role-equivalent of ray: python/ray/data/read_api.py + datasource/.
+Reads are parallelized per file / per range-slice into remote tasks
+producing Arrow blocks.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as block_mod
+from ray_tpu.data.dataset import Dataset
+
+DEFAULT_BLOCKS = 8
+
+
+# -- in-memory sources -----------------------------------------------------
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    import builtins
+
+    nb = min(override_num_blocks or DEFAULT_BLOCKS, max(1, n))
+    step = (n + nb - 1) // nb
+
+    @ray_tpu.remote
+    def make(lo, hi):
+        return block_mod.from_numpy({"id": np.arange(lo, hi, dtype=np.int64)})
+
+    refs = [
+        make.remote(i * step, min((i + 1) * step, n))
+        for i in builtins.range(nb)
+        if i * step < n
+    ]
+    return Dataset(refs)
+
+
+def from_items(
+    items: List[Any], *, override_num_blocks: Optional[int] = None
+) -> Dataset:
+    import builtins
+
+    rows = [
+        it if isinstance(it, dict) else {"item": it} for it in items
+    ]
+    nb = min(override_num_blocks or DEFAULT_BLOCKS, max(1, len(rows)))
+    step = (len(rows) + nb - 1) // nb
+    refs = []
+    for i in builtins.range(nb):
+        chunk = rows[i * step : (i + 1) * step]
+        if chunk:
+            refs.append(ray_tpu.put(block_mod.from_rows(chunk)))
+    return Dataset(refs)
+
+
+def from_numpy(arrays: Dict[str, np.ndarray]) -> Dataset:
+    return Dataset([ray_tpu.put(block_mod.from_numpy(arrays))])
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset([ray_tpu.put(block_mod.from_pandas(df))])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([ray_tpu.put(table)])
+
+
+# -- file sources ----------------------------------------------------------
+
+
+def _expand_paths(paths, suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                sorted(glob_mod.glob(os.path.join(p, f"*{suffix}")))
+            )
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths!r}")
+    return out
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+
+    @ray_tpu.remote
+    def read_one(path):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+
+    return Dataset([read_one.remote(f) for f in files])
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    @ray_tpu.remote
+    def read_one(path):
+        import pyarrow.csv as pcsv
+
+        return pcsv.read_csv(path)
+
+    return Dataset([read_one.remote(f) for f in files])
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    """JSONL files (ray: read_json uses pyarrow.json line-delimited)."""
+    files = _expand_paths(paths, ".jsonl")
+
+    @ray_tpu.remote
+    def read_one(path):
+        import pyarrow.json as pjson
+
+        return pjson.read_json(path)
+
+    return Dataset([read_one.remote(f) for f in files])
+
+
+def read_text(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".txt")
+
+    @ray_tpu.remote
+    def read_one(path):
+        with open(path, "r") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return block_mod.from_rows([{"text": ln} for ln in lines])
+
+    return Dataset([read_one.remote(f) for f in files])
+
+
+def read_numpy(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".npy")
+
+    @ray_tpu.remote
+    def read_one(path):
+        return block_mod.from_numpy({"data": np.load(path)})
+
+    return Dataset([read_one.remote(f) for f in files])
+
+
+def read_binary_files(paths, **kwargs) -> Dataset:
+    files = _expand_paths(paths, "")
+
+    @ray_tpu.remote
+    def read_one(path):
+        with open(path, "rb") as f:
+            data = f.read()
+        return block_mod.from_rows([{"bytes": data, "path": path}])
+
+    return Dataset([read_one.remote(f) for f in files])
+
+
+# -- writers (attached to Dataset) ----------------------------------------
+
+
+def _write(ds: Dataset, path: str, fmt: str) -> List[str]:
+    os.makedirs(path, exist_ok=True)
+
+    @ray_tpu.remote
+    def write_one(block, out_path):
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pq.write_table(block, out_path)
+        elif fmt == "csv":
+            import pyarrow.csv as pcsv
+
+            pcsv.write_csv(block, out_path)
+        else:  # jsonl
+            with open(out_path, "w") as f:
+                for row in block.to_pylist():
+                    import json
+
+                    f.write(json.dumps(row) + "\n")
+        return out_path
+
+    suffix = {"parquet": ".parquet", "csv": ".csv", "jsonl": ".jsonl"}[fmt]
+    refs = [
+        write_one.remote(ref, os.path.join(path, f"part-{i:05d}{suffix}"))
+        for i, ref in enumerate(ds._execute())
+    ]
+    return ray_tpu.get(refs, timeout=600)
+
+
+def _install_writers():
+    Dataset.write_parquet = lambda self, path: _write(self, path, "parquet")
+    Dataset.write_csv = lambda self, path: _write(self, path, "csv")
+    Dataset.write_json = lambda self, path: _write(self, path, "jsonl")
+
+
+_install_writers()

@@ -24,13 +24,13 @@ class ASHAScheduler:
     def __init__(
         self,
         metric: str = None,
-        mode: str = "max",
+        mode: str = None,  # None = inherit from TuneConfig (default "max")
         time_attr: str = "training_iteration",
         max_t: int = 100,
         grace_period: int = 1,
         reduction_factor: int = 4,
     ):
-        assert mode in ("min", "max")
+        assert mode in (None, "min", "max")
         self.metric = metric
         self.mode = mode
         self.time_attr = time_attr
@@ -39,6 +39,8 @@ class ASHAScheduler:
         self.rf = reduction_factor
         # rung value -> list of recorded scores (sign-normalized: higher=better)
         self._rungs: Dict[int, List[float]] = {}
+        # trial -> highest rung already evaluated (each rung checked once)
+        self._trial_rung: Dict[str, int] = {}
         rung = grace_period
         self._rung_levels: List[int] = []
         while rung < max_t:
@@ -47,22 +49,25 @@ class ASHAScheduler:
 
     def _score(self, result: dict) -> float:
         v = float(result[self.metric])
-        return v if self.mode == "max" else -v
+        return v if (self.mode or "max") == "max" else -v
 
     def on_trial_result(self, trial_id: str, result: dict) -> str:
         t = int(result.get(self.time_attr, 0))
         if t >= self.max_t:
             return STOP  # budget exhausted (scheduler-complete, not failure)
-        decision = CONTINUE
-        for rung in self._rung_levels:
-            if t != rung:
-                continue
-            scores = self._rungs.setdefault(rung, [])
-            score = self._score(result)
-            scores.append(score)
-            # top 1/rf quantile survives: k = ceil(n / rf)
-            k = max(1, (len(scores) + self.rf - 1) // self.rf)
-            cutoff = sorted(scores, reverse=True)[k - 1]
-            if score < cutoff:
-                decision = STOP
-        return decision
+        # Evaluate at the highest rung <= t not yet checked for this trial:
+        # reports need not land exactly on rung values (reference ASHA
+        # cull-checks at the highest milestone <= t).
+        done_rung = self._trial_rung.get(trial_id, 0)
+        eligible = [r for r in self._rung_levels if done_rung < r <= t]
+        if not eligible:
+            return CONTINUE
+        rung = max(eligible)
+        self._trial_rung[trial_id] = rung
+        scores = self._rungs.setdefault(rung, [])
+        score = self._score(result)
+        scores.append(score)
+        # top 1/rf quantile survives: k = ceil(n / rf)
+        k = max(1, (len(scores) + self.rf - 1) // self.rf)
+        cutoff = sorted(scores, reverse=True)[k - 1]
+        return STOP if score < cutoff else CONTINUE

@@ -65,7 +65,11 @@ class ResultGrid:
             raise ValueError(
                 "no metric: pass metric= here or set TuneConfig.metric"
             )
-        candidates = [r for r in self._results if metric in (r.metrics or {})]
+        candidates = [
+            r
+            for r in self._results
+            if r.error is None and metric in (r.metrics or {})
+        ]
         if not candidates:
             raise ValueError(f"no trial reported metric {metric!r}")
         key = lambda r: r.metrics[metric]  # noqa: E731
@@ -142,7 +146,8 @@ class Tuner:
         exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
         scheduler = tc.scheduler or FIFOScheduler()
         # reference pattern: metric/mode set on TuneConfig propagate into a
-        # scheduler constructed without them (set_search_properties)
+        # scheduler constructed without them (set_search_properties); an
+        # explicit scheduler setting always wins
         if getattr(scheduler, "metric", "") is None:
             if tc.metric is None:
                 raise ValueError(
@@ -150,6 +155,7 @@ class Tuner:
                     "in TuneConfig(metric=...)"
                 )
             scheduler.metric = tc.metric
+        if getattr(scheduler, "mode", "") is None:
             scheduler.mode = tc.mode
         resources = getattr(self.trainable, "__tune_resources__", {"CPU": 1})
         trials = [

@@ -1,0 +1,254 @@
+"""Data library: transforms, shuffles, IO, iteration, jax ingest.
+
+Mirrors the reference's Data test areas (ray: python/ray/data/tests/
+test_map.py, test_consumption.py, test_parquet.py, ...).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestCreation:
+    def test_range(self, cluster):
+        ds = rd.range(100)
+        assert ds.count() == 100
+        assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+
+    def test_from_items(self, cluster):
+        ds = rd.from_items([{"a": i} for i in range(10)])
+        assert ds.count() == 10
+        ds2 = rd.from_items([1, 2, 3])
+        assert ds2.take_all() == [{"item": 1}, {"item": 2}, {"item": 3}]
+
+    def test_from_numpy_tensor(self, cluster):
+        ds = rd.from_numpy({"x": np.ones((6, 4), np.float32)})
+        out = next(ds.iter_batches(batch_size=6))
+        assert out["x"].shape == (6, 4)
+
+    def test_from_pandas(self, cluster):
+        import pandas as pd
+
+        ds = rd.from_pandas(pd.DataFrame({"a": [1, 2], "b": ["x", "y"]}))
+        assert ds.take_all() == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+
+
+class TestTransforms:
+    def test_map_fuses(self, cluster):
+        ds = (
+            rd.range(50)
+            .map(lambda r: {"id": r["id"] * 2})
+            .filter(lambda r: r["id"] % 4 == 0)
+        )
+        vals = [r["id"] for r in ds.take_all()]
+        assert vals == [i * 2 for i in range(50) if (i * 2) % 4 == 0]
+
+    def test_map_batches_numpy(self, cluster):
+        ds = rd.range(20).map_batches(lambda b: {"sq": b["id"] ** 2})
+        assert ds.sum("sq") == sum(i * i for i in range(20))
+
+    def test_map_batches_pyarrow(self, cluster):
+        import pyarrow as pa
+
+        ds = rd.range(10).map_batches(
+            lambda t: t.append_column(
+                "neg", pa.array([-x for x in t.column("id").to_pylist()])
+            ),
+            batch_format="pyarrow",
+        )
+        assert ds.min("neg") == -9
+
+    def test_flat_map(self, cluster):
+        ds = rd.from_items([1, 2]).flat_map(
+            lambda r: [{"v": r["item"]}, {"v": r["item"] * 10}]
+        )
+        assert sorted(x["v"] for x in ds.take_all()) == [1, 2, 10, 20]
+
+    def test_column_ops(self, cluster):
+        ds = (
+            rd.range(5)
+            .add_column("double", lambda t: [x * 2 for x in t.column("id").to_pylist()])
+            .rename_columns({"id": "orig"})
+        )
+        assert set(ds.columns()) == {"orig", "double"}
+        ds2 = ds.drop_columns(["orig"])
+        assert ds2.columns() == ["double"]
+
+
+class TestShuffles:
+    def test_repartition(self, cluster):
+        ds = rd.range(100).repartition(5)
+        assert ds.num_blocks() == 5
+        assert ds.count() == 100
+
+    def test_random_shuffle_permutes(self, cluster):
+        ds = rd.range(1000).random_shuffle(seed=42)
+        ids = [r["id"] for r in ds.take_all()]
+        assert sorted(ids) == list(range(1000))
+        assert ids != list(range(1000))
+
+    def test_sort(self, cluster):
+        ds = rd.from_items([{"k": x} for x in [3, 1, 2]]).sort("k")
+        assert [r["k"] for r in ds.take_all()] == [1, 2, 3]
+        dsd = ds.sort("k", descending=True)
+        assert [r["k"] for r in dsd.take_all()] == [3, 2, 1]
+
+    def test_union_split_limit(self, cluster):
+        a, b = rd.range(10), rd.range(5)
+        assert a.union(b).count() == 15
+        parts = rd.range(100).split(4)
+        assert sum(p.count() for p in parts) == 100
+        assert rd.range(100).limit(7).count() == 7
+
+    def test_groupby(self, cluster):
+        ds = rd.from_items(
+            [{"g": i % 3, "v": i} for i in range(30)]
+        )
+        out = {r["g"]: r["v_sum"] for r in ds.groupby("g").sum("v").take_all()}
+        expect = {}
+        for i in range(30):
+            expect[i % 3] = expect.get(i % 3, 0) + i
+        assert out == expect
+
+
+class TestConsumption:
+    def test_iter_batches_rechunks(self, cluster):
+        ds = rd.range(100, override_num_blocks=7)
+        sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+        assert sizes == [32, 32, 32, 4]
+        sizes = [
+            len(b["id"])
+            for b in ds.iter_batches(batch_size=32, drop_last=True)
+        ]
+        assert sizes == [32, 32, 32]
+
+    def test_iter_jax_batches(self, cluster):
+        import jax.numpy as jnp
+
+        ds = rd.range(64).map_batches(
+            lambda b: {"x": b["id"].astype(np.float32)}
+        )
+        batches = list(ds.iter_jax_batches(batch_size=16))
+        assert len(batches) == 4
+        assert batches[0]["x"].dtype == jnp.float32
+        assert batches[0]["x"].shape == (16,)
+
+    def test_aggregations(self, cluster):
+        ds = rd.range(10)
+        assert ds.sum("id") == 45
+        assert ds.min("id") == 0
+        assert ds.max("id") == 9
+        assert ds.mean("id") == 4.5
+
+    def test_schema(self, cluster):
+        s = rd.range(5).schema()
+        assert s.names == ["id"]
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, cluster, tmp_path):
+        ds = rd.range(100, override_num_blocks=3)
+        ds.write_parquet(str(tmp_path / "pq"))
+        back = rd.read_parquet(str(tmp_path / "pq"))
+        assert back.count() == 100
+        assert back.sum("id") == 4950
+
+    def test_csv_roundtrip(self, cluster, tmp_path):
+        ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+        ds.write_csv(str(tmp_path / "csv"))
+        back = rd.read_csv(str(tmp_path / "csv"))
+        assert back.count() == 2
+
+    def test_json_roundtrip(self, cluster, tmp_path):
+        ds = rd.from_items([{"a": i} for i in range(10)])
+        ds.write_json(str(tmp_path / "js"))
+        back = rd.read_json(str(tmp_path / "js"))
+        assert back.sum("a") == 45
+
+    def test_read_text(self, cluster, tmp_path):
+        p = tmp_path / "f.txt"
+        p.write_text("hello\nworld\n")
+        ds = rd.read_text(str(p))
+        assert ds.take_all() == [{"text": "hello"}, {"text": "world"}]
+
+
+class TestTrainIngest:
+    def test_dataset_to_trainer(self, cluster, tmp_path):
+        """Dataset → split per worker → iter_jax_batches inside train loop."""
+        from ray_tpu import train
+        from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+        def loop(config):
+            import ray_tpu  # noqa: F401  (already connected in worker)
+            from ray_tpu import data as rd
+
+            ds = rd.range(64).map_batches(
+                lambda b: {"x": b["id"].astype(np.float32)}
+            )
+            total = 0.0
+            for batch in ds.iter_jax_batches(batch_size=16):
+                total += float(batch["x"].sum())
+            train.report({"total": total})
+
+        r = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1, cpus_per_worker=1),
+            run_config=RunConfig(name="ingest", storage_path=str(tmp_path)),
+        ).fit()
+        assert r.error is None
+        assert r.metrics["total"] == float(sum(range(64)))
+
+
+class TestReviewRegressions:
+    def test_tensor_shape_roundtrip(self, cluster):
+        arr = np.arange(10 * 4 * 4 * 3, dtype=np.float32).reshape(10, 4, 4, 3)
+        ds = rd.from_numpy({"img": arr})
+        batch = next(ds.iter_batches(batch_size=5))
+        assert batch["img"].shape == (5, 4, 4, 3)
+        np.testing.assert_array_equal(batch["img"], arr[:5])
+
+    def test_tensor_shape_through_map(self, cluster):
+        arr = np.ones((8, 2, 3), np.float32)
+        ds = rd.from_numpy({"x": arr}).map_batches(lambda b: {"y": b["x"] * 2})
+        out = next(ds.iter_batches(batch_size=8))
+        assert out["y"].shape == (8, 2, 3)
+
+    def test_asha_off_rung_reports_still_culled(self):
+        from ray_tpu.tune.schedulers import CONTINUE, STOP
+        from ray_tpu.tune import ASHAScheduler
+
+        asha = ASHAScheduler(
+            metric="m", mode="max", max_t=64, grace_period=1,
+            reduction_factor=4,
+        )
+        # reports at t=5,10 never equal rungs 1,4,16 — highest rung <= t
+        assert asha.on_trial_result("good", {"m": 1.0, "training_iteration": 5}) == CONTINUE
+        assert asha.on_trial_result("bad", {"m": 0.1, "training_iteration": 5}) == STOP
+
+    def test_best_result_excludes_errored(self, cluster, tmp_path):
+        from ray_tpu import tune
+        from ray_tpu.train import RunConfig
+        from ray_tpu.tune import TuneConfig, Tuner
+
+        def objective(config):
+            tune.report({"acc": config["x"]})
+            if config["x"] == 10:
+                raise RuntimeError("crashed after good report")
+
+        grid = Tuner(
+            objective,
+            param_space={"x": tune.grid_search([1, 2, 10])},
+            tune_config=TuneConfig(metric="acc", mode="max"),
+            run_config=RunConfig(name="exclerr", storage_path=str(tmp_path)),
+        ).fit()
+        assert len(grid.errors) == 1
+        assert grid.get_best_result().metrics["acc"] == 2
