@@ -35,8 +35,12 @@ def from_numpy(arrays: Dict[str, np.ndarray]) -> Block:
         else:
             # tensors: fixed-shape lists (ragged unsupported on TPU anyway),
             # with the per-row shape kept in field metadata so to_numpy can
-            # restore ndim>2 tensors exactly
-            flat = v.reshape(len(v), -1)
+            # restore ndim>2 tensors exactly.  Explicit width: reshape(-1)
+            # cannot infer a dimension when the array has zero rows.
+            import math
+
+            width = math.prod(v.shape[1:])
+            flat = v.reshape(len(v), width)
             arr = pa.FixedSizeListArray.from_arrays(
                 pa.array(flat.reshape(-1)), flat.shape[1]
             )

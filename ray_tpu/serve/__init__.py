@@ -1,0 +1,23 @@
+"""ray_tpu.serve: model serving on replica actors.
+
+Role-equivalent of ray: python/ray/serve/.  Controller reconciles
+deployments to replica actors; handles route via power-of-two-choices;
+an aiohttp proxy exposes HTTP.
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.deployment import (  # noqa: F401
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    deployment,
+)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401

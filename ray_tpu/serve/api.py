@@ -1,0 +1,136 @@
+"""serve public API: run/delete/status/handles/shutdown.
+
+Role-equivalent of ray: python/ray/serve/api.py (serve.run:545,
+serve.start:66, serve.delete, serve.status).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import (
+    CONTROLLER_NAME,
+    get_or_create_controller,
+)
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+PROXY_NAME = "SERVE_PROXY"
+
+_route_table: Dict[str, tuple] = {}  # route_prefix -> (app, deployment)
+_proxy_handle = None
+
+
+def start(http_port: Optional[int] = None):
+    """Start the serve control plane (controller (+ proxy if port given))."""
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.ping.remote(), timeout=60)
+    if http_port is not None:
+        _get_or_create_proxy(http_port)
+    return controller
+
+
+def _get_or_create_proxy(port: int):
+    global _proxy_handle
+    from ray_tpu.serve.proxy import ProxyActor
+
+    proxy = ProxyActor.options(
+        name=PROXY_NAME, get_if_exists=True, lifetime="detached",
+        num_cpus=0.1,
+    ).remote(port)
+    ray_tpu.get(proxy.start.remote(), timeout=60)
+    _proxy_handle = proxy
+    return proxy
+
+
+def run(
+    target: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = "/",
+    http_port: Optional[int] = None,
+    blocking: bool = False,
+) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its (single) deployment.
+
+    (Model-composition DAGs of multiple deployments bind through handles
+    passed as init args; each deployment is then run separately.)
+    """
+    if isinstance(target, Deployment):
+        target = Application(target)
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects Application (deployment.bind(...))")
+    controller = get_or_create_controller()
+    d = target.deployment
+    ray_tpu.get(
+        controller.deploy_application.remote(name, [d]), timeout=120
+    )
+    if route_prefix is not None:
+        _route_table[route_prefix] = (name, d.name)
+        proxy = (
+            _get_or_create_proxy(http_port)
+            if http_port is not None
+            else _proxy_handle  # proxy started earlier via serve.start
+        )
+        if proxy is not None:
+            ray_tpu.get(
+                proxy.set_routes.remote(dict(_route_table)), timeout=60
+            )
+    return DeploymentHandle(controller, name, d.name)
+
+
+def get_deployment_handle(
+    deployment_name: str, app_name: str = "default"
+) -> DeploymentHandle:
+    return DeploymentHandle(
+        get_or_create_controller(), app_name, deployment_name
+    )
+
+
+def get_app_handle(app_name: str = "default") -> DeploymentHandle:
+    controller = get_or_create_controller()
+    status = ray_tpu.get(controller.get_status.remote(), timeout=30)
+    deployments = list(status.get(app_name, {}))
+    if not deployments:
+        raise ValueError(f"no app named {app_name!r}")
+    return DeploymentHandle(controller, app_name, deployments[0])
+
+
+def delete(name: str):
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+    removed = False
+    for prefix, (app, _d) in list(_route_table.items()):
+        if app == name:
+            del _route_table[prefix]
+            removed = True
+    if removed and _proxy_handle is not None:
+        try:
+            ray_tpu.get(
+                _proxy_handle.set_routes.remote(dict(_route_table)),
+                timeout=60,
+            )
+        except Exception:
+            pass
+
+
+def status() -> dict:
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.get_status.remote(), timeout=30)
+
+
+def shutdown():
+    """Tear down all serve actors."""
+    global _proxy_handle
+    from ray_tpu.core.actor import get_actor
+
+    for app in list(status()):
+        delete(app)
+    _proxy_handle = None
+    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+        try:
+            ray_tpu.kill(get_actor(actor_name))
+        except Exception:
+            pass
+    _route_table.clear()

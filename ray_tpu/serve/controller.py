@@ -1,0 +1,270 @@
+"""Serve controller: reconciles deployment specs to replica actors.
+
+Role-equivalent of ray: python/ray/serve/_private/controller.py:86
+(ServeController) + deployment_state.py (DeploymentStateManager:2307) +
+autoscaling_state.py (get_decision_num_replicas:261).  A detached named
+actor: holds app → deployment → replica state; a background reconcile
+THREAD creates/kills replicas to match targets, replaces dead ones, and
+computes autoscaling decisions from replica ongoing-request counts.
+(A thread, not an asyncio task: actor creation and ray_tpu.get are
+blocking calls, which must never run on the worker's io loop.)
+Handles/proxies poll `get_routes` (versioned) instead of the reference's
+long-poll channel — same effect, simpler transport.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List
+
+import ray_tpu
+from ray_tpu.serve.replica import ReplicaActor
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, deployment):
+        self.app_name = app_name
+        self.deployment = deployment
+        self.replicas: List[Any] = []  # ActorHandles
+        self.target = (
+            deployment.autoscaling_config.min_replicas
+            if deployment.autoscaling_config
+            else deployment.num_replicas
+        )
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+
+    @property
+    def name(self) -> str:
+        return self.deployment.name
+
+
+@ray_tpu.remote
+class ServeControllerActor:
+    def __init__(self):
+        self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        self._routes_version = 0
+        self._lock = threading.RLock()
+        # serializes whole reconcile passes (the loop thread and
+        # deploy_application both call _reconcile_once; interleaved passes
+        # would double-create replicas)
+        self._reconcile_mutex = threading.Lock()
+        self._interval = 0.5
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, name="serve-reconcile", daemon=True
+        )
+        self._thread.start()
+
+    # -- deploy API ------------------------------------------------------
+    def deploy_application(self, app_name: str, deployments: list) -> bool:
+        """Deploy/update an app (list of Deployment objects)."""
+        with self._lock:
+            states = self._apps.setdefault(app_name, {})
+            new_names = {d.name for d in deployments}
+            for name in list(states):
+                if name not in new_names:
+                    self._drain(states.pop(name))
+            for d in deployments:
+                existing = states.get(d.name)
+                if existing is not None:
+                    # redeploy: replace spec, restart replicas
+                    self._drain(existing)
+                states[d.name] = _DeploymentState(app_name, d)
+            self._routes_version += 1
+        self._reconcile_once()
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            states = self._apps.pop(app_name, {})
+            for st in states.values():
+                self._drain(st)
+            self._routes_version += 1
+        return True
+
+    def _drain(self, st: _DeploymentState):
+        for r in st.replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        st.replicas = []
+
+    # -- reconcile -------------------------------------------------------
+    def _reconcile_loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._reconcile_once()
+                self._autoscale()
+            except Exception:
+                logger.exception("reconcile failed")
+
+    def _snapshot(self) -> List[_DeploymentState]:
+        with self._lock:
+            return [
+                st
+                for states in self._apps.values()
+                for st in states.values()
+            ]
+
+    def _is_current(self, st: _DeploymentState) -> bool:
+        with self._lock:
+            return self._apps.get(st.app_name, {}).get(st.name) is st
+
+    def _check_health(self, replicas: List[Any]) -> List[Any]:
+        """Batched health probe: errored replicas are dead; replicas that
+        simply haven't answered within the window get the benefit of the
+        doubt (busy, not dead) — one hung replica must not stall
+        reconciliation for everyone (single reconcile thread)."""
+        if not replicas:
+            return []
+        refs = [r.check_health.remote() for r in replicas]
+        ready, _pending = ray_tpu.wait(
+            refs, num_returns=len(refs), timeout=10.0, fetch_local=True
+        )
+        ready_set = set(ready)
+        alive = []
+        for r, ref in zip(replicas, refs):
+            if ref not in ready_set:
+                alive.append(r)  # slow, assumed busy
+                continue
+            try:
+                ray_tpu.get(ref, timeout=1)
+                alive.append(r)
+            except Exception:
+                pass  # dead
+        return alive
+
+    def _reconcile_once(self):
+        with self._reconcile_mutex:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self):
+        changed = False
+        for st in self._snapshot():
+            alive = self._check_health(st.replicas)
+            with self._lock:
+                if not self._is_current(st):
+                    continue  # redeployed/deleted while we probed
+                if st.replicas != alive:
+                    st.replicas = alive
+                    changed = True
+                d = st.deployment
+                to_create = st.target - len(st.replicas)
+                to_remove = len(st.replicas) - st.target
+            for _ in range(max(0, to_create)):
+                opts = dict(d.ray_actor_options)
+                handle = ReplicaActor.options(
+                    num_cpus=opts.get("num_cpus", 0.1),
+                    num_tpus=opts.get("num_tpus"),
+                    resources=opts.get("resources"),
+                    max_restarts=0,
+                ).remote(d.func_or_class, d.init_args, d.init_kwargs, None)
+                with self._lock:
+                    if self._is_current(st):
+                        st.replicas.append(handle)
+                        changed = True
+                        handle = None
+                if handle is not None:
+                    # state was drained while we created: don't leak
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:
+                        pass
+            for _ in range(max(0, to_remove)):
+                with self._lock:
+                    victim = (
+                        st.replicas.pop()
+                        if self._is_current(st) and st.replicas
+                        else None
+                    )
+                if victim is not None:
+                    try:
+                        ray_tpu.kill(victim)
+                    except Exception:
+                        pass
+                    changed = True
+        if changed:
+            with self._lock:
+                self._routes_version += 1
+
+    def _autoscale(self):
+        now = time.monotonic()
+        for st in self._snapshot():
+            asc = st.deployment.autoscaling_config
+            if asc is None or not st.replicas:
+                continue
+            try:
+                lens = ray_tpu.get(
+                    [r.queue_len.remote() for r in st.replicas], timeout=30
+                )
+            except Exception:
+                continue
+            total = float(sum(lens))
+            desired = max(
+                asc.min_replicas,
+                min(
+                    asc.max_replicas,
+                    int(-(-total // asc.target_ongoing_requests)),
+                ),
+            )
+            with self._lock:
+                if desired > st.target:
+                    if now - st.last_scale_up >= asc.upscale_delay_s:
+                        st.target = desired
+                        st.last_scale_up = now
+                elif desired < st.target:
+                    if now - st.last_scale_down >= asc.downscale_delay_s:
+                        st.target = max(desired, asc.min_replicas)
+                        st.last_scale_down = now
+                else:
+                    st.last_scale_up = now
+                    st.last_scale_down = now
+
+    # -- discovery (handles / proxies poll this) -------------------------
+    def get_routes(self) -> dict:
+        with self._lock:
+            out = {}
+            for app_name, states in self._apps.items():
+                out[app_name] = {
+                    name: {
+                        "replicas": list(st.replicas),
+                        "max_ongoing": st.deployment.max_ongoing_requests,
+                    }
+                    for name, st in states.items()
+                }
+            return {"version": self._routes_version, "apps": out}
+
+    def get_status(self) -> dict:
+        with self._lock:
+            return {
+                app_name: {
+                    name: {
+                        "target_replicas": st.target,
+                        "running_replicas": len(st.replicas),
+                    }
+                    for name, st in states.items()
+                }
+                for app_name, states in self._apps.items()
+            }
+
+    def ping(self) -> bool:
+        return True
+
+
+def get_or_create_controller():
+    """The controller is a detached named actor, one per cluster."""
+    handle = ServeControllerActor.options(
+        name=CONTROLLER_NAME,
+        get_if_exists=True,
+        lifetime="detached",
+        num_cpus=0.1,
+    ).remote()
+    return handle

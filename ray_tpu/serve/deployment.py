@@ -1,0 +1,83 @@
+"""Deployment definitions.
+
+Role-equivalent of ray: python/ray/serve/deployment.py:87 (Deployment) and
+the @serve.deployment decorator (serve/api.py:248).  A deployment is a
+replicated callable with scaling policy; `.bind(*args)` produces an
+Application ready for serve.run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """(ray: serve/config.py AutoscalingConfig)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 10.0
+
+
+@dataclasses.dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    init_args: Tuple = ()
+    init_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    num_replicas: int = 1
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    max_ongoing_requests: int = 100
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    user_config: Any = None
+
+    def options(self, **kwargs) -> "Deployment":
+        return dataclasses.replace(self, **kwargs)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(
+            dataclasses.replace(self, init_args=args, init_kwargs=kwargs)
+        )
+
+    def __call__(self, *a, **k):
+        raise RuntimeError(
+            "deployments are not called directly; use serve.run + a handle"
+        )
+
+
+@dataclasses.dataclass
+class Application:
+    deployment: Deployment
+
+
+def deployment(
+    _func_or_class: Optional[Callable] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Optional[int] = None,
+    autoscaling_config: Optional[dict] = None,
+    max_ongoing_requests: int = 100,
+    ray_actor_options: Optional[dict] = None,
+):
+    """@serve.deployment decorator (ray: serve/api.py:248)."""
+
+    def wrap(target) -> Deployment:
+        asc = autoscaling_config
+        if isinstance(asc, dict):
+            asc = AutoscalingConfig(**asc)
+        return Deployment(
+            func_or_class=target,
+            name=name or target.__name__,
+            num_replicas=num_replicas or 1,
+            autoscaling_config=asc,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options or {},
+        )
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap

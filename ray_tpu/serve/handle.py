@@ -1,0 +1,175 @@
+"""Deployment handles and the replica router.
+
+Role-equivalent of ray: python/ray/serve/handle.py:711 (DeploymentHandle)
++ serve/_private/replica_scheduler/pow_2_scheduler.py:49.  The router
+keeps a cached replica list (refreshed from the controller on a version
+poll) and picks per request by power-of-two-choices over its own
+in-flight counts — two random replicas, route to the lighter one.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+ROUTE_REFRESH_S = 1.0
+
+
+class Router:
+    def __init__(self, controller, app_name: str, deployment_name: str):
+        self._controller = controller
+        self._app = app_name
+        self._deployment = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._inflight: Dict[Any, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < ROUTE_REFRESH_S:
+            return
+        self._last_refresh = now
+        routes = ray_tpu.get(
+            self._controller.get_routes.remote(), timeout=30
+        )
+        entry = routes["apps"].get(self._app, {}).get(self._deployment)
+        if entry is None:
+            raise RuntimeError(
+                f"deployment {self._deployment!r} not found in app "
+                f"{self._app!r}"
+            )
+        with self._lock:
+            self._version = routes["version"]
+            self._replicas = entry["replicas"]
+            self._inflight = {
+                r: self._inflight.get(r, 0) for r in self._replicas
+            }
+
+    def pick(self):
+        """Pow-2 choices over local in-flight counts."""
+        self._refresh()
+        deadline = time.monotonic() + 30
+        while True:
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for {self._deployment!r} after 30s"
+                )
+            time.sleep(0.1)
+            self._refresh(force=True)
+        with self._lock:
+            if len(replicas) == 1:
+                chosen = replicas[0]
+            else:
+                a, b = random.sample(replicas, 2)
+                chosen = (
+                    a if self._inflight.get(a, 0) <= self._inflight.get(b, 0)
+                    else b
+                )
+            self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
+        return chosen
+
+    def done(self, replica):
+        with self._lock:
+            if replica in self._inflight:
+                self._inflight[replica] = max(
+                    0, self._inflight[replica] - 1
+                )
+
+    def drop(self, replica):
+        """Replica died mid-call: drop it until the next refresh."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r != replica]
+            self._inflight.pop(replica, None)
+        self._last_refresh = 0.0
+
+
+class DeploymentResponse:
+    """Lazy result of a handle call (ray: serve DeploymentResponse).
+
+    Replica death surfaces at result-fetch time (actor errors are stored
+    on the ref, not raised by .remote()), so failover lives HERE: on
+    ActorDiedError the router drops the replica and the request is
+    re-dispatched to another one.
+    """
+
+    def __init__(self, router: Router, replica, ref, redispatch, attempts=3):
+        self._router = router
+        self._replica = replica
+        self._ref = ref
+        self._redispatch = redispatch  # () -> (replica, ref)
+        self._attempts = attempts
+        self._done = False
+
+    def result(self, timeout_s: Optional[float] = 60.0):
+        from ray_tpu.core.errors import ActorDiedError, GetTimeoutError
+
+        while True:
+            try:
+                value = ray_tpu.get(self._ref, timeout=timeout_s)
+            except GetTimeoutError:
+                # request still occupies the replica: keep its in-flight
+                # count so pow-2 doesn't pile more load onto it
+                raise
+            except ActorDiedError:
+                self._settle()
+                self._router.drop(self._replica)
+                self._attempts -= 1
+                if self._attempts <= 0:
+                    raise
+                self._replica, self._ref = self._redispatch()
+                continue
+            except Exception:
+                self._settle()
+                raise
+            self._settle()
+            return value
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._router.done(self._replica)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(
+        self,
+        controller,
+        app_name: str,
+        deployment_name: str,
+        method_name: str = "__call__",
+    ):
+        self._controller = controller
+        self._app = app_name
+        self._deployment = deployment_name
+        self._method = method_name
+        self._router = Router(controller, app_name, deployment_name)
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self._controller, self._app, self._deployment, method_name
+        )
+        h._router = self._router  # share routing state
+        return h
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        def dispatch():
+            replica = self._router.pick()
+            ref = replica.handle_request.remote(self._method, args, kwargs)
+            return replica, ref
+
+        replica, ref = dispatch()
+        return DeploymentResponse(self._router, replica, ref, dispatch)

@@ -1,0 +1,121 @@
+"""HTTP proxy: routes external requests to deployment replicas.
+
+Role-equivalent of ray: python/ray/serve/_private/proxy.py:1112
+(ProxyActor, HTTPProxy:748).  An aiohttp server inside an actor: request
+path /<route_prefix>/... selects the app; JSON bodies become kwargs (or
+the raw body is passed under "body"); responses are JSON (dict/list) or
+text/bytes passthrough.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+@ray_tpu.remote
+class ProxyActor:
+    def __init__(self, port: int = 8000):
+        self._port = port
+        self._routes: Dict[str, Any] = {}  # route_prefix -> (app, deployment)
+        self._handles: Dict[str, Any] = {}
+        self._runner = None
+        self._site = None
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, "0.0.0.0", self._port)
+        await self._site.start()
+        return self._port
+
+    async def set_routes(self, routes: Dict[str, tuple]) -> bool:
+        """routes: {route_prefix: (app_name, deployment_name)}"""
+        self._routes = dict(routes)
+        self._handles = {}
+        return True
+
+    def _handle_for(self, prefix: str):
+        from ray_tpu.serve.controller import get_or_create_controller
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        h = self._handles.get(prefix)
+        if h is None:
+            app_name, dep_name = self._routes[prefix]
+            h = DeploymentHandle(
+                get_or_create_controller(), app_name, dep_name
+            )
+            self._handles[prefix] = h
+        return h
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info["tail"]
+        if path == "/-/healthz":
+            return web.Response(text="ok")
+        prefix = None
+        for p in sorted(self._routes, key=len, reverse=True):
+            if path == p or path.startswith(p.rstrip("/") + "/") or p == "/":
+                prefix = p
+                break
+        if prefix is None:
+            return web.Response(status=404, text="no route")
+        kwargs: Dict[str, Any] = {}
+        args = ()
+        body = await request.read()
+        if body:
+            try:
+                parsed = json.loads(body)
+                if isinstance(parsed, dict):
+                    kwargs = parsed
+                else:
+                    args = (parsed,)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                args = (body,)
+        elif request.query:
+            kwargs = dict(request.query)
+        try:
+            import asyncio
+
+            logger.info("proxy: routing %s via %s", path, prefix)
+
+            # Handle creation and handle.remote() both block (controller
+            # lookup, route refresh via ray_tpu.get) — never on the io
+            # loop; run them on an executor thread.
+            def _route_and_dispatch():
+                handle = self._handle_for(prefix)
+                return handle.remote(*args, **kwargs)
+
+            resp = await asyncio.get_running_loop().run_in_executor(
+                None, _route_and_dispatch
+            )
+            logger.info("proxy: dispatched to replica, awaiting result")
+            from ray_tpu.core.runtime import get_runtime
+
+            rt = get_runtime()
+            try:
+                value = await rt.await_ref(resp._ref)
+            finally:
+                # success or error, the replica is done with this request
+                resp._settle()
+            logger.info("proxy: result ready")
+        except Exception as e:  # noqa: BLE001 — surface as 500
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if isinstance(value, (dict, list)):
+            return web.json_response(value)
+        if isinstance(value, bytes):
+            return web.Response(body=value)
+        return web.Response(text=str(value))
+
+    async def ping(self) -> bool:
+        return True

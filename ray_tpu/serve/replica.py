@@ -1,0 +1,74 @@
+"""Replica actor: hosts one copy of the user callable.
+
+Role-equivalent of ray: python/ray/serve/_private/replica.py:231
+(ReplicaActor, UserCallableWrapper:737).  Requests arrive as actor calls;
+the replica tracks ongoing-request count (the router's pow-2 signal and
+the controller's autoscaling signal).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    def __init__(self, func_or_class, init_args, init_kwargs, method_default):
+        self._is_function = inspect.isfunction(func_or_class) or (
+            callable(func_or_class) and not inspect.isclass(func_or_class)
+        )
+        if inspect.isclass(func_or_class):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = func_or_class
+        self._method_default = method_default
+        self._ongoing = 0
+        self._total = 0
+
+    async def handle_request(self, method: str, args, kwargs) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method or "__call__")
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._ongoing -= 1
+
+    async def queue_len(self) -> int:
+        return self._ongoing
+
+    async def stats(self) -> dict:
+        import os
+
+        return {
+            "ongoing": self._ongoing,
+            "total": self._total,
+            "pid": os.getpid(),
+        }
+
+    async def reconfigure(self, user_config) -> bool:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            out = fn(user_config)
+            if inspect.iscoroutine(out):
+                await out
+        return True
+
+    async def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            out = fn()
+            if inspect.iscoroutine(out):
+                out = await out
+            return bool(out) if out is not None else True
+        return True
