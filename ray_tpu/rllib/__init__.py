@@ -1,0 +1,9 @@
+"""ray_tpu.rllib: reinforcement learning on actor rollouts + jax learners.
+
+Role-equivalent of ray: rllib/ — EnvRunner actors sample vectorized gym
+envs; the learner's whole PPO update is one jit'd jax function.
+"""
+
+from ray_tpu.rllib.core import MLPModuleConfig  # noqa: F401
+from ray_tpu.rllib.env_runner import EnvRunnerGroup  # noqa: F401
+from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae  # noqa: F401

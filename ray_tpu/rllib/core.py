@@ -1,0 +1,62 @@
+"""RLModule: the policy/value network (jax, functional).
+
+Role-equivalent of ray: rllib/core/rl_module/rl_module.py — reduced to
+the functional jax idiom: params in, (logits, value) out, so the same
+module runs CPU inference in env runners and pjit'd training in the
+learner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPModuleConfig:
+    obs_dim: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+def init(rng, config: MLPModuleConfig) -> Params:
+    sizes = (config.obs_dim, *config.hidden)
+    keys = jax.random.split(rng, len(sizes) + 2)
+    params: Params = {"layers": []}
+    for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(keys[i], (din, dout)) * jnp.sqrt(2.0 / din)
+        params["layers"].append({"w": w, "b": jnp.zeros((dout,))})
+    last = sizes[-1]
+    params["pi"] = {
+        "w": jax.random.normal(keys[-2], (last, config.num_actions)) * 0.01,
+        "b": jnp.zeros((config.num_actions,)),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[-1], (last, 1)) * 1.0,
+        "b": jnp.zeros((1,)),
+    }
+    return params
+
+
+def forward(params: Params, obs) -> Tuple[jax.Array, jax.Array]:
+    """obs (B, obs_dim) → (logits (B, A), value (B,))."""
+    x = obs
+    for layer in params["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def sample_actions(params: Params, obs, rng):
+    """Categorical sample + logp + value (env-runner inference path)."""
+    logits, value = forward(params, obs)
+    action = jax.random.categorical(rng, logits)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(logp_all, action[:, None], axis=1)[:, 0]
+    return action, logp, value

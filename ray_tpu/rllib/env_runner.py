@@ -1,0 +1,143 @@
+"""EnvRunner: vectorized gym envs stepping in an actor.
+
+Role-equivalent of ray: rllib/env/single_agent_env_runner.py:40
+(SingleAgentEnvRunner) + env_runner_group.py:66 (EnvRunnerGroup) +
+rollout_ops.py:20 (synchronous_parallel_sample).  CPU actors produce
+fixed-length rollout fragments; policy inference runs jax-on-CPU inside
+the runner (weights synced from the learner each iteration).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class EnvRunnerActor:
+    def __init__(self, env_fn, module_config, num_envs: int, seed: int):
+        import gymnasium as gym
+        import jax
+
+        from ray_tpu.rllib import core
+
+        self._envs = gym.vector.SyncVectorEnv(
+            [self._make_env_fn(env_fn, seed + i) for i in range(num_envs)]
+        )
+        self._num_envs = num_envs
+        self._config = module_config
+        self._params = core.init(jax.random.key(seed), module_config)
+        self._rng = jax.random.key(seed + 10_000)
+        self._sample_fn = jax.jit(core.sample_actions)
+        self._obs, _ = self._envs.reset(seed=seed)
+        # per-env running episode returns for metrics
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._completed: List[float] = []
+
+    @staticmethod
+    def _make_env_fn(env_fn, seed):
+        def make():
+            env = env_fn() if callable(env_fn) else None
+            if env is None:
+                import gymnasium as gym
+
+                env = gym.make(env_fn)
+            return env
+
+        return make
+
+    def set_weights(self, params) -> bool:
+        self._params = params
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect a fragment of num_steps per env; returns flat arrays
+        plus bootstrap values for GAE at the fragment boundary."""
+        import jax
+
+        B, T = self._num_envs, num_steps
+        obs_buf = np.zeros((T, B) + self._obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, B), np.int32)
+        rew_buf = np.zeros((T, B), np.float32)
+        done_buf = np.zeros((T, B), np.float32)
+        logp_buf = np.zeros((T, B), np.float32)
+        val_buf = np.zeros((T, B), np.float32)
+
+        for t in range(T):
+            self._rng, key = jax.random.split(self._rng)
+            action, logp, value = self._sample_fn(
+                self._params, self._obs.astype(np.float32), key
+            )
+            action = np.asarray(action)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self._obs, reward, term, trunc, _ = self._envs.step(action)
+            done = np.logical_or(term, trunc)
+            rew_buf[t] = reward
+            done_buf[t] = done
+            self._ep_return += reward
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+
+        # bootstrap value of the next obs (for the unfinished fragment tail)
+        from ray_tpu.rllib import core
+
+        _, last_val = core.forward(
+            self._params, self._obs.astype(np.float32)
+        )
+        episode_returns = self._completed
+        self._completed = []
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "last_values": np.asarray(last_val, np.float32),
+            "episode_returns": np.asarray(episode_returns, np.float64),
+        }
+
+
+class EnvRunnerGroup:
+    """N rollout actors + synchronous parallel sampling."""
+
+    def __init__(
+        self,
+        env_fn,
+        module_config,
+        num_runners: int = 2,
+        num_envs_per_runner: int = 4,
+        seed: int = 0,
+    ):
+        self.runners = [
+            EnvRunnerActor.options(num_cpus=1).remote(
+                env_fn, module_config, num_envs_per_runner, seed + 1000 * i
+            )
+            for i in range(num_runners)
+        ]
+
+    def sample(self, num_steps: int) -> List[Dict[str, np.ndarray]]:
+        return ray_tpu.get(
+            [r.sample.remote(num_steps) for r in self.runners], timeout=600
+        )
+
+    def sync_weights(self, params) -> None:
+        ref = ray_tpu.put(params)  # one copy in the store, N borrowers
+        ray_tpu.get(
+            [r.set_weights.remote(ref) for r in self.runners], timeout=120
+        )
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.runners = []

@@ -1,0 +1,151 @@
+"""RLlib: GAE math, learner update, end-to-end PPO learning on CartPole.
+
+Mirrors the reference's RLlib test areas (ray: rllib/algorithms/ppo/tests/
+test_ppo.py, rllib/tuned_examples/ learning-regression style).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig, compute_gae
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestGAE:
+    def test_single_step_terminal(self):
+        # one step, episode ends: advantage = r - v
+        adv, ret = compute_gae(
+            rewards=np.array([[1.0]], np.float32),
+            values=np.array([[0.4]], np.float32),
+            dones=np.array([[1.0]], np.float32),
+            last_values=np.array([9.9], np.float32),  # ignored: done
+            gamma=0.99,
+            lambda_=0.95,
+        )
+        assert np.allclose(adv, [[0.6]])
+        assert np.allclose(ret, [[1.0]])
+
+    def test_bootstrap_on_truncation(self):
+        # no dones: the fragment tail bootstraps from last_values
+        adv, ret = compute_gae(
+            rewards=np.zeros((2, 1), np.float32),
+            values=np.zeros((2, 1), np.float32),
+            dones=np.zeros((2, 1), np.float32),
+            last_values=np.array([1.0], np.float32),
+            gamma=1.0,
+            lambda_=1.0,
+        )
+        # delta_t1 = 0 + 1*1 - 0 = 1; adv_t0 = 0 + 1*1*1 = 1 (+delta_t0=0)
+        assert np.allclose(adv, [[1.0], [1.0]])
+
+    def test_discounting(self):
+        adv, _ = compute_gae(
+            rewards=np.array([[1.0], [1.0]], np.float32),
+            values=np.zeros((2, 1), np.float32),
+            dones=np.array([[0.0], [1.0]], np.float32),
+            last_values=np.zeros(1, np.float32),
+            gamma=0.5,
+            lambda_=1.0,
+        )
+        # t1: delta=1; t0: delta=1 + 0.5*0 ... adv_t0 = 1 + 0.5*1 = 1.5
+        assert np.allclose(adv, [[1.5], [1.0]])
+
+
+class TestLearner:
+    def test_update_improves_objective(self):
+        from ray_tpu.rllib import MLPModuleConfig, PPOLearner
+
+        cfg = PPOConfig(lr=1e-2, num_epochs=4, minibatch_size=64)
+        learner = PPOLearner(cfg, MLPModuleConfig(obs_dim=4, num_actions=2))
+        rng = np.random.default_rng(0)
+        n = 256
+        obs = rng.normal(size=(n, 4)).astype(np.float32)
+        # synthetic: action 1 advantaged when obs[0] > 0
+        actions = (obs[:, 0] > 0).astype(np.int32)
+        batch = {
+            "obs": obs,
+            "actions": actions,
+            "logp": np.full(n, -0.693, np.float32),  # uniform prior
+            "advantages": np.ones(n, np.float32),
+            "returns": np.zeros(n, np.float32),
+        }
+        m1 = learner.update(batch)
+        for _ in range(10):
+            m2 = learner.update(batch)
+        # policy loss should drop as the policy aligns with the advantages
+        assert m2["policy_loss"] < m1["policy_loss"]
+
+    def test_weight_sync_roundtrip(self, cluster):
+        from ray_tpu.rllib import MLPModuleConfig
+        from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+        mc = MLPModuleConfig(obs_dim=4, num_actions=2)
+        group = EnvRunnerGroup("CartPole-v1", mc, num_runners=1,
+                              num_envs_per_runner=2, seed=3)
+        import jax
+
+        from ray_tpu.rllib import core
+
+        params = jax.tree.map(
+            np.asarray, core.init(jax.random.key(7), mc)
+        )
+        group.sync_weights(params)
+        frag = group.sample(8)[0]
+        assert frag["obs"].shape == (8, 2, 4)
+        assert frag["actions"].shape == (8, 2)
+        group.stop()
+
+
+class TestPPOEndToEnd:
+    def test_cartpole_learns(self, cluster):
+        config = (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=64)
+            .training(lr=3e-3, num_epochs=6, minibatch_size=256,
+                      entropy_coeff=0.01)
+        )
+        algo = config.build()
+        first_return = None
+        best = -np.inf
+        for i in range(15):
+            result = algo.train()
+            r = result["episode_return_mean"]
+            if first_return is None and not np.isnan(r):
+                first_return = r
+            if not np.isnan(r):
+                best = max(best, r)
+            if best >= 80:
+                break
+        algo.stop()
+        assert first_return is not None
+        # CartPole random policy averages ~20; PPO must clearly learn
+        assert best >= 80, (first_return, best)
+
+    def test_save_restore(self, cluster, tmp_path):
+        config = (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                         rollout_fragment_length=16)
+        )
+        algo = config.build()
+        algo.train()
+        path = algo.save(str(tmp_path / "ckpt"))
+        it = algo.iteration
+        algo.stop()
+
+        algo2 = config.build()
+        algo2.restore(path)
+        assert algo2.iteration == it
+        result = algo2.train()
+        assert result["training_iteration"] == it + 1
+        algo2.stop()
