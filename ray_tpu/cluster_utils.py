@@ -105,6 +105,7 @@ class Cluster:
 
         deadline = time.monotonic() + timeout
         want = {n.node_id for n in self._nodes}
+        alive: set = set()
         while time.monotonic() < deadline:
             if self._connected:
                 alive = {

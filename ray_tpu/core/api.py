@@ -189,8 +189,11 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     get_runtime().kill_actor(actor._actor_id, no_restart=no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    get_runtime().cancel(ref)
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Cancel the task producing ``ref``: queued tasks are dropped before
+    dispatch; running tasks are interrupted on their worker (ray:
+    worker.py cancel → CoreWorker::CancelTask)."""
+    return get_runtime().cancel(ref)
 
 
 def available_resources() -> dict:

@@ -122,6 +122,10 @@ class ActorEntry:
     detached: bool = False
     death_cause: Optional[str] = None
     num_pending_restart_waiters: int = 0
+    # conn of the creating client while PENDING_CREATION; a PENDING actor
+    # whose creator vanishes can never be reported started — kill it so
+    # callers waiting on the state don't hang forever
+    creator_conn: Any = None
 
 
 @dataclass
@@ -273,6 +277,13 @@ class GcsServer:
         job_id = self._conn_job.pop(conn, None)
         if job_id is not None:
             await self._on_job_finished(job_id)
+        # orphaned creations: a PENDING actor whose creating client is gone
+        # will never receive actor_started — fail it now
+        for actor in list(self.actors.values()):
+            if actor.state == ACTOR_PENDING and actor.creator_conn is conn:
+                await self._kill_actor(
+                    actor, "creating client disconnected", no_restart=True
+                )
         for wid, c in list(self._worker_conns.items()):
             if c is conn:
                 del self._worker_conns[wid]
@@ -839,6 +850,36 @@ class GcsServer:
                 },
                 timeout=cfg.worker_start_timeout_s,
             )
+            # Re-check after the await: _remove_pg may have run while the
+            # raylet was starting the worker, and its lease scan could not
+            # see this in-flight grant — the reference kills all PG
+            # inhabitants on removal, so fail the grant and free the worker.
+            if pg_ref is not None:
+                pg = self.placement_groups[pg_ref[0]]
+                if pg.state == PG_REMOVED or pg.bundle_nodes[pg_ref[1]] != node.node_id:
+                    try:
+                        await node.conn.notify(
+                            "release_worker",
+                            {
+                                "lease_id": lease_id,
+                                "worker_id": reply["worker_id"],
+                                "broken": True,
+                            },
+                        )
+                    except Exception:
+                        pass
+                    # _remove_pg already credited the (post-debit) bundle
+                    # remainder back to the node; refund our demand debit
+                    # too, or the node leaks capacity permanently
+                    if pg.state == PG_REMOVED and node.alive:
+                        node.resources_available = (
+                            node.resources_available.add(demand)
+                        )
+                        self._kick_pending()
+                    raise rpc.RpcError(
+                        "placement group was removed while the lease was "
+                        "being granted"
+                    )
         except Exception:
             if pg_ref is not None:
                 pg = self.placement_groups[pg_ref[0]]
@@ -976,6 +1017,7 @@ class GcsServer:
             resources=p["resources"],
             scheduling=p.get("strategy", {}),
             detached=p.get("detached", False),
+            creator_conn=conn,
         )
         self.actors[actor_id] = entry
         return {"existing": False, "actor_id": actor_id.binary()}
@@ -1138,6 +1180,8 @@ class GcsServer:
                 await asyncio.sleep(0.02)
             if worker_conn is None:
                 raise rpc.RpcError("restarted worker never registered with GCS")
+            # No fixed deadline on __init__ replay — liveness comes from the
+            # worker: its death breaks the duplex conn and fails this call.
             await worker_conn.call(
                 "create_actor",
                 {
@@ -1145,7 +1189,7 @@ class GcsServer:
                     "creation_spec": actor.creation_spec,
                     "accelerator_env": grant.get("accelerator_env", {}),
                 },
-                timeout=cfg.worker_start_timeout_s,
+                timeout=-1,
             )
             actor.state = ACTOR_ALIVE
             actor.worker_addr = grant["worker_addr"]

@@ -80,6 +80,26 @@ class PendingTask:
     spec: dict
     return_ids: List[bytes]
     retries_left: int
+    sub_idx: int = 0  # per-actor submission order (client-side)
+
+
+@dataclass
+class ActorClientState:
+    """Client half of ordered actor-call transport (reference analogue:
+    CoreWorkerDirectActorTaskSubmitter, direct_actor_task_submitter.h:74).
+
+    Wire sequence numbers are assigned per CONNECTION EPOCH: each
+    (re)connect bumps `epoch` and restarts `wire_seq` at 0, and unacked
+    calls are re-pushed in original submission order — so the server can
+    enforce exact per-caller ordering even across reconnects/restarts."""
+
+    queue: Any = None  # deque[PendingTask] in submission order
+    inflight: Dict[int, PendingTask] = field(default_factory=dict)  # sub_idx→task
+    epoch: int = -1  # bumped to 0 on first connect
+    wire_seq: int = 0
+    conn: Any = None
+    wake: Any = None  # asyncio.Event
+    pump_running: bool = False
 
 
 class SchedClassState:
@@ -140,6 +160,12 @@ class Runtime:
         self._actor_conns: Dict[bytes, rpc.Connection] = {}
         self._actor_addrs: Dict[bytes, str] = {}
         self._actor_seq: Dict[bytes, int] = {}
+        self._actor_states: Dict[bytes, ActorClientState] = {}
+
+        # in-flight dispatch registry for cancellation: first return oid ->
+        # (task_id, conn carrying the running call)
+        self._inflight_dispatch: Dict[bytes, tuple] = {}
+        self._cancel_requested: set = set()  # oids cancelled pre-enqueue
 
         # function cache (worker side)
         self._fn_cache: Dict[bytes, Any] = {}
@@ -616,7 +642,18 @@ class Runtime:
                 return value.exc
         return None
 
+    def _consume_cancel_flag(self, task: PendingTask) -> bool:
+        """True (and fails the task) if cancel() flagged it pre-dispatch."""
+        if any(oid in self._cancel_requested for oid in task.return_ids):
+            for oid in task.return_ids:
+                self._cancel_requested.discard(oid)
+            self._fail_task(task, TaskCancelledError(task.return_ids[0].hex()))
+            return True
+        return False
+
     def _enqueue_task(self, class_key, pending: PendingTask, resources, strategy):
+        if self._consume_cancel_flag(pending):
+            return
         st = self._classes.get(class_key)
         if st is None:
             st = self._classes[class_key] = SchedClassState()
@@ -703,6 +740,13 @@ class Runtime:
     async def _dispatch(self, class_key, lease: Lease, task: PendingTask,
                         resources, strategy):
         st = self._classes[class_key]
+        if self._consume_cancel_flag(task):  # cancelled in the pop→push window
+            lease.inflight -= 1
+            self._pump_class(class_key, resources, strategy)
+            return
+        self._inflight_dispatch[task.return_ids[0]] = (
+            task.spec["task_id"], lease.conn,
+        )
         try:
             reply = await lease.conn.call("push_task", task.spec, timeout=-1)
             self._apply_task_reply(task, reply)
@@ -719,6 +763,7 @@ class Runtime:
                     ),
                 )
         finally:
+            self._inflight_dispatch.pop(task.return_ids[0], None)
             lease.inflight -= 1
             if lease.broken:
                 if lease in st.leases:
@@ -774,12 +819,14 @@ class Runtime:
                         self._shared.add(oid)
             else:  # stored in shm on the producing node
                 pass  # resolvable via store/pull path
+            self._cancel_requested.discard(oid)
             fut = self.result_futures.pop(oid, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
 
     def _fail_task(self, task: PendingTask, exc: Exception):
         for oid in task.return_ids:
+            self._cancel_requested.discard(oid)
             self.memory_store[oid] = _RaiseOnGet(exc)
             fut = self.result_futures.pop(oid, None)
             if fut is not None and not fut.done():
@@ -835,23 +882,40 @@ class Runtime:
 
     async def _create_actor_async(self, actor_id, creation_spec, resources, strategy):
         try:
-            grant = await self.gcs.call(
-                "request_lease",
-                {
-                    "resources": resources,
-                    "strategy": strategy,
-                    "actor_id": actor_id.binary(),
-                },
-                timeout=cfg.sched_max_pending_lease_s + cfg.worker_start_timeout_s,
-            )
+            while True:
+                try:
+                    grant = await self.gcs.call(
+                        "request_lease",
+                        {
+                            "resources": resources,
+                            "strategy": strategy,
+                            "actor_id": actor_id.binary(),
+                        },
+                        timeout=cfg.sched_max_pending_lease_s
+                        + cfg.worker_start_timeout_s,
+                    )
+                    break
+                except rpc.RemoteCallError as e:
+                    # capacity-pending: keep waiting — an actor whose demand
+                    # is feasible must eventually place (infeasible demands
+                    # error immediately at the GCS instead)
+                    if "LEASE_PENDING" in str(e.remote_exception):
+                        continue
+                    raise
             conn = await self._connect_worker(grant["worker_addr"])
+            # No wall-clock deadline on __init__: arbitrarily long startup
+            # (jax import, backend init, first compile) is legal as long as
+            # the worker process is alive — its death breaks this TCP
+            # connection, which is the liveness signal (the reference's
+            # analogue: actor creation has no fixed timeout either; failure
+            # is detected via worker death, gcs_actor_manager.cc).
             await conn.call(
                 "create_actor",
                 {
                     "actor_id": actor_id.binary(),
                     "creation_spec": creation_spec,
                 },
-                timeout=cfg.worker_start_timeout_s,
+                timeout=-1,
             )
             await self.gcs.call(
                 "actor_started",
@@ -873,14 +937,21 @@ class Runtime:
             except Exception:
                 pass
 
-    async def _actor_conn(self, actor_id: bytes, wait: float = 60.0):
+    async def _actor_conn(self, actor_id: bytes):
+        """Connection to the actor's worker, waiting through PENDING/RESTARTING.
+
+        Liveness-based, not deadline-based: an actor may spend minutes in
+        __init__ (jax backend init + first XLA compile routinely exceed any
+        fixed budget).  The GCS is the liveness authority — worker/node death
+        transitions the actor to DEAD (or RESTARTING → replay), so waiting on
+        a non-DEAD state can only block while the creation is genuinely in
+        progress."""
         conn = self._actor_conns.get(actor_id)
         if conn is not None and not conn.closed:
             return conn
-        deadline = time.monotonic() + wait
         while True:
             info = await self.gcs.call(
-                "get_actor", {"actor_id": actor_id, "wait": 5.0}
+                "get_actor", {"actor_id": actor_id, "wait": 5.0}, timeout=-1
             )
             if info is None:
                 raise ActorDiedError(f"actor {actor_id.hex()[:12]} unknown")
@@ -898,11 +969,6 @@ class Runtime:
                 raise ActorDiedError(
                     f"actor {actor_id.hex()[:12]} is dead: {info.get('death_cause')}"
                 )
-            if time.monotonic() > deadline:
-                raise ActorDiedError(
-                    f"actor {actor_id.hex()[:12]} unavailable "
-                    f"(state {info['state']})"
-                )
             await asyncio.sleep(0.1)
 
     def submit_actor_task(
@@ -916,8 +982,8 @@ class Runtime:
     ) -> List[ObjectRef]:
         task_id = TaskID.random()
         aid = actor_id.binary()
-        seq = self._actor_seq.get(aid, 0)
-        self._actor_seq[aid] = seq + 1
+        sub_idx = self._actor_seq.get(aid, 0)
+        self._actor_seq[aid] = sub_idx + 1
         spec = {
             "task_id": task_id.binary(),
             "actor_id": aid,
@@ -925,46 +991,122 @@ class Runtime:
             "args": self._pack_args(args, kwargs),
             "num_returns": num_returns,
             "caller_id": self.worker_id.binary(),
-            "seq": seq,
+            # seq/seq_epoch are assigned at push time by the actor pump
         }
         return_ids = [
             ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
         ]
-        task = PendingTask(spec, return_ids, retries)
+        task = PendingTask(spec, return_ids, retries, sub_idx=sub_idx)
         for oid in return_ids:
             self.result_futures[oid] = asyncio.Future(loop=self._loop)
         self._call_on_loop(self._enqueue_actor_task, task)
         return [ObjectRef(ObjectID(oid)) for oid in return_ids]
 
     def _enqueue_actor_task(self, task: PendingTask):
-        self._loop.create_task(self._dispatch_actor_task(task))
+        from collections import deque
 
-    async def _dispatch_actor_task(self, task: PendingTask):
         aid = task.spec["actor_id"]
+        st = self._actor_states.get(aid)
+        if st is None:
+            st = self._actor_states[aid] = ActorClientState(
+                queue=deque(), wake=asyncio.Event()
+            )
+        st.queue.append(task)
+        st.wake.set()
+        if not st.pump_running:
+            st.pump_running = True
+            self._loop.create_task(self._actor_pump(aid, st))
+
+    async def _actor_pump(self, aid: bytes, st: ActorClientState):
+        """Single pusher per actor: establishes the connection, assigns
+        wire (epoch, seq) pairs in submission order, and re-pushes unacked
+        calls — still in submission order — after a connection loss."""
         while True:
-            try:
-                conn = await self._actor_conn(aid)
-                reply = await conn.call("push_actor_task", task.spec, timeout=-1)
-                self._apply_task_reply(task, reply)
+            while st.queue or st.inflight:
+                if st.conn is None or st.conn.closed:
+                    # requeue unacked calls ahead of fresh ones, in order
+                    if st.inflight:
+                        requeue = []
+                        for k in sorted(st.inflight):
+                            t = st.inflight.pop(k)
+                            if t.retries_left == 0:
+                                self._fail_task(
+                                    t,
+                                    ActorDiedError(
+                                        f"actor {aid.hex()[:12]} died while "
+                                        f"running {t.spec['method']}"
+                                    ),
+                                )
+                                continue
+                            if t.retries_left > 0:
+                                t.retries_left -= 1
+                            requeue.append(t)
+                        st.queue.extendleft(reversed(requeue))
+                    if not st.queue and not st.inflight:
+                        break
+                    self._actor_conns.pop(aid, None)
+                    try:
+                        st.conn = await self._actor_conn(aid)
+                    except ActorDiedError as e:
+                        for t in list(st.queue):
+                            self._fail_task(t, e)
+                        st.queue.clear()
+                        break
+                    st.epoch += 1
+                    st.wire_seq = 0
+                while st.queue:
+                    t = st.queue.popleft()
+                    if self._consume_cancel_flag(t):
+                        continue
+                    t.spec["seq"] = st.wire_seq
+                    t.spec["seq_epoch"] = st.epoch
+                    st.wire_seq += 1
+                    st.inflight[t.sub_idx] = t
+                    self._loop.create_task(
+                        self._push_actor_call(aid, st, st.conn, t)
+                    )
+                st.wake.clear()
+                if st.inflight:
+                    # woken by new submissions, a connection break, or the
+                    # last in-flight reply landing (so the pump can exit)
+                    await st.wake.wait()
+            # idle: exit unless a submission raced the loop exit (no await
+            # between the check and the flag flip — atomic on the io loop)
+            if not st.queue:
+                st.pump_running = False
                 return
-            except ActorDiedError as e:
-                self._fail_task(task, e)
-                return
-            except (rpc.ConnectionLost, OSError):
-                self._actor_conns.pop(aid, None)
-                if task.retries_left != 0:  # -1 = infinite
-                    if task.retries_left > 0:
-                        task.retries_left -= 1
-                    await asyncio.sleep(0.1)
-                    continue
-                self._fail_task(
-                    task,
-                    ActorDiedError(
-                        f"actor {aid.hex()[:12]} died while running "
-                        f"{task.spec['method']}"
-                    ),
-                )
-                return
+
+    async def _push_actor_call(
+        self, aid: bytes, st: ActorClientState, conn, task: PendingTask
+    ):
+        self._inflight_dispatch[task.return_ids[0]] = (
+            task.spec["task_id"], conn,
+        )
+        try:
+            reply = await conn.call("push_actor_task", task.spec, timeout=-1)
+            st.inflight.pop(task.sub_idx, None)
+            if not st.inflight:
+                st.wake.set()  # let an idle pump exit
+            self._apply_task_reply(task, reply)
+        except (rpc.ConnectionLost, OSError):
+            # Leave the task in st.inflight; the pump reconnects and
+            # re-pushes.  Only signal if WE carry the current connection —
+            # a stale coroutine observing an old conn's loss after the pump
+            # already reconnected must not clobber the fresh one.
+            if st.conn is conn:
+                st.conn = None
+                st.wake.set()
+        except rpc.RpcError as e:
+            st.inflight.pop(task.sub_idx, None)
+            if not st.inflight:
+                st.wake.set()
+            self._fail_task(task, TaskError(
+                "ActorCallError", str(e), "", task.spec["method"]
+            ))
+        finally:
+            cur = self._inflight_dispatch.get(task.return_ids[0])
+            if cur is not None and cur[1] is conn:
+                self._inflight_dispatch.pop(task.return_ids[0], None)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._run(
@@ -975,15 +1117,40 @@ class Runtime:
         )
 
     # ---- misc ----------------------------------------------------------
-    def cancel(self, ref: ObjectRef):
-        # Round-1 cancellation: best-effort removal from client-side queues.
-        oid = ref.object_id.binary()
-        for class_key, st in self._classes.items():
+    def cancel(self, ref: ObjectRef) -> bool:
+        """Cancel the task producing ``ref``.
+
+        Queued client-side → removed before dispatch.  Already running →
+        a ``cancel_task`` RPC interrupts the executing thread on the worker
+        (reference: CoreWorker::CancelTask → HandleCancelTask raising
+        TaskCancelledError in the Cython execution wrapper; interruption is
+        best-effort at bytecode boundaries, like the reference)."""
+        return self._run(self._cancel_async(ref.object_id.binary()))
+
+    async def _cancel_async(self, oid: bytes) -> bool:
+        # On the io loop: serialized with enqueue/dispatch, no scan races.
+        for st in self._classes.values():
             for task in list(st.queue):
                 if oid in task.return_ids:
                     st.queue.remove(task)
-                    self._fail_task(task, TaskCancelledError(ref.hex()))
+                    self._fail_task(task, TaskCancelledError(oid.hex()))
                     return True
+        for ast in self._actor_states.values():
+            for task in list(ast.queue):
+                if oid in task.return_ids:
+                    ast.queue.remove(task)
+                    self._fail_task(task, TaskCancelledError(oid.hex()))
+                    return True
+        entry = self._inflight_dispatch.get(oid)
+        if entry is not None:
+            task_id, conn = entry
+            self._spawn(conn.call("cancel_task", {"task_id": task_id}))
+            return True
+        if oid in self.result_futures:
+            # submitted but not yet enqueued (waiting on local deps):
+            # flag it; _enqueue_task drops it on arrival
+            self._cancel_requested.add(oid)
+            return True
         return False
 
     def free(self, refs: List[ObjectRef]):

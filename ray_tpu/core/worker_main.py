@@ -45,6 +45,14 @@ class WorkerServer:
         self._actor_sem: Optional[asyncio.Semaphore] = None
         self._running_task_threads: Dict[bytes, int] = {}  # task_id -> thread id
         self._cancelled: set = set()
+        # Per-caller actor-call ordering state (reference analogue:
+        # ActorSchedulingQueue, core_worker/transport/actor_scheduling_queue.h):
+        # caller_id -> {"next_seq": int admitted so far,
+        #               "waiters": {seq: asyncio.Event},
+        #               "inflight": {task_id: asyncio.Future(reply)},
+        #               "replies": OrderedDict task_id -> reply (retry dedupe)}
+        self._callers: Dict[bytes, dict] = {}
+    _REPLY_CACHE_PER_CALLER = 256
 
     async def start(self):
         await self.server.start()
@@ -91,6 +99,9 @@ class WorkerServer:
 
     def _execute_sync(self, fn, args, kwargs, spec) -> dict:
         tid = spec["task_id"]
+        if tid in self._cancelled:  # cancelled while queued on the executor
+            self._cancelled.discard(tid)
+            return self._error_reply(TaskCancelledError("cancelled"), spec)
         self._running_task_threads[tid] = threading.get_ident()
         try:
             result = fn(*args, **kwargs)
@@ -173,11 +184,18 @@ class WorkerServer:
         return True
 
     async def handle_push_actor_task(self, spec) -> dict:
-        """Execution order: calls arrive FIFO on the caller's TCP connection
-        and sync methods enter a single executor thread in arrival order —
-        together that gives per-caller submission ordering (including
-        head-of-line blocking on ref args, resolved inside the executor).
-        Async methods run concurrently under the semaphore instead."""
+        """Per-caller submission ordering, enforced by sequence number.
+
+        Calls are ADMITTED in `seq` order (buffered while earlier seqs are
+        in flight over a reconnecting transport), then sync methods enter a
+        single executor thread in admission order — which gives per-caller
+        execution order even when a retry races fresh calls on a new TCP
+        connection.  Retries of a task that already ran (or is running) are
+        deduplicated by task_id and answered from the reply cache instead of
+        re-executing — exactly-once against an alive actor (reference:
+        ActorSchedulingQueue sequence numbers + duplicate suppression).
+        Async methods run concurrently under the semaphore (admission order
+        only), like the reference's out-of-order queue for async actors."""
         if self.actor_instance is None:
             return self._error_reply(
                 RuntimeError("actor instance not created on this worker"), spec
@@ -186,29 +204,135 @@ class WorkerServer:
             method = getattr(self.actor_instance, spec["method"])
         except AttributeError as e:
             return self._error_reply(e, spec)
-        if inspect.iscoroutinefunction(method):
-            try:
-                args, kwargs = await self.rt.unpack_args(spec["args"])
-            except Exception as e:
-                return self._error_reply(e, spec)
-            async with self._actor_sem:
+
+        caller = spec.get("caller_id", b"")
+        seq = spec.get("seq")
+        epoch = spec.get("seq_epoch", 0)
+        tid = spec["task_id"]
+        cs = self._callers.get(caller)
+        if cs is None:
+            cs = self._callers[caller] = {
+                "epochs": {},     # epoch -> {"next_seq", "waiters", "dead"}
+                "max_epoch": -1,
+                "inflight": {},   # task_id -> Future(reply)
+                "replies": {},    # task_id -> reply (cross-epoch dedupe)
+            }
+        if seq is not None:
+            if epoch > cs["max_epoch"]:
+                cs["max_epoch"] = epoch
+                # the caller reconnected: abandon ordering state of older
+                # epochs (their unadmitted calls are re-pushed under the
+                # new epoch; parked coroutines must not wait forever)
+                for old in list(cs["epochs"]):
+                    if old < epoch:
+                        es = cs["epochs"].pop(old)
+                        es["dead"] = True
+                        for ev in es["waiters"].values():
+                            ev.set()
+            elif epoch < cs["max_epoch"]:
+                return self._error_reply(
+                    RuntimeError(
+                        f"stale actor call from abandoned connection epoch "
+                        f"{epoch} (current {cs['max_epoch']})"
+                    ),
+                    spec,
+                )
+            es = cs["epochs"].get(epoch)
+            if es is None:
+                es = cs["epochs"][epoch] = {
+                    "next_seq": 0, "waiters": {}, "dead": False,
+                }
+            if seq < es["next_seq"]:
+                # duplicate delivery of an already-admitted seq: answer
+                # from the reply cache (or share the running execution) —
+                # never re-execute
+                if tid in cs["replies"]:
+                    return cs["replies"][tid]
+                fut = cs["inflight"].get(tid)
+                if fut is not None:
+                    return await asyncio.shield(fut)
+                # no record: the reply aged out of the cache — it already
+                # executed; report rather than rerun
+                return self._error_reply(
+                    RuntimeError(
+                        f"duplicate actor call (seq {seq} already executed, "
+                        f"reply no longer cached)"
+                    ),
+                    spec,
+                )
+            while seq > es["next_seq"] and not es["dead"]:
+                # park keyed by OUR seq; the predecessor wakes exactly us
+                ev = es["waiters"].setdefault(seq, asyncio.Event())
+                await ev.wait()
+                ev.clear()
+            if es["dead"]:
+                return self._error_reply(
+                    RuntimeError("connection epoch abandoned mid-wait"), spec
+                )
+            # admit: bump next_seq BEFORE executing so the successor can
+            # queue into the executor right behind us (FIFO thread = order)
+            es["next_seq"] = seq + 1
+            es["waiters"].pop(seq, None)
+            nxt = es["waiters"].get(es["next_seq"])
+            if nxt is not None:
+                nxt.set()
+
+        # Retry dedupe AFTER seq admission: a re-pushed call must still
+        # consume its slot in the new epoch (or its successors would park
+        # forever), but must not re-execute — completed → cached reply;
+        # still running → share its outcome.
+        if tid in cs["replies"]:
+            return cs["replies"][tid]
+        fut = cs["inflight"].get(tid)
+        if fut is not None:
+            return await asyncio.shield(fut)
+
+        reply_fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        cs["inflight"][tid] = reply_fut
+        try:
+            if inspect.iscoroutinefunction(method):
                 try:
-                    result = await method(*args, **kwargs)
-                    return self._exec_pack(spec, result)
+                    args, kwargs = await self.rt.unpack_args(spec["args"])
                 except Exception as e:
-                    return self._error_reply(e, spec)
-        return await asyncio.get_running_loop().run_in_executor(
-            self._exec, self._execute_sync_method, method, spec
-        )
+                    reply = self._error_reply(e, spec)
+                else:
+                    async with self._actor_sem:
+                        try:
+                            result = await method(*args, **kwargs)
+                            reply = self._exec_pack(spec, result)
+                        except Exception as e:
+                            reply = self._error_reply(e, spec)
+            else:
+                reply = await asyncio.get_running_loop().run_in_executor(
+                    self._exec, self._execute_sync_method, method, spec
+                )
+        except BaseException as e:
+            reply = self._error_reply(
+                e if isinstance(e, Exception) else RuntimeError(repr(e)), spec
+            )
+        cs["inflight"].pop(tid, None)
+        cs["replies"][tid] = reply
+        while len(cs["replies"]) > self._REPLY_CACHE_PER_CALLER:
+            cs["replies"].pop(next(iter(cs["replies"])))
+        if not reply_fut.done():
+            reply_fut.set_result(reply)
+        return reply
 
     def _execute_sync_method(self, method, spec) -> dict:
         tid = spec["task_id"]
+        if tid in self._cancelled:
+            self._cancelled.discard(tid)
+            return self._error_reply(TaskCancelledError("cancelled"), spec)
         self._running_task_threads[tid] = threading.get_ident()
         try:
             args, kwargs = self.rt._run(self.rt.unpack_args(spec["args"]))
             result = method(*args, **kwargs)
             return self._exec_pack(spec, result)
+        except TaskCancelledError as e:
+            return self._error_reply(e, spec)
         except BaseException as e:
+            if tid in self._cancelled:
+                return self._error_reply(TaskCancelledError(str(e)), spec)
             return self._error_reply(e, spec)
         finally:
             self._running_task_threads.pop(tid, None)

@@ -124,15 +124,13 @@ class EnvRunnerGroup:
         ]
 
     def sample(self, num_steps: int) -> List[Dict[str, np.ndarray]]:
-        return ray_tpu.get(
-            [r.sample.remote(num_steps) for r in self.runners], timeout=600
-        )
+        # No fixed deadline: the first sample sits behind jax init + compile
+        # in the runner; a dead runner fails the get with ActorDiedError.
+        return ray_tpu.get([r.sample.remote(num_steps) for r in self.runners])
 
     def sync_weights(self, params) -> None:
         ref = ray_tpu.put(params)  # one copy in the store, N borrowers
-        ray_tpu.get(
-            [r.set_weights.remote(ref) for r in self.runners], timeout=120
-        )
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
 
     def stop(self):
         for r in self.runners:

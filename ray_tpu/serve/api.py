@@ -6,7 +6,7 @@ serve.start:66, serve.delete, serve.status).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Optional
 
 import ray_tpu
 from ray_tpu.serve.controller import (
@@ -18,7 +18,9 @@ from ray_tpu.serve.handle import DeploymentHandle
 
 PROXY_NAME = "SERVE_PROXY"
 
-_route_table: Dict[str, tuple] = {}  # route_prefix -> (app, deployment)
+# Route state lives in the controller (versioned get_routes); the proxy
+# polls it.  No driver-local route table — multiple drivers can deploy
+# and delete apps without clobbering each other's routes.
 _proxy_handle = None
 
 
@@ -67,16 +69,12 @@ def run(
         controller.deploy_application.remote(name, [d]), timeout=120
     )
     if route_prefix is not None:
-        _route_table[route_prefix] = (name, d.name)
-        proxy = (
-            _get_or_create_proxy(http_port)
-            if http_port is not None
-            else _proxy_handle  # proxy started earlier via serve.start
+        ray_tpu.get(
+            controller.set_route_prefix.remote(route_prefix, name, d.name),
+            timeout=60,
         )
-        if proxy is not None:
-            ray_tpu.get(
-                proxy.set_routes.remote(dict(_route_table)), timeout=60
-            )
+        if http_port is not None:
+            _get_or_create_proxy(http_port)
     return DeploymentHandle(controller, name, d.name)
 
 
@@ -98,21 +96,10 @@ def get_app_handle(app_name: str = "default") -> DeploymentHandle:
 
 
 def delete(name: str):
+    # delete_application also removes the app's HTTP routes; proxies pick
+    # the change up on their next versioned poll.
     controller = get_or_create_controller()
     ray_tpu.get(controller.delete_application.remote(name), timeout=60)
-    removed = False
-    for prefix, (app, _d) in list(_route_table.items()):
-        if app == name:
-            del _route_table[prefix]
-            removed = True
-    if removed and _proxy_handle is not None:
-        try:
-            ray_tpu.get(
-                _proxy_handle.set_routes.remote(dict(_route_table)),
-                timeout=60,
-            )
-        except Exception:
-            pass
 
 
 def status() -> dict:
@@ -133,4 +120,3 @@ def shutdown():
             ray_tpu.kill(get_actor(actor_name))
         except Exception:
             pass
-    _route_table.clear()

@@ -49,6 +49,11 @@ class _DeploymentState:
 class ServeControllerActor:
     def __init__(self):
         self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        # HTTP route table lives HERE, not in any driver process (the
+        # reference keeps route state in the controller too:
+        # serve/_private/controller.py) — so a second driver or a driver
+        # restart can't clobber routes installed by others.
+        self._http_routes: Dict[str, tuple] = {}  # prefix -> (app, deployment)
         self._routes_version = 0
         self._lock = threading.RLock()
         # serializes whole reconcile passes (the loop thread and
@@ -86,8 +91,26 @@ class ServeControllerActor:
             states = self._apps.pop(app_name, {})
             for st in states.values():
                 self._drain(st)
+            for prefix, (app, _d) in list(self._http_routes.items()):
+                if app == app_name:
+                    del self._http_routes[prefix]
             self._routes_version += 1
         return True
+
+    def set_route_prefix(
+        self, prefix: str, app_name: str, deployment_name: str
+    ) -> bool:
+        with self._lock:
+            self._http_routes[prefix] = (app_name, deployment_name)
+            self._routes_version += 1
+        return True
+
+    def remove_route_prefix(self, prefix: str) -> bool:
+        with self._lock:
+            removed = self._http_routes.pop(prefix, None) is not None
+            if removed:
+                self._routes_version += 1
+        return removed
 
     def _drain(self, st: _DeploymentState):
         for r in st.replicas:
@@ -240,7 +263,11 @@ class ServeControllerActor:
                     }
                     for name, st in states.items()
                 }
-            return {"version": self._routes_version, "apps": out}
+            return {
+                "version": self._routes_version,
+                "apps": out,
+                "http_routes": dict(self._http_routes),
+            }
 
     def get_status(self) -> dict:
         with self._lock:

@@ -134,6 +134,37 @@ class DeploymentResponse:
             self._settle()
             return value
 
+    async def result_async(self):
+        """Async twin of result() with the same replica-death failover —
+        awaits on the io loop instead of blocking a thread (used by the
+        HTTP proxy so slow replicas can't exhaust its executor threads).
+        Redispatch (which blocks on route refresh) runs in an executor."""
+        import asyncio
+
+        from ray_tpu.core.errors import ActorDiedError
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        while True:
+            try:
+                value = await rt.await_ref(self._ref)
+            except ActorDiedError:
+                self._settle()
+                self._router.drop(self._replica)
+                self._attempts -= 1
+                if self._attempts <= 0:
+                    raise
+                loop = asyncio.get_running_loop()
+                self._replica, self._ref = await loop.run_in_executor(
+                    None, self._redispatch
+                )
+                continue
+            except Exception:
+                self._settle()
+                raise
+            self._settle()
+            return value
+
     def _settle(self):
         if not self._done:
             self._done = True

@@ -18,12 +18,18 @@ import ray_tpu
 logger = logging.getLogger(__name__)
 
 
+ROUTE_POLL_S = 1.0
+
+
 @ray_tpu.remote
 class ProxyActor:
     def __init__(self, port: int = 8000):
         self._port = port
         self._routes: Dict[str, Any] = {}  # route_prefix -> (app, deployment)
+        self._routes_version = -1
+        self._last_poll = 0.0
         self._handles: Dict[str, Any] = {}
+        self._controller = None
         self._runner = None
         self._site = None
 
@@ -38,22 +44,38 @@ class ProxyActor:
         await self._site.start()
         return self._port
 
-    async def set_routes(self, routes: Dict[str, tuple]) -> bool:
-        """routes: {route_prefix: (app_name, deployment_name)}"""
-        self._routes = dict(routes)
-        self._handles = {}
-        return True
+    # Route state is owned by the controller (like the reference's
+    # long-poll config push, serve/_private/long_poll.py); the proxy polls
+    # the versioned get_routes instead of accepting driver-pushed
+    # snapshots — so concurrent drivers can't clobber each other's routes.
+    def _poll_routes(self, force: bool = False):
+        import time
+
+        now = time.monotonic()
+        if not force and now - self._last_poll < ROUTE_POLL_S:
+            return
+        self._last_poll = now
+        if self._controller is None:
+            from ray_tpu.serve.controller import get_or_create_controller
+
+            self._controller = get_or_create_controller()
+        routes = ray_tpu.get(self._controller.get_routes.remote(), timeout=30)
+        if routes["version"] != self._routes_version:
+            self._routes_version = routes["version"]
+            new_routes = routes.get("http_routes", {})
+            # drop handles for prefixes that changed target
+            for p, target in list(self._handles.items()):
+                if new_routes.get(p) != self._routes.get(p):
+                    self._handles.pop(p, None)
+            self._routes = dict(new_routes)
 
     def _handle_for(self, prefix: str):
-        from ray_tpu.serve.controller import get_or_create_controller
         from ray_tpu.serve.handle import DeploymentHandle
 
         h = self._handles.get(prefix)
         if h is None:
             app_name, dep_name = self._routes[prefix]
-            h = DeploymentHandle(
-                get_or_create_controller(), app_name, dep_name
-            )
+            h = DeploymentHandle(self._controller, app_name, dep_name)
             self._handles[prefix] = h
         return h
 
@@ -63,13 +85,6 @@ class ProxyActor:
         path = "/" + request.match_info["tail"]
         if path == "/-/healthz":
             return web.Response(text="ok")
-        prefix = None
-        for p in sorted(self._routes, key=len, reverse=True):
-            if path == p or path.startswith(p.rstrip("/") + "/") or p == "/":
-                prefix = p
-                break
-        if prefix is None:
-            return web.Response(status=404, text="no route")
         kwargs: Dict[str, Any] = {}
         args = ()
         body = await request.read()
@@ -87,28 +102,41 @@ class ProxyActor:
         try:
             import asyncio
 
-            logger.info("proxy: routing %s via %s", path, prefix)
+            def _match():
+                for p in sorted(self._routes, key=len, reverse=True):
+                    if (
+                        path == p
+                        or path.startswith(p.rstrip("/") + "/")
+                        or p == "/"
+                    ):
+                        return p
+                return None
 
-            # Handle creation and handle.remote() both block (controller
-            # lookup, route refresh via ray_tpu.get) — never on the io
-            # loop; run them on an executor thread.
+            # Routing + dispatch block (controller poll, route refresh) —
+            # run them on an executor thread.  The (possibly long) replica
+            # wait is awaited on the io loop with failover, so slow
+            # replicas can't exhaust the executor pool.
             def _route_and_dispatch():
+                self._poll_routes()
+                prefix = _match()
+                if prefix is None:
+                    # one forced refresh: the route may have just been added
+                    self._poll_routes(force=True)
+                    prefix = _match()
+                if prefix is None:
+                    return None
                 handle = self._handle_for(prefix)
                 return handle.remote(*args, **kwargs)
 
             resp = await asyncio.get_running_loop().run_in_executor(
                 None, _route_and_dispatch
             )
-            logger.info("proxy: dispatched to replica, awaiting result")
-            from ray_tpu.core.runtime import get_runtime
-
-            rt = get_runtime()
-            try:
-                value = await rt.await_ref(resp._ref)
-            finally:
-                # success or error, the replica is done with this request
-                resp._settle()
-            logger.info("proxy: result ready")
+            if resp is None:
+                return web.Response(status=404, text="no route")
+            # result_async carries the pow-2 router's replica-death
+            # failover — HTTP clients get the same retry semantics as
+            # handle-API callers instead of a bare 500.
+            value = await resp.result_async()
         except Exception as e:  # noqa: BLE001 — surface as 500
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         if isinstance(value, (dict, list)):

@@ -94,24 +94,35 @@ class BackendExecutor:
                 )
             )
         try:
-            ray_tpu.get(starts, timeout=120)
+            ray_tpu.get(starts)
         except (ActorDiedError, TaskError) as e:
             raise TrainWorkerGroupError(f"worker failed to start: {e}") from e
 
-    def next_reports(self, timeout: float = 600.0) -> Optional[List[dict]]:
+    def next_reports(self, poll_s: float = 10.0) -> Optional[List[dict]]:
         """One report from every worker, or None when all loops finished.
 
-        Raises TrainWorkerGroupError if any worker errored or died.
+        Liveness-based: each worker is polled in ``poll_s`` slices with no
+        overall deadline — a loop stuck in its first XLA compile for minutes
+        is healthy, while a dead worker fails the poll call itself with
+        ActorDiedError (raised here as TrainWorkerGroupError).
         """
         wg = self.worker_group
+        reports: List[Optional[dict]] = [None] * len(wg.workers)
+        have: List[bool] = [False] * len(wg.workers)
         try:
-            reports = ray_tpu.get(
-                [
-                    w.actor.next_report.remote(timeout=timeout)
-                    for w in wg.workers
-                ],
-                timeout=timeout + 60,
-            )
+            while not all(have):
+                pend = [i for i in range(len(wg.workers)) if not have[i]]
+                polled = ray_tpu.get(
+                    [
+                        wg.workers[i].actor.next_report.remote(timeout=poll_s)
+                        for i in pend
+                    ]
+                )
+                for i, r in zip(pend, polled):
+                    if isinstance(r, dict) and r.get("pending"):
+                        continue
+                    reports[i] = r
+                    have[i] = True
         except ActorDiedError as e:
             raise TrainWorkerGroupError(f"worker died mid-training: {e}") from e
         except TaskError as e:
@@ -133,7 +144,7 @@ class BackendExecutor:
         wg = self.worker_group
         try:
             return ray_tpu.get(
-                [w.actor.get_result.remote() for w in wg.workers], timeout=600
+                [w.actor.get_result.remote() for w in wg.workers]
             )
         except (ActorDiedError, TaskError, GetTimeoutError) as e:
             raise TrainWorkerGroupError(str(e)) from e

@@ -101,7 +101,15 @@ class TrainSession:
 
     # -- executor-side API ----------------------------------------------
     def next_report(self, timeout: float) -> Optional[dict]:
-        """Next report, or None if the loop finished (raising its error)."""
+        """Next report, None if the loop finished (raising its error), or
+        the sentinel ``{"pending": True}`` if nothing arrived within
+        ``timeout``.
+
+        The sentinel (not an exception) is deliberate: how long a loop may
+        go without reporting is unbounded — the first report sits behind an
+        XLA compile that can take minutes — so the driver polls in short
+        slices and relies on actor liveness (worker death fails the poll
+        call itself) rather than any fixed report deadline."""
         while True:
             try:
                 item = self.reports.get(timeout=min(timeout, 0.2))
@@ -114,7 +122,7 @@ class TrainSession:
                     return None
                 timeout -= 0.2
                 if timeout <= 0:
-                    raise TimeoutError("no report from training loop")
+                    return {"pending": True}
 
 
 def init_session(session: TrainSession) -> None:

@@ -155,9 +155,10 @@ class WorkerGroup:
             ).remote()
             for i in range(num_workers)
         ]
-        infos = ray_tpu.get(
-            [a.node_info.remote() for a in actors], timeout=120
-        )
+        # No wall-clock bound: actor startup length is unbounded under load
+        # and liveness is tracked by the core (a dead worker surfaces as
+        # ActorDiedError on this get).
+        infos = ray_tpu.get([a.node_info.remote() for a in actors])
         # Rank assignment: group workers by node; node_rank by first
         # appearance; worker 0 of node 0 is the SPMD coordinator
         # (reference pattern: TPU-<pod>-head resource, tpu.py:376-397).
@@ -185,10 +186,10 @@ class WorkerGroup:
         return self._pg
 
     def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
-        """Run ``fn`` on every worker, gathered."""
+        """Run ``fn`` on every worker, gathered (no fixed deadline — worker
+        death fails the get; slow jax/XLA init is legal)."""
         return ray_tpu.get(
-            [w.actor.execute.remote(fn, *args, **kwargs) for w in self.workers],
-            timeout=600,
+            [w.actor.execute.remote(fn, *args, **kwargs) for w in self.workers]
         )
 
     def set_envs(self, envs: List[Dict[str, str]]):

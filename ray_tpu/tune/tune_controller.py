@@ -119,7 +119,7 @@ class TuneController:
             for _ in range(min(capacity(), len(pending))):
                 trial = pending.pop(0)
                 self._launch(trial)
-                ref = trial.actor.next_report.remote(timeout=600.0)
+                ref = trial.actor.next_report.remote(timeout=30.0)
                 outstanding[ref] = trial
             if not outstanding:
                 time.sleep(0.05)
@@ -137,6 +137,13 @@ class TuneController:
                 if report is None:  # loop finished cleanly
                     self._finalize(trial, TERMINATED)
                     continue
+                if report.get("pending"):
+                    # nothing reported inside the poll slice (legal: e.g. a
+                    # long compile) — re-poll; trial liveness is carried by
+                    # the actor call itself, not a report deadline
+                    nref = trial.actor.next_report.remote(timeout=30.0)
+                    outstanding[nref] = trial
+                    continue
                 result = report["metrics"]
                 result.setdefault("training_iteration", len(trial.results) + 1)
                 result.setdefault("_timestamp", time.time())
@@ -151,6 +158,6 @@ class TuneController:
                     self._finalize(trial, TERMINATED)
                 else:
                     assert decision == CONTINUE
-                    nref = trial.actor.next_report.remote(timeout=600.0)
+                    nref = trial.actor.next_report.remote(timeout=30.0)
                     outstanding[nref] = trial
         return self.trials
