@@ -94,4 +94,12 @@ D("tpu_topology_override", str, "")
 D("task_max_retries_default", int, 3)
 D("actor_max_restarts_default", int, 0)
 
+# --- data streaming ---
+D("data_streaming_window", int, 8)  # max blocks in production at once
+
+# --- refcounting / lineage ---
+D("ref_flush_interval_s", float, 0.05)  # batch window for holder updates
+D("lineage_reconstruction_max", int, 3)  # re-executions per lost task
+D("gcs_free_delay_s", float, 0.5)  # grace before freeing unreferenced objects
+
 cfg = _Config()

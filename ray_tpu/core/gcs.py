@@ -228,6 +228,14 @@ class GcsServer:
         self.object_locations: Dict[bytes, Set[NodeID]] = {}
         self.object_sizes: Dict[bytes, int] = {}
         self._location_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        # distributed refcounting: object_id -> holder tokens (worker_id
+        # bytes for processes, b"actor:<id>" for actor creation specs).
+        # When a registered object's holder set empties, the object is
+        # freed cluster-wide after a short grace (reference analogue: the
+        # owner releasing its ReferenceCounter entry, reference_count.h:61)
+        self.object_holders: Dict[bytes, Set[bytes]] = {}
+        self.object_edges: Dict[bytes, List[bytes]] = {}  # parent -> children
+        self._free_scheduled: Set[bytes] = set()
         # pubsub: channel -> set of conns
         self.subscribers: Dict[str, Set[rpc.Connection]] = {}
         # conn bookkeeping
@@ -287,6 +295,7 @@ class GcsServer:
         for wid, c in list(self._worker_conns.items()):
             if c is conn:
                 del self._worker_conns[wid]
+                self._scrub_holder(wid.binary())
         for subs in self.subscribers.values():
             subs.discard(conn)
 
@@ -501,16 +510,79 @@ class GcsServer:
 
     async def rpc_free_objects(self, conn, p):
         for oid in p["object_ids"]:
-            locs = self.object_locations.pop(oid, set())
-            self.object_sizes.pop(oid, None)
-            for nid in locs:
-                node = self.nodes.get(nid)
-                if node and node.alive:
-                    try:
-                        await node.conn.notify("delete_objects", {"object_ids": [oid]})
-                    except Exception:
-                        pass
+            await self._free_object(oid)
         return True
+
+    async def _free_object(self, oid: bytes):
+        locs = self.object_locations.pop(oid, set())
+        self.object_sizes.pop(oid, None)
+        self.object_holders.pop(oid, None)
+        for nid in locs:
+            node = self.nodes.get(nid)
+            if node and node.alive:
+                try:
+                    await node.conn.notify(
+                        "delete_objects", {"object_ids": [oid]}
+                    )
+                except Exception:
+                    pass
+        # a freed parent releases its nested (borrowed) children
+        token = b"obj:" + oid
+        for child in self.object_edges.pop(oid, ()):
+            s = self.object_holders.get(child)
+            if s is not None:
+                s.discard(token)
+                if not s:
+                    self._schedule_free(child)
+
+    async def rpc_ref_edge(self, conn, p):
+        """A stored object contains serialized refs to children: pin the
+        children for as long as the parent object exists."""
+        parent = p["parent"]
+        token = b"obj:" + parent
+        kids = self.object_edges.setdefault(parent, [])
+        for child in p.get("children", ()):
+            if child not in kids:
+                kids.append(child)
+                self.object_holders.setdefault(child, set()).add(token)
+        return True
+
+    # ---- distributed refcounting ---------------------------------------
+    async def rpc_ref_update(self, conn, p):
+        holder = p["holder"]
+        for oid in p.get("add", ()):
+            self.object_holders.setdefault(oid, set()).add(holder)
+        for oid in p.get("del", ()):
+            s = self.object_holders.get(oid)
+            if s is not None:
+                s.discard(holder)
+                if not s:
+                    self._schedule_free(oid)
+        return True
+
+    def _schedule_free(self, oid: bytes):
+        """Free after a grace window, re-checking holders — an in-flight
+        ref_add from a borrower that deserialized the ref moments ago must
+        win over a racing release."""
+        if oid in self._free_scheduled:
+            return
+        self._free_scheduled.add(oid)
+
+        def _maybe_free():
+            self._free_scheduled.discard(oid)
+            s = self.object_holders.get(oid)
+            if s is not None and not s:
+                asyncio.get_event_loop().create_task(self._free_object(oid))
+
+        asyncio.get_event_loop().call_later(cfg.gcs_free_delay_s, _maybe_free)
+
+    def _scrub_holder(self, holder: bytes):
+        """A process died: remove it from every holder set."""
+        for oid, s in list(self.object_holders.items()):
+            if holder in s:
+                s.discard(holder)
+                if not s:
+                    self._schedule_free(oid)
 
     # ---- placement groups ----------------------------------------------
     def _bundle_order(self, pg: PlacementGroupEntry, indices: List[int]) -> List[int]:
@@ -1020,7 +1092,23 @@ class GcsServer:
             creator_conn=conn,
         )
         self.actors[actor_id] = entry
+        # pin ref args inside the creation spec for the actor's lifetime:
+        # restart replay must be able to resolve them even after every
+        # client ref died
+        token = b"actor:" + actor_id.binary()
+        for oid in self._spec_ref_oids(entry.creation_spec):
+            self.object_holders.setdefault(oid, set()).add(token)
         return {"existing": False, "actor_id": actor_id.binary()}
+
+    @staticmethod
+    def _spec_ref_oids(creation_spec) -> List[bytes]:
+        out = []
+        for item in (creation_spec or {}).get("args", ()):
+            if item[0] == "ref":
+                out.append(item[1])
+            elif item[0] == "kwref":
+                out.append(item[2])
+        return out
 
     async def rpc_actor_started(self, conn, p):
         """Creator reports the actor's worker is up and __init__ succeeded."""
@@ -1090,6 +1178,13 @@ class GcsServer:
             return
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
+        token = b"actor:" + actor.actor_id.binary()
+        for oid in self._spec_ref_oids(actor.creation_spec):
+            s = self.object_holders.get(oid)
+            if s is not None:
+                s.discard(token)
+                if not s:
+                    self._schedule_free(oid)
         if actor.name:
             self.named_actors.pop((actor.namespace, actor.name), None)
         if actor.worker_addr:
@@ -1210,6 +1305,7 @@ class GcsServer:
         """Raylet reports a worker process exited."""
         wid = WorkerID(p["worker_id"])
         self._worker_conns.pop(wid, None)
+        self._scrub_holder(wid.binary())
         for lease_id, lease in list(self.leases.items()):
             if lease.worker_id == wid:
                 actor_id = lease.actor_id

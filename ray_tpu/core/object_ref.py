@@ -19,6 +19,14 @@ class ObjectRef:
     def __init__(self, object_id: ObjectID, owner_hint: Optional[str] = None):
         self.object_id = object_id
         self._owner_hint = owner_hint  # node hint for locality-aware pulls
+        try:
+            from ray_tpu.core import runtime as _rt
+
+            rt = _rt._global_runtime
+            if rt is not None:
+                rt.on_ref_created(object_id)
+        except Exception:
+            pass
 
     def hex(self) -> str:
         return self.object_id.hex()

@@ -81,6 +81,7 @@ class PendingTask:
     return_ids: List[bytes]
     retries_left: int
     sub_idx: int = 0  # per-actor submission order (client-side)
+    dep_oids: List[bytes] = field(default_factory=list)  # held while in flight
 
 
 @dataclass
@@ -170,8 +171,29 @@ class Runtime:
         # function cache (worker side)
         self._fn_cache: Dict[bytes, Any] = {}
 
+        # ---- distributed refcounting (reference analogue:
+        # core_worker/reference_count.h:61, collapsed to a GCS-tracked
+        # holder set per object; this process reports itself as a holder
+        # while any local ObjectRef instance or in-flight task arg needs
+        # the object, with events batched per flush window) ----
+        self._ref_lock = threading.Lock()
+        self._local_refs: Dict[bytes, int] = {}   # live ObjectRef instances
+        self._task_holds: Dict[bytes, int] = {}   # held as in-flight task deps
+        self._ref_registered: set = set()         # ref_add sent (or pending)
+        self._pending_ref_add: set = set()
+        self._pending_ref_del: set = set()
+        self._ref_flush_scheduled = False
+
+        # ---- lineage (reference analogue: task_manager.h:208 lineage +
+        # object_recovery_manager.h:41): keep resubmittable task specs while
+        # any of their return refs live, so a lost object re-executes its
+        # producing task ----
+        self._lineage: Dict[bytes, dict] = {}          # task_id -> entry
+        self._lineage_by_return: Dict[bytes, bytes] = {}  # oid -> task_id
+
         self._serialization = ser.SerializationContext()
         self._serialization.register_reducer(ObjectRef, self._reduce_ref)
+        self._nested_ref_sink = threading.local()
         self._closed = False
 
     # ---- loop bridging -------------------------------------------------
@@ -262,7 +284,31 @@ class Runtime:
         """Custom reducer: a ref escaping this process must be resolvable
         anywhere → promote its value to the shared store first."""
         self.ensure_shared(ref.object_id)
+        sink = getattr(self._nested_ref_sink, "sink", None)
+        if sink is not None:
+            sink.append(ref.object_id.binary())
         return (ObjectRef, (ref.object_id, self.node_id))
+
+    def _serialize_tracked(self, value):
+        """Serialize, collecting any ObjectRefs nested inside the value —
+        the caller registers parent→child edges with the GCS so a stored
+        object keeps its borrowed children alive (reference: borrowing,
+        reference_count.h — collapsed to GCS-tracked object→object pins)."""
+        sink: List[bytes] = []
+        self._nested_ref_sink.sink = sink
+        try:
+            s = self._serialization.serialize(value)
+        finally:
+            self._nested_ref_sink.sink = None
+        return s, sink
+
+    def _register_edges(self, parent_oid: bytes, children: List[bytes]):
+        if children and self.gcs and not self.gcs.closed:
+            self._spawn(
+                self.gcs.notify(
+                    "ref_edge", {"parent": parent_oid, "children": children}
+                )
+            )
 
     def serialize(self, value) -> ser.SerializedObject:
         return self._serialization.serialize(value)
@@ -282,7 +328,9 @@ class Runtime:
             if oid in self.memory_store:
                 value = self.memory_store[oid]
                 if not isinstance(value, _RaiseOnGet):
-                    self._write_to_store(oid, self._serialization.serialize(value))
+                    s, nested = self._serialize_tracked(value)
+                    self._write_to_store(oid, s)
+                    self._register_edges(oid, nested)
                 return
             if oid in self._escaped:
                 return  # marked; the reply applier will promote on arrival
@@ -332,8 +380,9 @@ class Runtime:
         self._put_index += 1
         object_id = ObjectID.for_put(self.worker_id, self._put_index)
         oid = object_id.binary()
-        s = self._serialization.serialize(value)
+        s, nested = self._serialize_tracked(value)
         self._write_to_store(oid, s)
+        self._register_edges(oid, nested)
         return ObjectRef(object_id, self.node_id)
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -413,6 +462,12 @@ class Runtime:
                 if found:
                     return value
                 failed_pulls += 1
+                # A failed pull already waited a location round: if we own
+                # lineage for the object, re-execute its producing task now
+                # (reference: object_recovery_manager.h:41) — whatever the
+                # deadline shape, recovery beats spinning.
+                if await self._try_reconstruct(oid):
+                    continue
                 if deadline is None or (
                     deadline == float("inf") and failed_pulls >= 4
                 ):
@@ -577,7 +632,6 @@ class Runtime:
             tuple(sorted(resources.items())),
             tuple(sorted((strategy or {}).items(), key=lambda kv: kv[0])),
         )
-        pending = PendingTask(spec, return_ids, max_retries)
         # Dependencies this process itself is producing.  They must resolve
         # BEFORE the task may occupy a lease — a worker blocking on an
         # in-flight upstream result while holding the worker that upstream
@@ -588,16 +642,27 @@ class Runtime:
             for item in spec["args"]
             if item[0] in ("ref", "kwref")
         ]
+        pending = PendingTask(spec, return_ids, max_retries, dep_oids=dep_oids)
+        # ref args stay pinned while the task is in flight, even if the
+        # caller drops its own refs (reference: task-argument references,
+        # reference_count.h)
+        self._hold_for_task(dep_oids)
+        self._record_lineage(
+            pending, class_key, dict(resources), strategy or {}, dep_oids
+        )
         # Register result futures before the task can possibly complete, then
         # hand off to the io loop without blocking (safe to call from the io
         # thread itself, e.g. async actor methods submitting sub-tasks).
         for oid in return_ids:
             self.result_futures[oid] = asyncio.Future(loop=self._loop)
+        # refs exist BEFORE the enqueue can run: a fast failure path must
+        # see a nonzero refcount or it would drop the error sentinel
+        refs = [ObjectRef(ObjectID(oid), self.node_id) for oid in return_ids]
         self._call_on_loop(
             self._enqueue_after_deps, class_key, pending, dict(resources),
             strategy or {}, dep_oids,
         )
-        return [ObjectRef(ObjectID(oid), self.node_id) for oid in return_ids]
+        return refs
 
     def _call_on_loop(self, fn, *args):
         if threading.current_thread() is self._thread:
@@ -794,6 +859,7 @@ class Runtime:
         if reply["status"] == "error":
             self._fail_task(task, self._serialization.deserialize(reply["error"]))
             return
+        self._unhold_for_task(task.dep_oids)
         for oid, ret in zip(task.return_ids, reply["returns"]):
             kind = ret[0]
             if kind == "inline":
@@ -823,14 +889,17 @@ class Runtime:
             fut = self.result_futures.pop(oid, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
+            self._maybe_release_after_reply(oid)
 
     def _fail_task(self, task: PendingTask, exc: Exception):
+        self._unhold_for_task(task.dep_oids)
         for oid in task.return_ids:
             self._cancel_requested.discard(oid)
             self.memory_store[oid] = _RaiseOnGet(exc)
             fut = self.result_futures.pop(oid, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
+            self._maybe_release_after_reply(oid)
 
     # ---- actors (client side) ------------------------------------------
     def create_actor(
@@ -996,11 +1065,20 @@ class Runtime:
         return_ids = [
             ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
         ]
-        task = PendingTask(spec, return_ids, retries, sub_idx=sub_idx)
+        dep_oids = [
+            item[1] if item[0] == "ref" else item[2]
+            for item in spec["args"]
+            if item[0] in ("ref", "kwref")
+        ]
+        task = PendingTask(
+            spec, return_ids, retries, sub_idx=sub_idx, dep_oids=dep_oids
+        )
+        self._hold_for_task(dep_oids)
         for oid in return_ids:
             self.result_futures[oid] = asyncio.Future(loop=self._loop)
+        refs = [ObjectRef(ObjectID(oid)) for oid in return_ids]
         self._call_on_loop(self._enqueue_actor_task, task)
-        return [ObjectRef(ObjectID(oid)) for oid in return_ids]
+        return refs
 
     def _enqueue_actor_task(self, task: PendingTask):
         from collections import deque
@@ -1160,8 +1238,193 @@ class Runtime:
             self._shared.discard(oid)
         self._run(self.gcs.call("free_objects", {"object_ids": oids}))
 
+    # ---- distributed refcounting ---------------------------------------
+    def on_ref_created(self, object_id: ObjectID):
+        oid = object_id.binary()
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) + 1
+            self._local_refs[oid] = n
+            if n == 1:
+                if oid in self._pending_ref_del:
+                    # re-created before the release flushed: net effect is
+                    # "still held" — cancel the pending del
+                    self._pending_ref_del.discard(oid)
+                    self._ref_registered.add(oid)
+                elif oid not in self._ref_registered:
+                    self._ref_registered.add(oid)
+                    self._pending_ref_add.add(oid)
+                    self._schedule_ref_flush()
+
     def on_ref_deleted(self, object_id: ObjectID):
-        pass  # distributed refcounting lands with lineage GC (round 2)
+        oid = object_id.binary()
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
+            self._local_refs.pop(oid, None)
+            if self._task_holds.get(oid, 0) > 0:
+                return  # still pinned as an in-flight task dependency
+        self._release_local(oid)
+
+    def _hold_for_task(self, oids):
+        with self._ref_lock:
+            for oid in oids:
+                self._task_holds[oid] = self._task_holds.get(oid, 0) + 1
+
+    def _unhold_for_task(self, oids):
+        released = []
+        with self._ref_lock:
+            for oid in oids:
+                n = self._task_holds.get(oid, 0) - 1
+                if n > 0:
+                    self._task_holds[oid] = n
+                else:
+                    self._task_holds.pop(oid, None)
+                    if self._local_refs.get(oid, 0) == 0:
+                        released.append(oid)
+        for oid in released:
+            self._release_local(oid)
+
+    def _release_local(self, oid: bytes):
+        """Last local reference (and task hold) is gone: drop the local
+        value and tell the GCS this process no longer holds the object."""
+        if self._closed:
+            return
+        self.memory_store.pop(oid, None)
+        self._shared.discard(oid)
+        self._escaped.discard(oid)
+        self._release_lineage_return(oid)
+        with self._ref_lock:
+            if oid in self._ref_registered:
+                # the del is sent even when its add is still pending in the
+                # same window (adds flush before dels): the GCS must see
+                # the empty holder set to free any stored copies
+                self._ref_registered.discard(oid)
+                self._pending_ref_del.add(oid)
+                self._schedule_ref_flush()
+
+    def _schedule_ref_flush(self):
+        # caller holds _ref_lock
+        if self._ref_flush_scheduled or self._closed:
+            return
+        self._ref_flush_scheduled = True
+        try:
+            self._loop.call_soon_threadsafe(
+                self._loop.call_later, cfg.ref_flush_interval_s,
+                self._flush_ref_events,
+            )
+        except RuntimeError:
+            self._ref_flush_scheduled = False  # loop closing
+
+    def _flush_ref_events(self):
+        with self._ref_lock:
+            add = list(self._pending_ref_add)
+            dels = list(self._pending_ref_del)
+            self._pending_ref_add.clear()
+            self._pending_ref_del.clear()
+            self._ref_flush_scheduled = False
+        if (add or dels) and self.gcs and not self.gcs.closed:
+            self._spawn(
+                self.gcs.notify(
+                    "ref_update",
+                    {
+                        "holder": self.worker_id.binary(),
+                        "add": add,
+                        "del": dels,
+                    },
+                )
+            )
+
+    def _maybe_release_after_reply(self, oid: bytes):
+        """A task reply landed a value for ``oid`` but every ref died while
+        the task ran — release immediately so unobserved results can't
+        accumulate in the memory store."""
+        with self._ref_lock:
+            live = self._local_refs.get(oid, 0) > 0 or self._task_holds.get(
+                oid, 0
+            ) > 0
+        if not live:
+            self._release_local(oid)
+
+    # ---- lineage + reconstruction --------------------------------------
+    def _record_lineage(self, task: PendingTask, class_key, resources,
+                        strategy, dep_oids):
+        if cfg.lineage_reconstruction_max <= 0:
+            return
+        tid = task.spec["task_id"]
+        self._lineage[tid] = {
+            "spec": task.spec,
+            "class_key": class_key,
+            "resources": resources,
+            "strategy": strategy,
+            "dep_oids": list(dep_oids),
+            "return_ids": list(task.return_ids),
+            "budget": cfg.lineage_reconstruction_max,
+            "live_returns": set(task.return_ids),
+            "inflight": False,
+        }
+        for oid in task.return_ids:
+            self._lineage_by_return[oid] = tid
+
+    def _release_lineage_return(self, oid: bytes):
+        tid = self._lineage_by_return.pop(oid, None)
+        if tid is None:
+            return
+        entry = self._lineage.get(tid)
+        if entry is None:
+            return
+        entry["live_returns"].discard(oid)
+        if not entry["live_returns"]:
+            self._lineage.pop(tid, None)
+
+    async def _try_reconstruct(self, oid: bytes) -> bool:
+        """Re-execute the task that produced ``oid`` (lineage recovery).
+
+        Returns True if a reconstruction is running (caller loops back to
+        waiting on the result future).  Runs on the io loop."""
+        tid = self._lineage_by_return.get(oid)
+        if tid is None:
+            return False
+        entry = self._lineage.get(tid)
+        if entry is None:
+            return False
+        if entry["inflight"] or oid in self.result_futures:
+            return True  # already being reconstructed
+        if entry["budget"] <= 0:
+            return False
+        entry["budget"] -= 1
+        entry["inflight"] = True
+        try:
+            logger.info(
+                "reconstructing object %s via task %s (budget left %d)",
+                oid.hex()[:12], tid.hex()[:12], entry["budget"],
+            )
+            # Recover dependencies first: resolving them triggers their own
+            # reconstruction recursively through this same path, then
+            # re-promote each to the shared store for the executing worker.
+            for dep in entry["dep_oids"]:
+                value = await self._resolve_one(dep, None)
+                if not self.store.contains(dep):
+                    self._shared.discard(dep)
+                    self._write_to_store(
+                        dep, self._serialization.serialize(value)
+                    )
+            task = PendingTask(
+                entry["spec"], entry["return_ids"],
+                retries_left=0,
+            )
+            for roid in entry["return_ids"]:
+                if roid not in self.result_futures:
+                    self.memory_store.pop(roid, None)
+                    self.result_futures[roid] = asyncio.Future(loop=self._loop)
+            self._enqueue_task(
+                entry["class_key"], task, dict(entry["resources"]),
+                entry["strategy"],
+            )
+            return True
+        finally:
+            entry["inflight"] = False
 
     def cluster_resources(self) -> dict:
         return self._run(self.gcs.call("cluster_resources", {}))

@@ -131,12 +131,15 @@ class WorkerServer:
         task_id = TaskID(spec["task_id"])
         returns = []
         for i, v in enumerate(values):
-            s = self.rt.serialize(v)
+            s, nested = self.rt._serialize_tracked(v)
             if s.total_bytes <= cfg.inline_object_max_bytes:
+                # inline: the caller deserializes immediately, so nested
+                # refs become live ObjectRefs there — no edge needed
                 returns.append(("inline", s.to_bytes()))
             else:
                 oid = ObjectID.for_task_return(task_id, i).binary()
                 self.rt._write_to_store(oid, s)
+                self.rt._register_edges(oid, nested)
                 returns.append(("stored", s.total_bytes))
         return {"status": "ok", "returns": returns}
 

@@ -9,8 +9,19 @@ boundaries.  Blocks are ObjectRefs to pyarrow Tables, processed by
 @remote tasks, so transform parallelism and locality come from the core
 scheduler.
 
+Execution is STREAMING by default (ray: data/_internal/execution/
+streaming_executor.py:51 analogue): consumption iterates block-by-block
+with a bounded in-flight window — sources are lazy ReadTasks executed
+inside the fused stage task, at most `cfg.data_streaming_window` blocks
+are being produced at once, and consumed blocks are freed by the core's
+distributed refcounting as their refs drop — so a dataset much larger
+than the object store flows through map→ingest at bounded memory
+(backpressure = the consumer's pull rate).
+
 The TPU-facing consumption path is iter_jax_batches(): dict-of-device
-arrays, optionally laid out onto a mesh sharding for SPMD ingest.
+arrays, optionally laid out onto a mesh sharding for SPMD ingest, with
+double-buffered jax.device_put so host→device transfer of batch N+1
+overlaps the caller's step N compute.
 """
 
 from __future__ import annotations
@@ -29,6 +40,19 @@ BatchFormat = Union[str]  # "pyarrow" | "numpy" | "pandas"
 
 
 # -- transform ops ---------------------------------------------------------
+
+
+class ReadTask:
+    """Lazy block source: fn(*args) → Block, run on a worker inside the
+    fused stage task (ray: data ReadTask analogue).  Keeping sources lazy
+    means a read is only issued when the streaming window pulls it."""
+
+    def __init__(self, fn: Callable[..., "Block"], *args):
+        self.fn = fn
+        self.args = args
+
+    def __call__(self) -> "Block":
+        return self.fn(*self.args)
 
 
 class _Op:
@@ -165,21 +189,55 @@ class Dataset:
         return self.map_batches(rename, batch_format="pyarrow")
 
     # -- execution -------------------------------------------------------
-    def _execute(self) -> List[Any]:
-        """Run pending ops: one fused task per block (cached)."""
-        if self._materialized is not None:
-            return self._materialized
-        if not self._ops:
-            self._materialized = list(self._input_refs)
-            return self._materialized
+    def _submit_stage(self, src) -> Any:
+        """One fused read+transform task for one source → block ref."""
+        ops = self._ops
+        if not ops and not isinstance(src, ReadTask):
+            return src  # already-materialized block, nothing to run
 
         @ray_tpu.remote
-        def run_stage(ops, block):
+        def run_stage(ops, src):
+            block = src() if isinstance(src, ReadTask) else src
             return _apply_ops(block, ops)
 
-        ops = self._ops
+        return run_stage.remote(ops, src)
+
+    def iter_block_refs(self) -> Iterator[Any]:
+        """Streaming execution: yield block refs in order with a bounded
+        in-flight production window.  The consumer's pull rate is the
+        backpressure (ray: streaming_executor_state.py:497 analogue,
+        collapsed to a sliding window over the fused single-stage plan);
+        dropping each yielded ref frees the block cluster-wide via the
+        distributed refcounter."""
+        if self._materialized is not None:
+            yield from self._materialized
+            return
+        from collections import deque
+
+        from ray_tpu.common.config import cfg
+
+        window = max(1, cfg.data_streaming_window)
+        pending: Any = deque()
+        srcs = iter(self._input_refs)
+        for src in srcs:
+            pending.append(self._submit_stage(src))
+            if len(pending) >= window:
+                break
+        while pending:
+            ref = pending.popleft()
+            nxt = next(srcs, None)
+            if nxt is not None:
+                pending.append(self._submit_stage(nxt))
+            yield ref
+
+    def _execute(self) -> List[Any]:
+        """Materialize the whole plan: every stage task in flight at once
+        (used by shuffle boundaries and materialize(); streaming paths use
+        iter_block_refs)."""
+        if self._materialized is not None:
+            return self._materialized
         self._materialized = [
-            run_stage.remote(ops, ref) for ref in self._input_refs
+            self._submit_stage(src) for src in self._input_refs
         ]
         return self._materialized
 
@@ -235,7 +293,7 @@ class Dataset:
 
     def limit(self, n: int) -> "Dataset":
         taken, out = 0, []
-        for ref in self._execute():
+        for ref in self.iter_block_refs():
             if taken >= n:
                 break
             b = ray_tpu.get(ref, timeout=600)
@@ -245,15 +303,18 @@ class Dataset:
         return Dataset(out)
 
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
-        """Split into n datasets by whole blocks (per-worker ingest)."""
-        refs = self._execute()
+        """Split into n datasets (per-worker ingest).
+
+        Stays LAZY: sources round-robin into the splits with the pending
+        ops carried along, so each worker's shard streams independently
+        (equal=True materializes — it must count rows)."""
         if equal:
             ds = self.repartition(n)
             return [Dataset([r]) for r in ds._execute()]
         out: List[List[Any]] = [[] for _ in range(n)]
-        for i, ref in enumerate(refs):
-            out[i % n].append(ref)
-        return [Dataset(rs) for rs in out]
+        for i, src in enumerate(self._input_refs):
+            out[i % n].append(src)
+        return [Dataset(srcs, ops=list(self._ops)) for srcs in out]
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -264,17 +325,16 @@ class Dataset:
         def count_block(b):
             return b.num_rows
 
-        return sum(
-            ray_tpu.get(
-                [count_block.remote(r) for r in self._execute()], timeout=600
-            )
-        )
+        # per-block counts consume each block promptly, so the stage
+        # outputs free as fast as they are counted
+        refs = [count_block.remote(r) for r in self.iter_block_refs()]
+        return sum(ray_tpu.get(refs, timeout=600))
 
     def num_blocks(self) -> int:
-        return len(self._execute())
+        return len(self._input_refs)
 
     def schema(self):
-        for ref in self._execute():
+        for ref in self.iter_block_refs():
             b = ray_tpu.get(ref, timeout=600)
             if b.num_rows or b.column_names:
                 return b.schema
@@ -286,7 +346,7 @@ class Dataset:
 
     def take(self, n: int = 20) -> List[dict]:
         rows: List[dict] = []
-        for ref in self._execute():
+        for ref in self.iter_block_refs():
             b = ray_tpu.get(ref, timeout=600)
             for r in BlockAccessor(b).iter_rows():
                 rows.append(r)
@@ -306,7 +366,7 @@ class Dataset:
             print(r)
 
     def iter_rows(self) -> Iterator[dict]:
-        for ref in self._execute():
+        for ref in self.iter_block_refs():
             b = ray_tpu.get(ref, timeout=600)
             yield from BlockAccessor(b).iter_rows()
 
@@ -317,9 +377,10 @@ class Dataset:
         batch_format: str = "numpy",
         drop_last: bool = False,
     ) -> Iterator[Any]:
-        """Stream batches, re-chunking across block boundaries."""
+        """Stream batches, re-chunking across block boundaries; pulls one
+        block at a time through the bounded streaming window."""
         carry: Optional[Block] = None
-        for ref in self._execute():
+        for ref in self.iter_block_refs():
             b = ray_tpu.get(ref, timeout=600)
             if carry is not None and carry.num_rows:
                 b = concat_blocks([carry, b])
@@ -352,23 +413,37 @@ class Dataset:
         The TPU ingest path: host Arrow blocks → numpy → jax.device_put
         (with a NamedSharding this feeds an SPMD step directly).  TPU
         wants static shapes, so drop_last defaults True.
+
+        Double-buffered: batch N+1's device_put is issued (async) before
+        batch N is yielded, so the host→device DMA overlaps the caller's
+        step-N compute — ingest must not serialize against the train step
+        (the prefetch the reference gets from iter_torch_batches'
+        prefetch_batches).
         """
         import jax
 
-        for batch in self.iter_batches(
-            batch_size=batch_size, batch_format="numpy", drop_last=drop_last
-        ):
+        def to_device(batch):
             if dtypes:
                 batch = {
                     k: v.astype(dtypes[k]) if k in dtypes else v
                     for k, v in batch.items()
                 }
             if sharding is not None:
-                yield {
+                return {
                     k: jax.device_put(v, sharding) for k, v in batch.items()
                 }
-            else:
-                yield {k: jax.device_put(v) for k, v in batch.items()}
+            return {k: jax.device_put(v) for k, v in batch.items()}
+
+        prev = None
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy", drop_last=drop_last
+        ):
+            cur = to_device(batch)  # async transfer starts now
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
 
     def to_pandas(self):
         return concat_blocks(self._blocks()).to_pandas()
@@ -405,9 +480,10 @@ class Dataset:
         return getattr(builtins, kind)(vals)
 
     def __repr__(self):
+        lazy = sum(1 for s in self._input_refs if isinstance(s, ReadTask))
         return (
             f"Dataset(num_blocks={len(self._input_refs)}, "
-            f"pending_ops={len(self._ops)})"
+            f"lazy_sources={lazy}, pending_ops={len(self._ops)})"
         )
 
 

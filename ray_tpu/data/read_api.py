@@ -15,7 +15,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data import block as block_mod
-from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.dataset import Dataset, ReadTask
 
 DEFAULT_BLOCKS = 8
 
@@ -23,22 +23,22 @@ DEFAULT_BLOCKS = 8
 # -- in-memory sources -----------------------------------------------------
 
 
+def _range_block(lo: int, hi: int):
+    return block_mod.from_numpy({"id": np.arange(lo, hi, dtype=np.int64)})
+
+
 def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
     import builtins
 
     nb = min(override_num_blocks or DEFAULT_BLOCKS, max(1, n))
     step = (n + nb - 1) // nb
-
-    @ray_tpu.remote
-    def make(lo, hi):
-        return block_mod.from_numpy({"id": np.arange(lo, hi, dtype=np.int64)})
-
-    refs = [
-        make.remote(i * step, min((i + 1) * step, n))
-        for i in builtins.range(nb)
-        if i * step < n
-    ]
-    return Dataset(refs)
+    return Dataset(
+        [
+            ReadTask(_range_block, i * step, min((i + 1) * step, n))
+            for i in builtins.range(nb)
+            if i * step < n
+        ]
+    )
 
 
 def from_items(
@@ -92,75 +92,71 @@ def _expand_paths(paths, suffix: str) -> List[str]:
     return out
 
 
+def _read_parquet_file(path):
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path)
+
+
+def _read_csv_file(path):
+    import pyarrow.csv as pcsv
+
+    return pcsv.read_csv(path)
+
+
+def _read_jsonl_file(path):
+    import pyarrow.json as pjson
+
+    return pjson.read_json(path)
+
+
+def _read_text_file(path):
+    with open(path, "r") as f:
+        lines = [ln.rstrip("\n") for ln in f]
+    return block_mod.from_rows([{"text": ln} for ln in lines])
+
+
+def _read_npy_file(path):
+    return block_mod.from_numpy({"data": np.load(path)})
+
+
+def _read_binary_file(path):
+    with open(path, "rb") as f:
+        data = f.read()
+    return block_mod.from_rows([{"bytes": data, "path": path}])
+
+
+def _file_dataset(paths, suffix: str, reader) -> Dataset:
+    """One lazy ReadTask per file: the read happens on a worker when the
+    streaming window pulls the block, not at dataset-construction time."""
+    return Dataset(
+        [ReadTask(reader, f) for f in _expand_paths(paths, suffix)]
+    )
+
+
 def read_parquet(paths, **kwargs) -> Dataset:
-    files = _expand_paths(paths, ".parquet")
-
-    @ray_tpu.remote
-    def read_one(path):
-        import pyarrow.parquet as pq
-
-        return pq.read_table(path)
-
-    return Dataset([read_one.remote(f) for f in files])
+    return _file_dataset(paths, ".parquet", _read_parquet_file)
 
 
 def read_csv(paths, **kwargs) -> Dataset:
-    files = _expand_paths(paths, ".csv")
-
-    @ray_tpu.remote
-    def read_one(path):
-        import pyarrow.csv as pcsv
-
-        return pcsv.read_csv(path)
-
-    return Dataset([read_one.remote(f) for f in files])
+    return _file_dataset(paths, ".csv", _read_csv_file)
 
 
 def read_json(paths, **kwargs) -> Dataset:
     """JSONL files (ray: read_json uses pyarrow.json line-delimited)."""
-    files = _expand_paths(paths, ".jsonl")
-
-    @ray_tpu.remote
-    def read_one(path):
-        import pyarrow.json as pjson
-
-        return pjson.read_json(path)
-
-    return Dataset([read_one.remote(f) for f in files])
+    return _file_dataset(paths, ".jsonl", _read_jsonl_file)
 
 
 def read_text(paths, **kwargs) -> Dataset:
-    files = _expand_paths(paths, ".txt")
-
-    @ray_tpu.remote
-    def read_one(path):
-        with open(path, "r") as f:
-            lines = [ln.rstrip("\n") for ln in f]
-        return block_mod.from_rows([{"text": ln} for ln in lines])
-
-    return Dataset([read_one.remote(f) for f in files])
+    return _file_dataset(paths, ".txt", _read_text_file)
 
 
 def read_numpy(paths, **kwargs) -> Dataset:
-    files = _expand_paths(paths, ".npy")
-
-    @ray_tpu.remote
-    def read_one(path):
-        return block_mod.from_numpy({"data": np.load(path)})
-
-    return Dataset([read_one.remote(f) for f in files])
+    return _file_dataset(paths, ".npy", _read_npy_file)
 
 
 def read_binary_files(paths, **kwargs) -> Dataset:
-    files = _expand_paths(paths, "")
-
-    @ray_tpu.remote
-    def read_one(path):
-        with open(path, "rb") as f:
-            data = f.read()
-        return block_mod.from_rows([{"bytes": data, "path": path}])
-
-    return Dataset([read_one.remote(f) for f in files])
+    return _file_dataset(paths, "", _read_binary_file)
 
 
 # -- writers (attached to Dataset) ----------------------------------------
@@ -190,7 +186,7 @@ def _write(ds: Dataset, path: str, fmt: str) -> List[str]:
     suffix = {"parquet": ".parquet", "csv": ".csv", "jsonl": ".jsonl"}[fmt]
     refs = [
         write_one.remote(ref, os.path.join(path, f"part-{i:05d}{suffix}"))
-        for i, ref in enumerate(ds._execute())
+        for i, ref in enumerate(ds.iter_block_refs())
     ]
     return ray_tpu.get(refs, timeout=600)
 

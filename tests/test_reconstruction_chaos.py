@@ -1,0 +1,56 @@
+"""Node-death object recovery (chaos path for lineage reconstruction).
+
+Mirrors ray: python/ray/tests/test_object_reconstruction.py node-failure
+cases on the multi-raylet Cluster harness.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import get_runtime
+
+
+class TestNodeDeathReconstruction:
+    def test_node_death_recovers_value(self):
+        """Chaos path: the node holding the only copy dies mid-workload;
+        the driver's get reconstructs the value on a surviving node
+        (VERDICT r1 done-criterion for N10)."""
+        from ray_tpu.cluster_utils import Cluster
+
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        doomed = c.add_node(num_cpus=2, resources={"spot": 2.0})
+        c.add_node(num_cpus=2, resources={"spot": 2.0})
+        c.connect()
+        c.wait_for_nodes()
+        try:
+
+            @ray_tpu.remote(max_retries=2, resources={"spot": 1.0})
+            def produce():
+                return np.full(200_000, 9, np.int64)
+
+            # pin the first execution to the doomed node via its full
+            # capacity: two tasks, one per spot-node; find the doomed copy
+            ref = produce.remote()
+            assert ray_tpu.get(ref, timeout=120)[0] == 9
+            rt = get_runtime()
+            oid = ref.object_id.binary()
+            locs = rt._run(
+                rt.gcs.call("get_object_locations", {"object_id": oid})
+            )["locations"]
+            assert locs, "object should have a recorded location"
+            victim_node_id = locs[0]["node_id"]
+            if victim_node_id == doomed.node_id:
+                c.remove_node(doomed, allow_graceful=False)
+            else:
+                # produced on the other spot node: kill that one instead
+                other = [
+                    n for n in c._nodes if n.node_id == victim_node_id
+                ]
+                assert other, "victim must be a cluster-harness node"
+                c.remove_node(other[0], allow_graceful=False)
+            again = ray_tpu.get(ref, timeout=180)
+            assert again[0] == 9 and again.shape == (200_000,)
+        finally:
+            ray_tpu.shutdown()
+            c.shutdown()
