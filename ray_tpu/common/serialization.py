@@ -17,12 +17,16 @@ pickle.PickleBuffer out-of-band serialization.
 
 from __future__ import annotations
 
+import logging
 import pickle
 import struct
 import sys
+import types
 from typing import Any, Callable, List, Optional, Sequence
 
 import cloudpickle
+
+logger = logging.getLogger(__name__)
 
 _HEADER = struct.Struct("<I")
 _BUFHDR = struct.Struct("<Q")
@@ -70,6 +74,62 @@ def _restore_numpy(a):
     return a
 
 
+_by_value_checked: set = set()
+
+
+def _maybe_register_by_value(module_name: Optional[str]) -> None:
+    """Serialize functions/classes from *user-code* modules by value.
+
+    A module-level function defined in the driver's own script or test file
+    pickles by reference under plain cloudpickle, and the worker — which
+    does not share the driver's sys.path — fails with ModuleNotFoundError.
+    The reference solves this with working_dir runtime envs; the more
+    robust default here is: any module that is not installed (not under
+    sys.prefix/site-packages or the stdlib) and is not the framework
+    itself is registered with cloudpickle's pickle-by-value registry, so
+    its code travels with the task (ray: python/ray/_private/
+    serialization.py role; cloudpickle.register_pickle_by_value).
+    """
+    if not module_name or module_name in _by_value_checked:
+        return
+    _by_value_checked.add(module_name)
+    if module_name in ("__main__", "__mp_main__"):  # already by-value
+        return
+    if module_name.split(".", 1)[0] == "ray_tpu":
+        return
+    mod = sys.modules.get(module_name)
+    f = getattr(mod, "__file__", None)
+    if mod is None or f is None:  # builtin / namespace pkg
+        return
+    import os
+    import site
+    import sysconfig
+
+    path = os.path.abspath(f)
+    roots = {
+        sysconfig.get_paths().get(k)
+        for k in ("stdlib", "platstdlib", "purelib", "platlib")
+    }
+    try:  # user site + any system site-packages a venv exposes
+        roots.update(site.getsitepackages())
+        roots.add(site.getusersitepackages())
+    except Exception:  # site may be absent under some embedded interpreters
+        pass
+    roots.discard(None)
+    if any(path.startswith(os.path.abspath(r) + os.sep) for r in roots):
+        return  # installed package: importable on workers by reference
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+    except Exception as e:
+        logger.warning(
+            "could not register module %r for by-value pickling (%s); "
+            "functions from it will pickle by reference and workers "
+            "without it on sys.path will fail to import it",
+            module_name,
+            e,
+        )
+
+
 class _Pickler(cloudpickle.CloudPickler):
     """Cloudpickle with isinstance-based custom reducers (handles jax.Array
     subclasses anywhere inside a container graph)."""
@@ -87,6 +147,8 @@ class _Pickler(cloudpickle.CloudPickler):
         for typ, red in self._custom.items():
             if isinstance(obj, typ):
                 return red(obj)
+        if isinstance(obj, (types.FunctionType, type)):
+            _maybe_register_by_value(getattr(obj, "__module__", None))
         return super().reducer_override(obj)
 
 

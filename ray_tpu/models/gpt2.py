@@ -152,19 +152,39 @@ def _attention(q, k, v, config: GPTConfig):
 def _block(x, p, config: GPTConfig):
     """One transformer block. x: (B, S, E); p: per-layer param slice."""
     c = config
+    S = x.shape[1]
     h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
-    qkv = (
-        jnp.einsum("bse,ehd->bshd", h, p["qkv_kernel"].astype(c.dtype))
-        + p["qkv_bias"].astype(c.dtype)
-    )
-    q, k, v = jnp.split(qkv, 3, axis=2)
-    q = constrain(q, ("batch", "seq", "heads", None))
-    k = constrain(k, ("batch", "seq", "heads", None))
-    v = constrain(v, ("batch", "seq", "heads", None))
-    attn = _attention(q, k, v, c)
-    x = x + jnp.einsum(
-        "bshd,hde->bse", attn, p["proj_kernel"].astype(c.dtype)
-    ) + p["proj_bias"].astype(c.dtype)
+    if c.attention_impl == "flash" and S % 128 == 0:
+        # Kernel-native (B, H, S, D) layout: the qkv/proj einsums emit and
+        # consume it directly, so no transposes surround the pallas call.
+        # Non-128-multiple S falls through to the dense path below — the
+        # kernel requires block-divisible sequence lengths.
+        from ray_tpu.ops.flash_attention import sharded_flash_attention_bhsd
+
+        qkv = jnp.einsum(
+            "bse,ehd->bhsd", h, p["qkv_kernel"].astype(c.dtype)
+        ) + p["qkv_bias"].astype(c.dtype)[None, :, None, :]
+        q, k, v = jnp.split(qkv, 3, axis=1)
+        q = constrain(q, ("batch", "heads", "seq", None))
+        k = constrain(k, ("batch", "heads", "seq", None))
+        v = constrain(v, ("batch", "heads", "seq", None))
+        attn = sharded_flash_attention_bhsd(q, k, v)
+        x = x + jnp.einsum(
+            "bhsd,hde->bse", attn, p["proj_kernel"].astype(c.dtype)
+        ) + p["proj_bias"].astype(c.dtype)
+    else:
+        qkv = (
+            jnp.einsum("bse,ehd->bshd", h, p["qkv_kernel"].astype(c.dtype))
+            + p["qkv_bias"].astype(c.dtype)
+        )
+        q, k, v = jnp.split(qkv, 3, axis=2)
+        q = constrain(q, ("batch", "seq", "heads", None))
+        k = constrain(k, ("batch", "seq", "heads", None))
+        v = constrain(v, ("batch", "seq", "heads", None))
+        attn = _attention(q, k, v, c)
+        x = x + jnp.einsum(
+            "bshd,hde->bse", attn, p["proj_kernel"].astype(c.dtype)
+        ) + p["proj_bias"].astype(c.dtype)
     x = constrain(x, ("batch", "seq", "embed"))
     h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
     h = jnp.einsum("bse,em->bsm", h, p["fc_kernel"].astype(c.dtype))
@@ -180,7 +200,15 @@ def forward(params: Params, tokens, config: GPTConfig):
     """tokens (B, S) int32 → logits (B, S, vocab) in f32."""
     c = config
     B, S = tokens.shape
-    x = params["wte"].astype(c.dtype)[tokens]
+    # Explicitly all-gather the embedding table for the lookup: a gather
+    # from the (vocab/tp, embed/fsdp)-sharded table forces SPMD into
+    # "involuntary full rematerialization" (replicate + repartition every
+    # step).  Constraining the operand replicated makes the all-gather a
+    # deliberate, one-per-step collective and lets the gather partition
+    # cleanly along the tokens' batch/seq sharding.  The lm_head einsum
+    # below still consumes the sharded table.
+    wte_lookup = constrain(params["wte"], (None, None)).astype(c.dtype)
+    x = wte_lookup[tokens]
     x = x + params["wpe"].astype(c.dtype)[:S]
     x = constrain(x, ("batch", "seq", "embed"))
 
@@ -210,8 +238,13 @@ def loss_fn(params: Params, batch, config: GPTConfig):
     else:
         inputs, targets = batch["inputs"], batch["targets"]
     logits = forward(params, inputs, config)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # lse − target_logit instead of log_softmax + gather: avoids writing a
+    # second full (B, S, V) f32 array (1.6 GB at B=8, S=1024, V=50k).
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tl = jnp.take_along_axis(
+        logits.astype(jnp.float32), targets[..., None], axis=-1
+    )[..., 0]
+    ll = tl - lse
     mask = batch.get("mask")
     if mask is None:
         return -ll.mean()
@@ -227,8 +260,13 @@ def num_params(config: GPTConfig) -> int:
 
 
 def flops_per_token(config: GPTConfig, seq_len: Optional[int] = None) -> float:
-    """Approximate training FLOPs/token (6N + attention term)."""
+    """Approximate training FLOPs/token: 6N + attention term.
+
+    N excludes the position table but keeps wte — the lm_head is tied to
+    it, so its matmul is real executed compute (nanoGPT estimate_mfu
+    convention; under-counting it would overstate MFU headroom).
+    """
     c = config
     s = seq_len or c.max_seq_len
-    n = num_params(c) - c.vocab_size * c.embed_dim  # non-embedding
+    n = num_params(c) - c.max_seq_len * c.embed_dim  # minus wpe only
     return 6 * n + 12 * c.num_layers * c.embed_dim * s
