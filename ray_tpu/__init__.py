@@ -26,6 +26,7 @@ def __getattr__(name):
         "wait",
         "kill",
         "cancel",
+        "get_actor",
         "get_runtime_context",
         "available_resources",
         "cluster_resources",

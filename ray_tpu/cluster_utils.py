@@ -142,6 +142,33 @@ class Cluster:
         ray_tpu.init(address=self.address)
         self._connected = True
 
+    def kill_gcs(self):
+        """kill -9 the GCS process (head fault injection)."""
+        if self.gcs_proc.poll() is None:
+            self.gcs_proc.kill()
+            self.gcs_proc.wait(timeout=5)
+
+    def restart_gcs(self, timeout: float = 30.0):
+        """Restart the GCS on the SAME port with the same session dir, so
+        raylets/drivers holding ReconnectingConnections re-attach and the
+        checkpoint restores cluster state (ray: GCS FT with external Redis;
+        here the CheckpointStore under the session dir)."""
+        self.kill_gcs()
+        host, port_s = self.address.rsplit(":", 1)
+        deadline = time.monotonic() + timeout
+        last_exc: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            try:
+                self.gcs_proc, addr = node_mod.start_gcs(
+                    self.session_dir, host=host, port=int(port_s)
+                )
+                assert addr == self.address, (addr, self.address)
+                return
+            except Exception as e:  # port may linger in TIME_WAIT briefly
+                last_exc = e
+                time.sleep(0.3)
+        raise RuntimeError(f"GCS restart failed: {last_exc!r}")
+
     def shutdown(self):
         """Tear down all raylets and the GCS."""
         for node in list(self._nodes):

@@ -63,6 +63,11 @@ D("rpc_connect_timeout_s", float, 30.0)
 D("rpc_call_timeout_s", float, 120.0)
 D("heartbeat_interval_s", float, 1.0)
 D("node_death_timeout_s", float, 10.0)
+# how long clients (raylets, drivers, workers) keep re-dialing a dead GCS
+# before declaring the cluster lost
+D("gcs_reconnect_max_downtime_s", float, 60.0)
+# debounce for GCS snapshot flushes (fault-tolerance checkpoint)
+D("gcs_checkpoint_debounce_s", float, 0.05)
 
 # --- object store ---
 D("object_store_bytes", int, 0)  # 0 = auto (30% of /dev/shm free, capped)

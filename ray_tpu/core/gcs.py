@@ -166,7 +166,8 @@ class Scheduler:
         stype = strategy.get("type", "default")
         if stype == "node_affinity":
             node = self.gcs.nodes.get(NodeID.from_hex(strategy["node_id"]))
-            if node and node.alive and node.resources_available.covers(demand):
+            if (node and node.alive and node.conn is not None
+                    and node.resources_available.covers(demand)):
                 return node
             if node and strategy.get("soft", False):
                 pass  # fall through to default placement
@@ -176,7 +177,10 @@ class Scheduler:
         candidates = [
             n
             for n in self.gcs.nodes.values()
-            if n.alive and n.resources_available.covers(demand)
+            # conn=None: checkpoint-restored node whose raylet has not
+            # re-attached yet — known, but not schedulable
+            if n.alive and n.conn is not None
+            and n.resources_available.covers(demand)
         ]
         if not candidates:
             return None
@@ -206,11 +210,116 @@ class Scheduler:
 # --------------------------------------------------------------------------
 
 
+class CheckpointStore:
+    """Debounced snapshot persistence for GCS fault tolerance.
+
+    Role-equivalent of the reference's Redis/observability-backed
+    StoreClient (ray: src/ray/gcs/store_client/store_client.h,
+    redis_store_client.h): GCS tables are flushed to one pickle file
+    (atomic tmp+rename) shortly after every mutation, and reloaded on
+    restart so the cluster can re-attach instead of dying with the head.
+    A single local file instead of Redis is deliberate: TPU pods mount a
+    shared or local session dir, and the write set (control-plane tables,
+    not objects) is small.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._dirty = False
+        self._flush_task: Optional[asyncio.Task] = None
+        self._get_state: Optional[Any] = None  # set by the server
+
+    def load(self) -> Optional[dict]:
+        import pickle
+
+        try:
+            with open(self.path, "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception:
+            logger.exception("GCS checkpoint at %s unreadable; starting fresh",
+                             self.path)
+            return None
+
+    def mark_dirty(self):
+        self._dirty = True
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._flush_soon()
+            )
+
+    async def _flush_soon(self):
+        await asyncio.sleep(cfg.gcs_checkpoint_debounce_s)
+        self.flush()
+
+    def flush(self):
+        import os
+        import pickle
+
+        if not self._dirty or self._get_state is None:
+            return
+        self._dirty = False
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(self._get_state(), f, protocol=5)
+            os.replace(tmp, self.path)
+        except Exception:
+            logger.exception("GCS checkpoint flush failed")
+
+
+#: rpc methods that only mutate the high-churn object tables; their
+#: checkpoint rides a separate, debounce-only file so critical control
+#: flushes stay O(control-plane state)
+_OBJECT_RPCS = frozenset({
+    "add_object_location", "remove_object_location", "free_objects",
+    "ref_edge", "ref_update",
+})
+
+#: rpc methods whose effects must survive an immediate crash: flushed
+#: synchronously before the reply (the reference writes Redis before
+#: acking — gcs_actor_manager.cc persistence-first pattern).  High-churn
+#: mutations (object locations, refcounts) stay on the debounced path.
+_CRITICAL_RPCS = frozenset({
+    "register_actor", "actor_started", "actor_creation_failed",
+    "kill_actor", "create_placement_group", "remove_placement_group",
+    "register_node", "register_job", "kv_put", "kv_del",
+})
+
+#: rpc methods that never mutate GCS state (no checkpoint after these)
+_READONLY_RPCS = frozenset({
+    "get_nodes", "cluster_resources", "kv_get", "kv_exists", "kv_keys",
+    "get_object_locations", "get_actor", "list_actors", "heartbeat",
+    "get_placement_group", "list_placement_groups",
+    "wait_placement_group_ready", "ping", "subscribe", "unsubscribe",
+})
+
+
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        session_dir: Optional[str] = None,
+    ):
         self.server = rpc.Server(
             self._handle, host=host, port=port, on_close=self._conn_closed
         )
+        self.checkpoint: Optional[CheckpointStore] = None
+        self.checkpoint_objects: Optional[CheckpointStore] = None
+        if session_dir:
+            import os
+
+            os.makedirs(session_dir, exist_ok=True)
+            self.checkpoint = CheckpointStore(
+                os.path.join(session_dir, "gcs_checkpoint.pkl")
+            )
+            self.checkpoint._get_state = self._snapshot_state
+            self.checkpoint_objects = CheckpointStore(
+                os.path.join(session_dir, "gcs_objects.pkl")
+            )
+            self.checkpoint_objects._get_state = self._snapshot_object_state
         self.nodes: Dict[NodeID, NodeEntry] = {}
         self.actors: Dict[ActorID, ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (ns, name)
@@ -246,8 +355,139 @@ class GcsServer:
         self._health_task: Optional[asyncio.Task] = None
         self._start_time = time.time()
 
+    # ---- persistence ---------------------------------------------------
+    def _mark_dirty(self):
+        if self.checkpoint is not None:
+            self.checkpoint.mark_dirty()
+
+    def _mark_objects_dirty(self):
+        if self.checkpoint_objects is not None:
+            self.checkpoint_objects.mark_dirty()
+
+    def _snapshot_state(self) -> dict:
+        """Connection-free copy of every durable table."""
+        import copy
+
+        actors = {}
+        for aid, a in self.actors.items():
+            c = copy.copy(a)
+            c.creator_conn = None
+            actors[aid] = c
+        nodes = {
+            nid: {
+                "address": n.address,
+                "resources": n.resources_total.to_dict(),
+                "labels": n.labels,
+            }
+            for nid, n in self.nodes.items()
+            if n.alive
+        }
+        return {
+            "version": 1,
+            "nodes": nodes,
+            "actors": actors,
+            "named_actors": dict(self.named_actors),
+            "jobs": {j: dict(v) for j, v in self.jobs.items()},
+            "kv": dict(self.kv),
+            "placement_groups": {
+                pid: copy.copy(pg) for pid, pg in self.placement_groups.items()
+            },
+            "named_pgs": dict(self.named_pgs),
+        }
+
+    def _snapshot_object_state(self) -> dict:
+        return {
+            "object_locations": {
+                k: set(v) for k, v in self.object_locations.items()
+            },
+            "object_sizes": dict(self.object_sizes),
+            "object_holders": {
+                k: set(v) for k, v in self.object_holders.items()
+            },
+            "object_edges": {k: list(v) for k, v in self.object_edges.items()},
+        }
+
+    def _restore_object_state(self, st: dict):
+        self.object_locations.update(st["object_locations"])
+        self.object_sizes.update(st["object_sizes"])
+        self.object_holders.update(st["object_holders"])
+        self.object_edges.update(st["object_edges"])
+
+    def _restore_state(self, st: dict):
+        """Rebuild tables from a snapshot; connections re-attach lazily.
+
+        Nodes come back as alive-with-no-conn entries: their raylets hold
+        ReconnectingConnections and will re-register within the death
+        timeout, re-applying placement-group bundle debits; ones that
+        don't are reaped by the normal health loop.  ALIVE actors keep
+        serving the whole time — actor calls ride direct client->worker
+        connections that never touched the GCS.
+        """
+        now = time.monotonic()
+        for nid, n in st["nodes"].items():
+            self.nodes[nid] = NodeEntry(
+                node_id=nid,
+                address=n["address"],
+                resources_total=ResourceSet(n["resources"]),
+                resources_available=ResourceSet(n["resources"]),
+                labels=n["labels"],
+                conn=None,
+                alive=True,
+                last_heartbeat=now,
+            )
+        self.actors.update(st["actors"])
+        self.named_actors.update(st["named_actors"])
+        self.jobs.update(st["jobs"])
+        self.kv.update(st["kv"])
+        self.placement_groups.update(st["placement_groups"])
+        self.named_pgs.update(st["named_pgs"])
+        # A PENDING actor's creating client must re-drive creation itself
+        # (its conn died with us); mid-restart actors get their restart
+        # replayed once nodes have had a chance to re-register.  Leases
+        # are NOT checkpointed and the lease-id counter restarts, so any
+        # restored lease_id is stale — scrub it (a fresh synthetic lease
+        # is attached when the hosting raylet re-registers).
+        to_replay = []
+        for a in self.actors.values():
+            a.lease_id = None
+            if a.state == ACTOR_PENDING:
+                a.state = ACTOR_DEAD
+                a.death_cause = "GCS restarted during creation"
+            elif a.state == ACTOR_RESTARTING:
+                to_replay.append(a)
+        # Re-derive per-node available resources: nothing holds leases
+        # across a restart, but CREATED placement groups keep their
+        # bundle reservations (re-debited in rpc_register_node).
+        for pg in self.placement_groups.values():
+            if pg.state == PG_PENDING:
+                self._pending_pgs.append(pg.pg_id)
+        if to_replay:
+            async def _replay():
+                await asyncio.sleep(cfg.node_death_timeout_s)
+                for a in to_replay:
+                    if a.state == ACTOR_RESTARTING:
+                        await self._restart_actor(a, "GCS restart replay")
+
+            # keep a strong ref: the loop holds tasks weakly and a
+            # GC'd task would silently drop the replay
+            self._replay_task = asyncio.get_running_loop().create_task(
+                _replay()
+            )
+        logger.info(
+            "GCS state restored: %d nodes, %d actors, %d PGs, %d kv keys",
+            len(self.nodes), len(self.actors),
+            len(self.placement_groups), len(self.kv),
+        )
+
     # ---- lifecycle -----------------------------------------------------
     async def start(self):
+        if self.checkpoint is not None:
+            st = self.checkpoint.load()
+            if st:
+                self._restore_state(st)
+            ost = self.checkpoint_objects.load()
+            if ost:
+                self._restore_object_state(ost)
         await self.server.start()
         self._health_task = asyncio.get_running_loop().create_task(
             self._health_loop()
@@ -257,6 +497,10 @@ class GcsServer:
     async def close(self):
         if self._health_task:
             self._health_task.cancel()
+        if self.checkpoint is not None:
+            self.checkpoint.flush()
+        if self.checkpoint_objects is not None:
+            self.checkpoint_objects.flush()
         await self.server.close()
 
     @property
@@ -268,7 +512,15 @@ class GcsServer:
         fn = getattr(self, f"rpc_{method}", None)
         if fn is None:
             raise rpc.RpcError(f"GCS: unknown method {method!r}")
-        return await fn(conn, p)
+        result = await fn(conn, p)
+        if method in _OBJECT_RPCS:
+            if self.checkpoint_objects is not None:
+                self.checkpoint_objects.mark_dirty()
+        elif method not in _READONLY_RPCS:
+            self._mark_dirty()
+            if method in _CRITICAL_RPCS and self.checkpoint is not None:
+                self.checkpoint.flush()
+        return result
 
     def _conn_closed(self, conn: rpc.Connection):
         loop = asyncio.get_event_loop()
@@ -278,12 +530,16 @@ class GcsServer:
         # release leases held by a disconnected submitter
         for lease_id in list(self._conn_leases.pop(conn, ())):
             await self._release_lease(lease_id)
-        # node connection lost -> node death
+        # node connection lost -> node death, unless the raylet already
+        # re-registered over a NEWER connection (half-open TCP: the stale
+        # server-side socket can outlive the replacement)
         node_id = self._conn_node.pop(conn, None)
         if node_id is not None:
-            await self._on_node_death(node_id, "raylet connection lost")
+            node = self.nodes.get(node_id)
+            if node is None or node.conn is conn or node.conn is None:
+                await self._on_node_death(node_id, "raylet connection lost")
         job_id = self._conn_job.pop(conn, None)
-        if job_id is not None:
+        if job_id is not None and job_id not in self._conn_job.values():
             await self._on_job_finished(job_id)
         # orphaned creations: a PENDING actor whose creating client is gone
         # will never receive actor_started — fail it now
@@ -309,6 +565,9 @@ class GcsServer:
                     await self._on_node_death(node.node_id, "heartbeat timeout")
 
     async def _on_node_death(self, node_id: NodeID, reason: str):
+        self._mark_dirty()
+        if self.checkpoint is not None:
+            self.checkpoint.flush()
         node = self.nodes.get(node_id)
         if not node or not node.alive:
             return
@@ -391,6 +650,70 @@ class GcsServer:
             labels=p.get("labels", {}),
             conn=conn,
         )
+        # Re-registration (GCS restarted, raylet re-attaching): the fresh
+        # available pool must re-absorb reservations that survive a
+        # restart — CREATED/RESCHEDULING placement-group bundles placed on
+        # this node, and the resources of restored ALIVE actors still
+        # running here.  (Plain task leases die with the GCS; their
+        # workers are reclaimed by the raylet's idle reaper.)
+        for pg in self.placement_groups.values():
+            if pg.state == PG_REMOVED:
+                continue
+            for bi, bnode in enumerate(pg.bundle_nodes):
+                if bnode == node_id:
+                    entry.resources_available = (
+                        entry.resources_available.subtract(pg.bundles[bi])
+                    )
+        for actor in self.actors.values():
+            if (
+                actor.state in (ACTOR_ALIVE, ACTOR_RESTARTING)
+                and actor.node_id == node_id
+                and actor.lease_id is None
+            ):
+                # synthesize the lease the old GCS held, so the actor's
+                # capacity is debited now and refunded on its death
+                lease_id = next(self._lease_ids)
+                sched = actor.scheduling or {}
+                pg_ref = None
+                if sched.get("type") == "placement_group":
+                    pgid = PlacementGroupID.from_hex(sched["pg_id"])
+                    pg = self.placement_groups.get(pgid)
+                    if pg is not None:
+                        bi = sched.get("bundle_index", -1)
+                        if bi is None or bi < 0:
+                            bi = next(
+                                (
+                                    i
+                                    for i, bn in enumerate(pg.bundle_nodes)
+                                    if bn == node_id
+                                ),
+                                None,
+                            )
+                        if bi is not None:
+                            pg_ref = (pgid, bi)
+                res = ResourceSet(actor.resources)
+                if pg_ref is None:
+                    # bundle draws persisted inside bundle_available; only
+                    # non-PG actors debit the node pool directly
+                    entry.resources_available = (
+                        entry.resources_available.subtract(res)
+                    )
+                self.leases[lease_id] = LeaseEntry(
+                    lease_id=lease_id,
+                    node_id=node_id,
+                    worker_id=WorkerID.nil(),
+                    worker_addr=actor.worker_addr or "",
+                    resources=res,
+                    client_conn=_GCS_SELF_CONN,
+                    actor_id=actor.actor_id,
+                    pg_ref=pg_ref,
+                )
+                actor.lease_id = lease_id
+        # drop a stale conn mapping from a previous connection so its
+        # eventual close is not mistaken for a node death
+        for old_conn, nid in list(self._conn_node.items()):
+            if nid == node_id and old_conn is not conn:
+                del self._conn_node[old_conn]
         self.nodes[node_id] = entry
         self._conn_node[conn] = node_id
         await self.publish(
@@ -415,7 +738,8 @@ class GcsServer:
             {
                 "node_id": n.node_id.hex(),
                 "address": n.address,
-                "alive": n.alive,
+                # a restored-but-unattached node is not usable yet
+                "alive": n.alive and n.conn is not None,
                 "resources_total": n.resources_total.to_dict(),
                 "resources_available": n.resources_available.to_dict(),
                 "labels": n.labels,
@@ -434,12 +758,20 @@ class GcsServer:
 
     # ---- jobs ----------------------------------------------------------
     async def rpc_register_job(self, conn, p):
-        job_id = JobID.random()
-        self.jobs[job_id] = {
-            "state": "RUNNING",
-            "start_time": time.time(),
-            "driver_pid": p.get("pid"),
-        }
+        if p.get("job_id"):
+            # driver re-attaching after a GCS restart keeps its identity so
+            # actor/object ownership and namespaces stay coherent
+            job_id = JobID(p["job_id"])
+            entry = self.jobs.get(job_id) or {"start_time": time.time()}
+            entry.update({"state": "RUNNING", "driver_pid": p.get("pid")})
+            self.jobs[job_id] = entry
+        else:
+            job_id = JobID.random()
+            self.jobs[job_id] = {
+                "state": "RUNNING",
+                "start_time": time.time(),
+                "driver_pid": p.get("pid"),
+            }
         self._conn_job[conn] = job_id
         return {"job_id": job_id.binary()}
 
@@ -514,6 +846,7 @@ class GcsServer:
         return True
 
     async def _free_object(self, oid: bytes):
+        self._mark_objects_dirty()
         locs = self.object_locations.pop(oid, set())
         self.object_sizes.pop(oid, None)
         self.object_holders.pop(oid, None)
@@ -578,6 +911,7 @@ class GcsServer:
 
     def _scrub_holder(self, holder: bytes):
         """A process died: remove it from every holder set."""
+        self._mark_objects_dirty()
         for oid, s in list(self.object_holders.items()):
             if holder in s:
                 s.discard(holder)
@@ -600,7 +934,11 @@ class GcsServer:
         (The reference does this with a 2-phase prepare/commit across
         raylets — bundle_scheduling_policy.cc; here one atomic pass.)
         """
-        alive = {n.node_id: n for n in self.nodes.values() if n.alive}
+        alive = {
+            n.node_id: n
+            for n in self.nodes.values()
+            if n.alive and n.conn is not None
+        }
         avail = {nid: n.resources_available for nid, n in alive.items()}
         missing = [i for i in range(len(pg.bundles)) if pg.bundle_nodes[i] is None]
         used: Set[NodeID] = {nid for nid in pg.bundle_nodes if nid is not None}
@@ -673,6 +1011,11 @@ class GcsServer:
 
     async def rpc_create_placement_group(self, conn, p):
         pg_id = PlacementGroupID(p["pg_id"])
+        existing = self.placement_groups.get(pg_id)
+        if existing is not None and existing.state != PG_REMOVED:
+            # retry of a create that already landed (checkpoint flushed,
+            # GCS crashed before the reply) — idempotent success
+            return {"state": existing.state}
         strategy = p.get("strategy", "PACK")
         if strategy not in PG_STRATEGIES:
             raise rpc.RpcError(f"unknown placement strategy {strategy!r}")
@@ -825,7 +1168,8 @@ class GcsServer:
         for i in cands:
             nid = pg.bundle_nodes[i]
             node = self.nodes.get(nid) if nid else None
-            if node and node.alive and pg.bundle_available[i].covers(demand):
+            if (node and node.alive and node.conn is not None
+                    and pg.bundle_available[i].covers(demand)):
                 return await self._grant_lease(
                     node, demand, conn, p, pg_ref=(pg.pg_id, i)
                 )
@@ -1174,6 +1518,7 @@ class GcsServer:
         return True
 
     async def _kill_actor(self, actor: ActorEntry, reason: str, no_restart: bool):
+        self._mark_dirty()
         if actor.state == ACTOR_DEAD:
             return
         actor.state = ACTOR_DEAD
@@ -1222,6 +1567,7 @@ class GcsServer:
             await self._kill_actor(actor, reason, no_restart=True)
 
     async def _restart_actor(self, actor: ActorEntry, reason: str):
+        self._mark_dirty()
         """GCS-driven actor restart: lease a fresh worker, replay creation."""
         try:
             demand = ResourceSet(actor.resources)
@@ -1356,13 +1702,17 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--session-dir", default=None,
+                    help="enables checkpoint persistence / restart recovery")
     args = ap.parse_args()
 
     logging.basicConfig(level=logging.INFO,
                         format="[gcs] %(levelname)s %(message)s")
 
     async def run():
-        gcs = GcsServer(host=args.host, port=args.port)
+        gcs = GcsServer(
+            host=args.host, port=args.port, session_dir=args.session_dir
+        )
         await gcs.start()
         # report the bound address to the parent on stdout
         print(f"GCS_ADDRESS={gcs.address}", flush=True)

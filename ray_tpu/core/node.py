@@ -68,11 +68,15 @@ class NodeProcessGroup:
                     proc.kill()
 
 
-def start_gcs(session_dir: str, host: str = "127.0.0.1") -> tuple:
+def start_gcs(session_dir: str, host: str = "127.0.0.1", port: int = 0) -> tuple:
     os.makedirs(session_dir, exist_ok=True)
     log = open(os.path.join(session_dir, "gcs.log"), "ab")
     proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu.core.gcs", "--host", host],
+        [
+            sys.executable, "-m", "ray_tpu.core.gcs",
+            "--host", host, "--port", str(port),
+            "--session-dir", session_dir,
+        ],
         stdout=subprocess.PIPE,
         stderr=log,
         env=_control_plane_env(),

@@ -92,9 +92,15 @@ class Raylet:
             os.unlink(self.store_path)
         self.store = ShmStore(self.store_path, self.store_capacity, create=True)
         await self.server.start()
-        self.gcs = await rpc.connect(
+        # Reconnecting channel: a GCS crash/restart no longer kills the
+        # node — the raylet re-dials, re-registers (same node_id), and the
+        # GCS restores cluster state from its checkpoint (gcs.py
+        # CheckpointStore).  Workers and their direct client connections
+        # keep running through the outage.
+        self.gcs = rpc.ReconnectingConnection(
             self.gcs_address, self._handle, name="raylet->gcs",
-            on_close=self._on_gcs_lost,
+            on_reconnect=self._register_with_gcs,
+            on_give_up=self._on_gcs_lost,
         )
         await self.gcs.call(
             "register_node",
@@ -116,9 +122,25 @@ class Raylet:
             self.node_id, self.server.address, self.store_path, self.store_capacity,
         )
 
-    def _on_gcs_lost(self, conn):
+    async def _register_with_gcs(self, conn):
+        """Re-attach to a reborn GCS over a fresh connection."""
+        await conn.call(
+            "register_node",
+            {
+                "node_id": self.node_id.binary(),
+                "address": self.server.address,
+                "resources": self.resources,
+                "labels": self.labels,
+            },
+        )
+        logger.info("raylet %s re-registered with GCS", self.node_id)
+
+    def _on_gcs_lost(self):
         if not self._closing:
-            logger.error("raylet %s lost GCS connection; shutting down", self.node_id)
+            logger.error(
+                "raylet %s: GCS unreachable past the reconnect budget; "
+                "shutting down", self.node_id,
+            )
             for w in self.workers.values():
                 w.proc.terminate()
             os._exit(1)

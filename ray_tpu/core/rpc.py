@@ -285,6 +285,131 @@ class Server:
             await conn.close()
 
 
+class ReconnectingConnection:
+    """A call/notify channel that survives peer restarts.
+
+    Wraps a `Connection` to `address`; when the underlying connection is
+    lost (peer crashed or restarted), calls block while a new connection
+    is dialed with backoff, `on_reconnect(conn)` re-registers this client
+    with the reborn peer, and the call is retried — up to
+    `max_downtime_s` of cumulative downtime, after which ConnectionLost
+    propagates.  This is the client half of GCS fault tolerance (ray:
+    gcs_rpc_client.h reconnection + gcs_client resubscribe behavior):
+    servers persist their tables; clients re-attach and replay identity.
+
+    Retried calls must be idempotent — true for the control-plane verbs
+    used over this channel (registrations, kv, lookups, notifies).
+    """
+
+    def __init__(
+        self,
+        address: str,
+        handler: Callable[[Connection, str, Any], Awaitable[Any]] = None,
+        name: str = "",
+        on_reconnect: Optional[
+            Callable[[Connection], Awaitable[None]]
+        ] = None,
+        on_give_up: Optional[Callable[[], None]] = None,
+        max_downtime_s: float = None,
+    ):
+        self.address = address
+        self.handler = handler
+        self.name = name
+        self.on_reconnect = on_reconnect
+        self.on_give_up = on_give_up
+        self.max_downtime_s = (
+            cfg.gcs_reconnect_max_downtime_s
+            if max_downtime_s is None
+            else max_downtime_s
+        )
+        self._conn: Optional[Connection] = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+
+    async def _ensure(self) -> Connection:
+        if self._closed:
+            raise ConnectionLost(f"{self.name}: channel closed")
+        conn = self._conn
+        if conn is not None and not conn.closed:
+            return conn
+        async with self._lock:
+            if self._closed:
+                raise ConnectionLost(f"{self.name}: channel closed")
+            if self._conn is not None and not self._conn.closed:
+                return self._conn
+            deadline = (
+                asyncio.get_running_loop().time() + self.max_downtime_s
+            )
+            delay = 0.1
+            first_attempt = self._conn is None
+            while True:
+                conn = None
+                try:
+                    conn = await connect(
+                        self.address, self.handler, name=self.name
+                    )
+                    if self.on_reconnect and not first_attempt:
+                        await self.on_reconnect(conn)
+                    self._conn = conn
+                    return conn
+                except BaseException as e:
+                    # never leak a half-initialized connection (its recv
+                    # loop would keep handling server pushes concurrently
+                    # with the eventually-installed one)
+                    if conn is not None and self._conn is not conn:
+                        try:
+                            await conn.close()
+                        except Exception:
+                            pass
+                    if not isinstance(
+                        e, (OSError, RpcError, asyncio.TimeoutError)
+                    ):
+                        raise
+                    if asyncio.get_running_loop().time() >= deadline:
+                        if self.on_give_up:
+                            self.on_give_up()
+                        raise ConnectionLost(
+                            f"{self.name}: peer at {self.address} unreachable "
+                            f"for {self.max_downtime_s:.0f}s ({e!r})"
+                        ) from e
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+
+    async def call(self, method: str, payload: Any = None, timeout: float = None):
+        while True:
+            conn = await self._ensure()
+            try:
+                return await conn.call(method, payload, timeout=timeout)
+            except ConnectionLost:
+                if self._closed:
+                    raise
+                continue  # _ensure() re-dials with its own deadline
+
+    async def notify(self, method: str, payload: Any = None) -> None:
+        conn = await self._ensure()
+        try:
+            await conn.notify(method, payload)
+        except ConnectionLost:
+            if self._closed:
+                raise
+            conn = await self._ensure()
+            await conn.notify(method, payload)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def current(self) -> Optional[Connection]:
+        """The live underlying Connection, if any (for identity checks)."""
+        return self._conn
+
+    async def close(self):
+        self._closed = True
+        if self._conn is not None:
+            await self._conn.close()
+
+
 async def connect(
     address: str,
     handler: Callable[[Connection, str, Any], Awaitable[Any]] = None,

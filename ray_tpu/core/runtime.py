@@ -226,8 +226,11 @@ class Runtime:
         self._run(self._connect(), timeout=cfg.rpc_connect_timeout_s + 5)
 
     async def _connect(self):
-        self.gcs = await rpc.connect(
-            self.gcs_address, self._gcs_handler, name=f"{self.mode}->gcs"
+        # Reconnecting channel: survives GCS restarts (the GCS restores
+        # its tables from the checkpoint; we re-register our identity).
+        self.gcs = rpc.ReconnectingConnection(
+            self.gcs_address, self._gcs_handler, name=f"{self.mode}->gcs",
+            on_reconnect=self._reattach_gcs,
         )
         self.raylet = await rpc.connect(
             self.raylet_address, name=f"{self.mode}->raylet"
@@ -238,6 +241,16 @@ class Runtime:
         if self.mode == "driver":
             reply = await self.gcs.call("register_job", {"pid": os.getpid()})
             self.job_id = JobID(reply["job_id"])
+
+    async def _reattach_gcs(self, conn):
+        await conn.call(
+            "register_worker", {"worker_id": self.worker_id.binary()}
+        )
+        if self.mode == "driver" and self.job_id is not None:
+            await conn.call(
+                "register_job",
+                {"pid": os.getpid(), "job_id": self.job_id.binary()},
+            )
 
     async def _gcs_handler(self, conn, method, payload):
         # GCS-initiated pushes (actor restarts target workers; pubsub)
