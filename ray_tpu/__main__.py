@@ -1,0 +1,5 @@
+"""`python -m ray_tpu <cmd>` — the CLI entrypoint (scripts.py)."""
+
+from ray_tpu.scripts import main
+
+main()

@@ -1,0 +1,24 @@
+"""Autoscaler: elastic nodes driven by pending demand.
+
+Role-equivalent of the reference's autoscaler v2 (ray:
+python/ray/autoscaler/v2/scheduler.py:624, instance_manager/
+reconciler.py:53) collapsed to the TPU shape of the problem: node types
+are slice shapes, demand is pending leases + unplaced placement-group
+bundles read straight from the GCS, and the reconcile loop is a single
+bin-packing pass — no instance-manager state machine, because TPU slice
+provisioning is a single create/delete call per node.
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import (
+    LocalSubprocessProvider,
+    NodeProvider,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "NodeTypeConfig",
+    "NodeProvider",
+    "LocalSubprocessProvider",
+]

@@ -1,0 +1,291 @@
+"""The reconcile loop: pending demand -> node launches, idle -> drains.
+
+Role-equivalent of the reference's autoscaler v2 scheduler + reconciler
+(ray: python/ray/autoscaler/v2/scheduler.py:624 ResourceDemandScheduler,
+instance_manager/reconciler.py:53) in one deliberate pass:
+
+    demand  = pending leases + unplaced PG bundles       (from the GCS)
+    supply  = running nodes + launches still registering
+    plan    = first-fit-decreasing bin-pack of unmet demand onto the
+              cheapest node types that fit (STRICT_PACK bundles must
+              land whole on one node — a slice shape)
+    action  = launch plan nodes; drain nodes idle > idle_timeout over
+              their type's min_workers
+
+TPU framing: a node type IS a slice shape ({"TPU": 4, "CPU": 8},
+label generation=v5e), so "scale up for this STRICT_PACK PG" means
+"allocate another slice of that shape".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.common.resources import ResourceSet
+from ray_tpu.core import rpc
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 100
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: List[NodeTypeConfig]
+    idle_timeout_s: float = 60.0
+    interval_s: float = 1.0
+    max_launch_batch: int = 8
+
+
+class Autoscaler:
+    """Drives a NodeProvider from GCS demand.  Run via `start()` inside
+    an asyncio loop (the monitor process) or step manually with
+    `reconcile()` (tests)."""
+
+    def __init__(self, gcs_address: str, provider, config: AutoscalerConfig):
+        self.gcs_address = gcs_address
+        self.provider = provider
+        self.config = config
+        self.gcs: Optional[rpc.ReconnectingConnection] = None
+        self._idle_since: Dict[str, float] = {}  # node_id_hex -> ts
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    async def start(self):
+        self.gcs = rpc.ReconnectingConnection(
+            self.gcs_address, name="autoscaler->gcs"
+        )
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self):
+        self._stopped = True
+        if self._task:
+            self._task.cancel()
+        if self.gcs:
+            await self.gcs.close()
+
+    async def _loop(self):
+        while not self._stopped:
+            try:
+                await self.reconcile()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("autoscaler reconcile failed")
+            await asyncio.sleep(self.config.interval_s)
+
+    # ---- the pass ------------------------------------------------------
+
+    async def reconcile(self):
+        state = await self.gcs.call("get_autoscaler_state", {})
+        demands = self._unmet_demands(state)
+        launches = self._plan_launches(demands, state)
+        for node_type in launches:
+            tc = self._type(node_type)
+            self.provider.create_node(node_type, tc.resources, tc.labels)
+        await self._drain_idle(state)
+
+    def _type(self, name: str) -> NodeTypeConfig:
+        for tc in self.config.node_types:
+            if tc.name == name:
+                return tc
+        raise KeyError(name)
+
+    def _unmet_demands(self, state) -> List[ResourceSet]:
+        """Demand that existing capacity cannot absorb.
+
+        PG bundles are gang demand: each unplaced bundle is one unit that
+        must fit whole on some node (matches the GCS's per-bundle atomic
+        placement).  Pending leases are singles.
+        """
+        free = [
+            ResourceSet(n["resources_available"])
+            for n in state["nodes"]
+            if n["alive"]
+        ]
+        # launches still registering count as supply, or every reconcile
+        # pass while a node boots would launch another copy
+        registered = {n["node_id"] for n in state["nodes"] if n["alive"]}
+        for pn in self.provider.non_terminated_nodes():
+            if pn.node_id_hex not in registered:
+                free.append(ResourceSet(self._type(pn.node_type).resources))
+        unmet: List[ResourceSet] = []
+
+        def absorb(demand: ResourceSet) -> bool:
+            for i, f in enumerate(free):
+                if f.covers(demand):
+                    free[i] = f.subtract(demand)
+                    return True
+            return False
+
+        units: List[ResourceSet] = []
+        for pl in state["pending_leases"]:
+            units.append(ResourceSet(pl["demand"]))
+        for pgb in state["pending_pg_bundles"]:
+            units.extend(ResourceSet(b) for b in pgb["bundles"])
+        # big first: gang bundles should claim fresh nodes before smalls
+        units.sort(key=lambda r: -sum(r.to_dict().values()))
+        for d in units:
+            if not absorb(d):
+                unmet.append(d)
+        return unmet
+
+    def _plan_launches(self, unmet: List[ResourceSet], state) -> List[str]:
+        """First-fit-decreasing onto the smallest node type that fits."""
+        if not unmet:
+            return self._min_workers_topup(state)
+        counts = self._current_counts(state)
+        plan: List[str] = []
+        # virtual free pools of nodes we are about to launch
+        virtual: List[ResourceSet] = []
+
+        types_small_first = sorted(
+            self.config.node_types,
+            key=lambda t: sum(t.resources.values()),
+        )
+        for d in unmet:
+            placed = False
+            for i, f in enumerate(virtual):
+                if f.covers(d):
+                    virtual[i] = f.subtract(d)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for tc in types_small_first:
+                full = ResourceSet(tc.resources)
+                if not full.covers(d):
+                    continue
+                if counts.get(tc.name, 0) >= tc.max_workers:
+                    continue
+                if len(plan) >= self.config.max_launch_batch:
+                    break
+                plan.append(tc.name)
+                counts[tc.name] = counts.get(tc.name, 0) + 1
+                virtual.append(full.subtract(d))
+                placed = True
+                break
+            if not placed and not any(
+                ResourceSet(t.resources).covers(d)
+                for t in self.config.node_types
+            ):
+                logger.warning(
+                    "demand %s fits no configured node type", d.to_dict()
+                )
+        return plan + self._min_workers_topup(state, counts)
+
+    def _min_workers_topup(self, state, counts=None) -> List[str]:
+        counts = counts if counts is not None else self._current_counts(state)
+        plan = []
+        for tc in self.config.node_types:
+            have = counts.get(tc.name, 0)
+            for _ in range(max(0, tc.min_workers - have)):
+                plan.append(tc.name)
+                counts[tc.name] = counts.get(tc.name, 0) + 1
+        return plan
+
+    def _current_counts(self, state) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for pn in self.provider.non_terminated_nodes():
+            counts[pn.node_type] = counts.get(pn.node_type, 0) + 1
+        return counts
+
+    async def _drain_idle(self, state):
+        now = time.monotonic()
+        idle_ids = set()
+        for n in state["nodes"]:
+            if not n["alive"]:
+                continue
+            if n["idle"]:
+                idle_ids.add(n["node_id"])
+                self._idle_since.setdefault(n["node_id"], now)
+            else:
+                self._idle_since.pop(n["node_id"], None)
+        # drop tracking for nodes that disappeared
+        for nid in list(self._idle_since):
+            if nid not in idle_ids:
+                self._idle_since.pop(nid)
+        counts = self._current_counts(state)
+        for pn in self.provider.non_terminated_nodes():
+            nid = pn.node_id_hex
+            since = self._idle_since.get(nid)
+            if since is None or now - since < self.config.idle_timeout_s:
+                continue
+            tc = self._type(pn.node_type)
+            if counts.get(pn.node_type, 0) <= tc.min_workers:
+                continue
+            logger.info("draining idle node %s (%s)", nid, pn.node_type)
+            try:
+                await self.gcs.call("drain_node", {"node_id": nid})
+            except Exception:
+                logger.exception("drain_node rpc failed")
+            self.provider.terminate_node(pn)
+            counts[pn.node_type] -= 1
+            self._idle_since.pop(nid, None)
+
+
+def main():
+    """Monitor entrypoint: `python -m ray_tpu.autoscaler.autoscaler
+    --gcs HOST:PORT --node-type name=CPU:4,TPU:0:min=0:max=10 ...`"""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gcs", required=True)
+    ap.add_argument("--session-dir", required=True)
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--idle-timeout", type=float, default=60.0)
+    ap.add_argument(
+        "--node-type", action="append", default=[],
+        help='"name=v5e_slice4;resources=CPU:8,TPU:4;min=0;max=8"',
+    )
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[autoscaler] %(levelname)s %(message)s")
+
+    node_types = []
+    for spec in args.node_type:
+        fields = dict(f.split("=", 1) for f in spec.split(";"))
+        resources = {
+            k: float(v)
+            for k, v in (kv.split(":") for kv in fields["resources"].split(","))
+        }
+        node_types.append(
+            NodeTypeConfig(
+                fields["name"],
+                resources,
+                int(fields.get("min", 0)),
+                int(fields.get("max", 100)),
+            )
+        )
+
+    from ray_tpu.autoscaler.node_provider import LocalSubprocessProvider
+
+    provider = LocalSubprocessProvider(args.gcs, args.session_dir)
+    cfg = AutoscalerConfig(
+        node_types=node_types,
+        idle_timeout_s=args.idle_timeout,
+        interval_s=args.interval,
+    )
+
+    async def run():
+        a = Autoscaler(args.gcs, provider, cfg)
+        await a.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,8 @@ D("node_death_timeout_s", float, 10.0)
 D("gcs_reconnect_max_downtime_s", float, 60.0)
 # debounce for GCS snapshot flushes (fault-tolerance checkpoint)
 D("gcs_checkpoint_debounce_s", float, 0.05)
+# how often each process ships its util.metrics registry to the GCS
+D("metrics_push_interval_s", float, 5.0)
 
 # --- object store ---
 D("object_store_bytes", int, 0)  # 0 = auto (30% of /dev/shm free, capped)

@@ -57,6 +57,7 @@ class NodeEntry:
     labels: Dict[str, str]
     conn: rpc.Connection
     alive: bool = True
+    draining: bool = False  # drain requested: stop scheduling onto it
     last_heartbeat: float = field(default_factory=time.monotonic)
 
 
@@ -167,6 +168,7 @@ class Scheduler:
         if stype == "node_affinity":
             node = self.gcs.nodes.get(NodeID.from_hex(strategy["node_id"]))
             if (node and node.alive and node.conn is not None
+                    and not node.draining
                     and node.resources_available.covers(demand)):
                 return node
             if node and strategy.get("soft", False):
@@ -179,7 +181,7 @@ class Scheduler:
             for n in self.gcs.nodes.values()
             # conn=None: checkpoint-restored node whose raylet has not
             # re-attached yet — known, but not schedulable
-            if n.alive and n.conn is not None
+            if n.alive and n.conn is not None and not n.draining
             and n.resources_available.covers(demand)
         ]
         if not candidates:
@@ -287,12 +289,15 @@ _CRITICAL_RPCS = frozenset({
     "register_node", "register_job", "kv_put", "kv_del",
 })
 
-#: rpc methods that never mutate GCS state (no checkpoint after these)
+#: rpc methods that never mutate durable GCS state (no checkpoint after
+#: these; metrics are ephemeral by design)
 _READONLY_RPCS = frozenset({
     "get_nodes", "cluster_resources", "kv_get", "kv_exists", "kv_keys",
     "get_object_locations", "get_actor", "list_actors", "heartbeat",
     "get_placement_group", "list_placement_groups",
     "wait_placement_group_ready", "ping", "subscribe", "unsubscribe",
+    "get_autoscaler_state", "list_tasks", "list_objects",
+    "metrics_push", "get_metrics",
 })
 
 
@@ -354,6 +359,8 @@ class GcsServer:
         self._worker_conns: Dict[WorkerID, rpc.Connection] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._start_time = time.time()
+        # observability: reporter id -> latest metric snapshot
+        self.metrics_by_reporter: Dict[str, dict] = {}
 
     # ---- persistence ---------------------------------------------------
     def _mark_dirty(self):
@@ -664,6 +671,15 @@ class GcsServer:
                     entry.resources_available = (
                         entry.resources_available.subtract(pg.bundles[bi])
                     )
+        # transient reconnect (GCS never restarted): live leases on this
+        # node are still tracked and their debits must carry over — bundle
+        # draws (pg_ref) live inside bundle_available and must not debit
+        # the node pool twice
+        for lease in self.leases.values():
+            if lease.node_id == node_id and lease.pg_ref is None:
+                entry.resources_available = (
+                    entry.resources_available.subtract(lease.resources)
+                )
         for actor in self.actors.values():
             if (
                 actor.state in (ACTOR_ALIVE, ACTOR_RESTARTING)
@@ -937,7 +953,7 @@ class GcsServer:
         alive = {
             n.node_id: n
             for n in self.nodes.values()
-            if n.alive and n.conn is not None
+            if n.alive and n.conn is not None and not n.draining
         }
         avail = {nid: n.resources_available for nid, n in alive.items()}
         missing = [i for i in range(len(pg.bundles)) if pg.bundle_nodes[i] is None]
@@ -1135,6 +1151,126 @@ class GcsServer:
 
     async def rpc_list_placement_groups(self, conn, p):
         return [self._pg_info(pg) for pg in self.placement_groups.values()]
+
+    async def rpc_list_tasks(self, conn, p):
+        """Cluster-wide live tasks: fan out to raylets → workers (ray:
+        python/ray/util/state/api.py list_tasks, sourced live instead of
+        from an event store)."""
+        out = []
+        for n in list(self.nodes.values()):
+            if not n.alive or n.conn is None:
+                continue
+            try:
+                out.extend(
+                    await n.conn.call("list_worker_tasks", {}, timeout=10.0)
+                )
+            except Exception:
+                continue
+        return out
+
+    async def rpc_list_objects(self, conn, p):
+        """Object directory view (id, size, locations, holder count)."""
+        limit = p.get("limit", 1000)
+        out = []
+        for oid, nodes in list(self.object_locations.items())[:limit]:
+            out.append({
+                "object_id": oid.hex(),
+                "size_bytes": self.object_sizes.get(oid),
+                "locations": [n.hex() for n in nodes],
+                "num_holders": len(self.object_holders.get(oid, ())),
+            })
+        return out
+
+    async def rpc_metrics_push(self, conn, p):
+        """A process pushes its metric snapshot (ray: stats exporter →
+        dashboard agent; here straight into the GCS aggregate table)."""
+        self.metrics_by_reporter[p["reporter"]] = {
+            "ts": time.time(),
+            "metrics": p["metrics"],
+        }
+        return True
+
+    async def rpc_get_metrics(self, conn, p):
+        """Aggregated metrics: counters/histogram buckets sum across
+        reporters, gauges keep per-reporter last values."""
+        agg: Dict[str, Any] = {}
+        for reporter, snap in self.metrics_by_reporter.items():
+            for m in snap["metrics"]:
+                key = m["name"]
+                ent = agg.setdefault(
+                    key,
+                    {"name": key, "type": m["type"],
+                     "description": m.get("description", ""),
+                     "series": {}},
+                )
+                for tags_key, value in m["series"].items():
+                    if m["type"] == "gauge":
+                        ent["series"][f"{reporter}|{tags_key}"] = value
+                    else:
+                        ent["series"][tags_key] = (
+                            ent["series"].get(tags_key, 0) + value
+                        )
+        return list(agg.values())
+
+    async def rpc_get_autoscaler_state(self, conn, p):
+        """Demand/usage view for the autoscaler's reconcile loop (ray:
+        autoscaler/v2 GetClusterResourceState — scheduler.py:624)."""
+        pending = [
+            {"demand": pl.demand.to_dict(), "strategy": pl.strategy,
+             "age_s": time.monotonic() - pl.enqueued_at}
+            for pl in self.scheduler.pending
+        ]
+        pending_bundles = []
+        for pg in self.placement_groups.values():
+            if pg.state in (PG_PENDING, PG_RESCHEDULING):
+                pending_bundles.append({
+                    "pg_id": pg.pg_id.hex(),
+                    "strategy": pg.strategy,
+                    "bundles": [
+                        pg.bundles[i].to_dict()
+                        for i in range(len(pg.bundles))
+                        if pg.bundle_nodes[i] is None
+                    ],
+                })
+        busy_nodes: Set[NodeID] = set()
+        for lease in self.leases.values():
+            busy_nodes.add(lease.node_id)
+        for a in self.actors.values():
+            if a.state in (ACTOR_ALIVE, ACTOR_RESTARTING) and a.node_id:
+                busy_nodes.add(a.node_id)
+        for pg in self.placement_groups.values():
+            if pg.state != PG_REMOVED:
+                busy_nodes.update(n for n in pg.bundle_nodes if n)
+        nodes = [
+            {
+                "node_id": n.node_id.hex(),
+                "alive": n.alive and n.conn is not None,
+                "labels": n.labels,
+                "resources_total": n.resources_total.to_dict(),
+                "resources_available": n.resources_available.to_dict(),
+                "idle": n.node_id not in busy_nodes,
+            }
+            for n in self.nodes.values()
+        ]
+        return {
+            "pending_leases": pending,
+            "pending_pg_bundles": pending_bundles,
+            "nodes": nodes,
+        }
+
+    async def rpc_drain_node(self, conn, p):
+        """Mark a node for shutdown: stop scheduling onto it.  The node
+        stays alive until its raylet actually dies, so _on_node_death can
+        still scrub object locations / leases / actors when the provider
+        terminates it (marking it dead here would skip all of that)."""
+        node = self.nodes.get(NodeID.from_hex(p["node_id"]))
+        if node is None:
+            return False
+        node.draining = True
+        await self.publish(
+            "nodes", {"event": "draining", "node_id": p["node_id"]}
+        )
+        return True
 
     def _pg_bundle_candidates(
         self, pg: PlacementGroupEntry, idx: int, demand: ResourceSet

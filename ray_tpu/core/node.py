@@ -135,6 +135,12 @@ def _control_plane_env() -> dict:
     """Control-plane processes must never touch the TPU (one process owns the
     chips); pin them to CPU-only jax in case anything imports it."""
     env = dict(os.environ)
+    # remember the accelerator platform so TPU-leased workers can be
+    # pointed back at it (raylet _accel_env_for); control-plane processes
+    # themselves must never touch the TPU
+    env.setdefault(
+        "RT_TPU_JAX_PLATFORM", os.environ.get("JAX_PLATFORMS") or "tpu"
+    )
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _pythonpath_with_pkg()
     return env

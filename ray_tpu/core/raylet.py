@@ -197,6 +197,23 @@ class Raylet:
             raise rpc.RpcError(f"raylet: unknown method {method!r}")
         return await fn(conn, p)
 
+    async def rpc_list_worker_tasks(self, conn, p):
+        """Live task/actor descriptors from every connected worker
+        (state-API fan-out leg; ray: util/state aggregating from raylets)."""
+        out = []
+        for w in list(self.workers.values()):
+            if w.conn is None or w.conn.closed:
+                continue
+            try:
+                st = await w.conn.call("status", {}, timeout=5.0)
+            except Exception:
+                continue
+            st["worker_id"] = w.worker_id.hex()
+            st["node_id"] = self.node_id.hex()
+            st["leased"] = w.lease_id is not None
+            out.append(st)
+        return out
+
     # ---- worker pool ---------------------------------------------------
     def _spawn_worker(self) -> WorkerEntry:
         worker_id = WorkerID.random()
@@ -261,6 +278,10 @@ class Raylet:
             return {
                 "TPU_VISIBLE_CHIPS": ",".join(map(str, chips)),
                 "_RT_TPU_CHIPS": ",".join(map(str, chips)),
+                # undo the control-plane cpu pin for chip-holding workers
+                "JAX_PLATFORMS": os.environ.get(
+                    "RT_TPU_JAX_PLATFORM", "tpu"
+                ),
             }
         return {"JAX_PLATFORMS": "cpu"}
 
@@ -270,9 +291,47 @@ class Raylet:
             for c in chips.split(","):
                 self._tpu_chips_free.add(int(c))
 
+    def _find_idle_tpu_worker(self, n_tpu: int) -> Optional[WorkerEntry]:
+        """An idle worker already bound to exactly n_tpu chips — reusing
+        it avoids allocating fresh chips (which may all be bound to such
+        idle workers; the old chips stay with the worker by design)."""
+        for pool in self._idle_by_env.values():
+            while pool:
+                cand = pool[-1]
+                if (
+                    cand.proc.poll() is not None
+                    or cand.conn is None
+                    or cand.conn.closed
+                ):
+                    pool.pop()
+                    continue
+                if len(cand.tpu_chips) == n_tpu:
+                    pool.pop()
+                    return cand
+                break  # pools are homogeneous per binding
+        return None
+
     async def rpc_lease_worker(self, conn: rpc.Connection, p):
         """GCS asks for a worker bound to `resources`. Returns its address."""
         resources = p["resources"]
+        n_tpu = int(resources.get("TPU", 0))
+        if n_tpu <= 0 and resources.get("TPU", 0) > 0:
+            n_tpu = 1
+        if n_tpu > 0:
+            # chip-bound reuse must come BEFORE allocation: the free set
+            # may be empty precisely because idle workers hold the chips
+            w = self._find_idle_tpu_worker(n_tpu)
+            if w is not None:
+                w.lease_id = p["lease_id"]
+                return {
+                    "worker_id": w.worker_id.binary(),
+                    "worker_addr": w.addr,
+                    "accelerator_env": {
+                        k: v
+                        for k, v in (w.bound_env or {}).items()
+                        if not k.startswith("_")
+                    },
+                }
         accel_env = self._accel_env_for(resources)
         key = _env_key(accel_env)
         # exact-match idle worker?

@@ -241,6 +241,25 @@ class Runtime:
         if self.mode == "driver":
             reply = await self.gcs.call("register_job", {"pid": os.getpid()})
             self.job_id = JobID(reply["job_id"])
+        self._metrics_task = self._loop.create_task(self._metrics_push_loop())
+
+    async def _metrics_push_loop(self):
+        """Ship this process's util.metrics registry to the GCS
+        periodically (ray: stats exporter role)."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        while not self._closed:
+            await asyncio.sleep(cfg.metrics_push_interval_s)
+            snap = metrics_mod.registry_snapshot()
+            if not snap:
+                continue
+            try:
+                await self.gcs.notify(
+                    "metrics_push",
+                    {"reporter": self.worker_id.hex(), "metrics": snap},
+                )
+            except Exception:
+                pass
 
     async def _reattach_gcs(self, conn):
         await conn.call(
@@ -272,6 +291,9 @@ class Runtime:
         self._closed = True
 
         async def _close():
+            t = getattr(self, "_metrics_task", None)
+            if t is not None:
+                t.cancel()
             for c in list(self._worker_conns.values()):
                 await c.close()
             for c in list(self._actor_conns.values()):

@@ -44,6 +44,7 @@ class WorkerServer:
         self._actor_is_async = False
         self._actor_sem: Optional[asyncio.Semaphore] = None
         self._running_task_threads: Dict[bytes, int] = {}  # task_id -> thread id
+        self._running_tasks: Dict[bytes, dict] = {}  # task_id -> descriptor
         self._cancelled: set = set()
         # Per-caller actor-call ordering state (reference analogue:
         # ActorSchedulingQueue, core_worker/transport/actor_scheduling_queue.h):
@@ -69,6 +70,7 @@ class WorkerServer:
             return await self.handle_create_actor(p)
         if method == "bind_env":
             os.environ.update(p["env"])
+            _apply_jax_platform(p["env"])
             return True
         if method == "cancel_task":
             return self._cancel(p["task_id"])
@@ -78,6 +80,15 @@ class WorkerServer:
             return True
         if method == "ping":
             return {"pid": os.getpid(), "actor": bool(self.actor_instance)}
+        if method == "status":
+            # live task/actor view for the state API (ray: util/state)
+            return {
+                "pid": os.getpid(),
+                "actor_class": type(self.actor_instance).__name__
+                if self.actor_instance is not None
+                else None,
+                "running_tasks": list(self._running_tasks.values()),
+            }
         raise rpc.RpcError(f"worker: unknown method {method!r}")
 
     # ---- normal tasks --------------------------------------------------
@@ -103,6 +114,11 @@ class WorkerServer:
             self._cancelled.discard(tid)
             return self._error_reply(TaskCancelledError("cancelled"), spec)
         self._running_task_threads[tid] = threading.get_ident()
+        self._running_tasks[tid] = {
+            "task_id": tid.hex(),
+            "name": spec.get("name") or "<task>",
+            "start_time": time.time(),
+        }
         try:
             result = fn(*args, **kwargs)
             return self._exec_pack(spec, result)
@@ -114,6 +130,7 @@ class WorkerServer:
             return self._error_reply(e, spec)
         finally:
             self._running_task_threads.pop(tid, None)
+            self._running_tasks.pop(tid, None)
             self._cancelled.discard(tid)
 
     def _exec_pack(self, spec, result) -> dict:
@@ -169,6 +186,7 @@ class WorkerServer:
         spec = p["creation_spec"]
         if p.get("accelerator_env"):
             os.environ.update(p["accelerator_env"])
+            _apply_jax_platform(p["accelerator_env"])
         cls = await self.rt.resolve_fn(spec["cls_hash"])
         args, kwargs = await self.rt.unpack_args(spec["args"])
         self.actor_id = ActorID(p["actor_id"])
@@ -300,11 +318,20 @@ class WorkerServer:
                     reply = self._error_reply(e, spec)
                 else:
                     async with self._actor_sem:
+                        self._running_tasks[tid] = {
+                            "task_id": tid.hex(),
+                            "name": spec.get("name")
+                            or spec.get("method")
+                            or "<async method>",
+                            "start_time": time.time(),
+                        }
                         try:
                             result = await method(*args, **kwargs)
                             reply = self._exec_pack(spec, result)
                         except Exception as e:
                             reply = self._error_reply(e, spec)
+                        finally:
+                            self._running_tasks.pop(tid, None)
             else:
                 reply = await asyncio.get_running_loop().run_in_executor(
                     self._exec, self._execute_sync_method, method, spec
@@ -327,6 +354,11 @@ class WorkerServer:
             self._cancelled.discard(tid)
             return self._error_reply(TaskCancelledError("cancelled"), spec)
         self._running_task_threads[tid] = threading.get_ident()
+        self._running_tasks[tid] = {
+            "task_id": tid.hex(),
+            "name": spec.get("name") or spec.get("method") or "<actor method>",
+            "start_time": time.time(),
+        }
         try:
             args, kwargs = self.rt._run(self.rt.unpack_args(spec["args"]))
             result = method(*args, **kwargs)
@@ -339,6 +371,7 @@ class WorkerServer:
             return self._error_reply(e, spec)
         finally:
             self._running_task_threads.pop(tid, None)
+            self._running_tasks.pop(tid, None)
             self._cancelled.discard(tid)
 
 
@@ -347,10 +380,33 @@ def _exit_soon():
     os._exit(0)
 
 
+def _apply_jax_platform(env: dict) -> None:
+    """Force jax onto the platform the lease assigned.
+
+    JAX_PLATFORMS as an env var is NOT sufficient here: site hooks (e.g.
+    the axon TPU tunnel) can register and force their platform at
+    interpreter start regardless of env, so a CPU-leased worker would
+    still dial the TPU — wedging the single-tenant tunnel for every
+    other process.  jax.config wins over the hook as long as no backend
+    has initialized, which holds until the first array op in this
+    worker.
+    """
+    jp = env.get("JAX_PLATFORMS")
+    if not jp:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", jp)
+    except Exception as e:  # backend already initialized: too late to move
+        logger.warning("could not set jax platform to %r: %s", jp, e)
+
+
 def main():
     logging.basicConfig(
         level=logging.INFO, format="[worker %(process)d] %(levelname)s %(message)s"
     )
+    _apply_jax_platform(os.environ)
     worker_id = WorkerID.from_hex(os.environ["RT_WORKER_ID"])
     raylet_addr = os.environ["RT_RAYLET_ADDR"]
     gcs_addr = os.environ["RT_GCS_ADDR"]

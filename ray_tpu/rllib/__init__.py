@@ -1,9 +1,14 @@
 """ray_tpu.rllib: reinforcement learning on actor rollouts + jax learners.
 
 Role-equivalent of ray: rllib/ — EnvRunner actors sample vectorized gym
-envs; the learner's whole PPO update is one jit'd jax function.
+envs; learners are jit'd jax functions, either in-process (whole update
+one jit) or as a data-parallel LearnerGroup of actors.  Algorithms (PPO,
+DQN) share the Algorithm/AlgorithmConfig skeleton.
 """
 
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.core import MLPModuleConfig  # noqa: F401
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, ReplayBuffer  # noqa: F401
 from ray_tpu.rllib.env_runner import EnvRunnerGroup  # noqa: F401
+from ray_tpu.rllib.learner_group import Learner, LearnerGroup  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae  # noqa: F401

@@ -1,0 +1,150 @@
+"""Algorithm base: the shared train-loop skeleton every algo plugs into.
+
+Role-equivalent of ray: rllib/algorithms/algorithm.py:200 (Algorithm,
+train:818) + algorithm_config.py (AlgorithmConfig builder chain) — cut to
+the functional-jax shape: a subclass provides `default_module_config`
+(network spec from env spaces), `_setup` (learners + runners), and
+`training_step` (one iteration); the base owns iteration bookkeeping,
+metric aggregation, and checkpointing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    """Builder-style config (subclasses add their hyperparameters)."""
+
+    env: Optional[Any] = None  # gym env id or callable returning an env
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 64
+    num_learners: int = 0  # 0 = in-process learner; >=2 = LearnerGroup dp
+    seed: int = 0
+
+    algo_class = None  # set by subclasses
+
+    def environment(self, env):
+        return dataclasses.replace(self, env=env)
+
+    def env_runners(
+        self, num_env_runners=None, num_envs_per_env_runner=None,
+        rollout_fragment_length=None,
+    ):
+        out = self
+        if num_env_runners is not None:
+            out = dataclasses.replace(out, num_env_runners=num_env_runners)
+        if num_envs_per_env_runner is not None:
+            out = dataclasses.replace(
+                out, num_envs_per_runner=num_envs_per_env_runner
+            )
+        if rollout_fragment_length is not None:
+            out = dataclasses.replace(
+                out, rollout_fragment_length=rollout_fragment_length
+            )
+        return out
+
+    def training(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+    def learners(self, num_learners: int):
+        return dataclasses.replace(self, num_learners=num_learners)
+
+    def build(self) -> "Algorithm":
+        assert self.algo_class is not None, "config has no algo_class"
+        return self.algo_class(self)
+
+
+def probe_env_spaces(env) -> Dict[str, int]:
+    """Spin the env up once to read its spaces (ray: Algorithm._get_env_id
+    + spaces inference in env_runner setup)."""
+    import gymnasium as gym
+
+    probe = env() if callable(env) else gym.make(env)
+    spaces = {
+        "obs_dim": int(np.prod(probe.observation_space.shape)),
+        "num_actions": int(probe.action_space.n),
+    }
+    probe.close()
+    return spaces
+
+
+class Algorithm:
+    """Iteration loop + checkpoint plumbing shared by every algorithm."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._total_steps = 0
+        self._recent_returns: List[float] = []
+        self._setup(config)
+
+    # -- subclass hooks --------------------------------------------------
+    def _setup(self, config) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    # -- the loop --------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        metrics = self.training_step()
+        self.iteration += 1
+        out = {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(self._recent_returns))
+                if self._recent_returns
+                else float("nan")
+            ),
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "time_total_s": time.monotonic() - t0,
+        }
+        out.update(metrics)
+        return out
+
+    def _record_returns(self, episode_returns) -> None:
+        self._recent_returns.extend(np.asarray(episode_returns).tolist())
+        self._recent_returns = self._recent_returns[-100:]
+
+    # -- checkpointing (ray: Algorithm.save/restore) ---------------------
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        state = dict(
+            self.get_state(),
+            iteration=self.iteration,
+            total_steps=self._total_steps,
+        )
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.iteration = state.pop("iteration")
+        self._total_steps = state.pop("total_steps")
+        self.set_state(state)
+
+    def stop(self) -> None:
+        group = getattr(self, "env_runner_group", None)
+        if group is not None:
+            group.stop()
+        lg = getattr(self, "learner_group", None)
+        if lg is not None:
+            lg.stop()

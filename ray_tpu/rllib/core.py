@@ -60,3 +60,20 @@ def sample_actions(params: Params, obs, rng):
     logp_all = jax.nn.log_softmax(logits)
     logp = jnp.take_along_axis(logp_all, action[:, None], axis=1)[:, 0]
     return action, logp, value
+
+
+def sample_actions_epsilon(params: Params, obs, rng, epsilon):
+    """ε-greedy over the logits head read as Q-values (DQN inference).
+
+    Same module, different readout: the "pi" head is the Q function and
+    the value slot carries max-Q (useful for diagnostics; unused by the
+    replay path).  Returned logp is 0 — off-policy methods don't use it.
+    """
+    q, _ = forward(params, obs)
+    B, A = q.shape
+    k_pick, k_rand = jax.random.split(rng)
+    greedy = jnp.argmax(q, axis=-1)
+    rand = jax.random.randint(k_rand, (B,), 0, A)
+    explore = jax.random.uniform(k_pick, (B,)) < epsilon
+    action = jnp.where(explore, rand, greedy)
+    return action, jnp.zeros((B,)), q.max(axis=-1)

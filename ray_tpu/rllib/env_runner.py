@@ -33,6 +33,7 @@ class EnvRunnerActor:
         self._rng = jax.random.key(seed + 10_000)
         self._sample_fn = jax.jit(core.sample_actions)
         self._obs, _ = self._envs.reset(seed=seed)
+        self._sample_eps_fn = jax.jit(core.sample_actions_epsilon)
         # per-env running episode returns for metrics
         self._ep_return = np.zeros(num_envs, np.float64)
         self._completed: List[float] = []
@@ -53,9 +54,14 @@ class EnvRunnerActor:
         self._params = params
         return True
 
-    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+    def sample(
+        self, num_steps: int, epsilon: Optional[float] = None
+    ) -> Dict[str, np.ndarray]:
         """Collect a fragment of num_steps per env; returns flat arrays
-        plus bootstrap values for GAE at the fragment boundary."""
+        plus bootstrap values for GAE at the fragment boundary.
+
+        epsilon=None samples the categorical policy (on-policy algos);
+        a float switches to ε-greedy over Q-values (DQN-family)."""
         import jax
 
         B, T = self._num_envs, num_steps
@@ -68,9 +74,15 @@ class EnvRunnerActor:
 
         for t in range(T):
             self._rng, key = jax.random.split(self._rng)
-            action, logp, value = self._sample_fn(
-                self._params, self._obs.astype(np.float32), key
-            )
+            if epsilon is None:
+                action, logp, value = self._sample_fn(
+                    self._params, self._obs.astype(np.float32), key
+                )
+            else:
+                action, logp, value = self._sample_eps_fn(
+                    self._params, self._obs.astype(np.float32), key,
+                    float(epsilon),
+                )
             action = np.asarray(action)
             obs_buf[t] = self._obs
             act_buf[t] = action
@@ -101,6 +113,9 @@ class EnvRunnerActor:
             "logp": logp_buf,
             "values": val_buf,
             "last_values": np.asarray(last_val, np.float32),
+            # the observation AFTER the final step: replay-buffer algos
+            # need next_obs for the fragment tail
+            "final_obs": np.asarray(self._obs, np.float32),
             "episode_returns": np.asarray(episode_returns, np.float64),
         }
 
@@ -123,10 +138,14 @@ class EnvRunnerGroup:
             for i in range(num_runners)
         ]
 
-    def sample(self, num_steps: int) -> List[Dict[str, np.ndarray]]:
+    def sample(
+        self, num_steps: int, epsilon: Optional[float] = None
+    ) -> List[Dict[str, np.ndarray]]:
         # No fixed deadline: the first sample sits behind jax init + compile
         # in the runner; a dead runner fails the get with ActorDiedError.
-        return ray_tpu.get([r.sample.remote(num_steps) for r in self.runners])
+        return ray_tpu.get(
+            [r.sample.remote(num_steps, epsilon) for r in self.runners]
+        )
 
     def sync_weights(self, params) -> None:
         ref = ray_tpu.put(params)  # one copy in the store, N borrowers

@@ -2,33 +2,30 @@
 
 Role-equivalent of ray: rllib/algorithms/ppo/ppo.py (PPOConfig:67,
 PPO:393, training_step:419) + core/learner/learner.py:104 — TPU-first:
-the learner's update is ONE pjit'd function (GAE-advantaged clipped
-surrogate + value + entropy loss, adam, minibatch epochs via lax loops),
-so on a mesh the gradient reduction compiles to ICI collectives instead
-of torch-DDP allreduce (learner_group.py:64).
+the local learner's update is ONE pjit'd function (GAE-advantaged
+clipped surrogate + value + entropy loss, adam, minibatch epochs via lax
+loops), so on a mesh the gradient reduction compiles to ICI collectives.
+With `config.learners(n)` the update runs on a LearnerGroup instead —
+n learner actors doing averaged-gradient data parallelism
+(learner_group.py, the reference's learner_group.py:64 analogue).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 
-import ray_tpu
 from ray_tpu.rllib import core
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, probe_env_spaces
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner_group import Learner, LearnerGroup
 
 
 @dataclasses.dataclass
-class PPOConfig:
-    env: Optional[Any] = None  # gym env id or callable returning an env
-    # rollouts
-    num_env_runners: int = 2
-    num_envs_per_runner: int = 4
-    rollout_fragment_length: int = 64
-    # training
+class PPOConfig(AlgorithmConfig):
     lr: float = 3e-4
     gamma: float = 0.99
     lambda_: float = 0.95
@@ -39,40 +36,38 @@ class PPOConfig:
     minibatch_size: int = 128
     grad_clip: float = 0.5
     hidden: tuple = (64, 64)
-    seed: int = 0
-
-    def environment(self, env) -> "PPOConfig":
-        return dataclasses.replace(self, env=env)
-
-    def env_runners(
-        self, num_env_runners=None, num_envs_per_env_runner=None,
-        rollout_fragment_length=None,
-    ) -> "PPOConfig":
-        out = self
-        if num_env_runners is not None:
-            out = dataclasses.replace(out, num_env_runners=num_env_runners)
-        if num_envs_per_env_runner is not None:
-            out = dataclasses.replace(
-                out, num_envs_per_runner=num_envs_per_env_runner
-            )
-        if rollout_fragment_length is not None:
-            out = dataclasses.replace(
-                out, rollout_fragment_length=rollout_fragment_length
-            )
-        return out
-
-    def training(self, **kw) -> "PPOConfig":
-        return dataclasses.replace(self, **kw)
-
-    def build(self) -> "PPO":
-        return PPO(self)
 
 
-# -- learner ---------------------------------------------------------------
+def ppo_loss(params, batch, config: PPOConfig):
+    """Clipped-surrogate + value + entropy loss on one minibatch."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    logits, values = core.forward(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None], axis=1
+    )[:, 0]
+    ratio = jnp.exp(logp - batch["logp"])
+    adv = batch["advantages"]
+    pg = -jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1 - c.clip_param, 1 + c.clip_param) * adv,
+    ).mean()
+    vf = 0.5 * ((values - batch["returns"]) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = pg + c.vf_coeff * vf - c.entropy_coeff * entropy
+    return total, {
+        "policy_loss": pg,
+        "vf_loss": vf,
+        "entropy": entropy,
+    }
 
 
-class PPOLearner:
-    """Jax learner: whole update (epochs × minibatches) is one jit."""
+class PPOLearner(Learner):
+    """Jax learner: the local whole update (epochs × minibatches) is one
+    jit; compute_grads/apply_grads serve the LearnerGroup dp path."""
 
     def __init__(self, config: PPOConfig, module_config):
         import jax
@@ -87,33 +82,17 @@ class PPOLearner:
         )
         self.opt_state = self.optimizer.init(self.params)
         self._update_fn = jax.jit(self._build_update())
+        self._init_jit()
+
+    def _loss(self, params, batch):
+        return ppo_loss(params, batch, self.config)
 
     def _build_update(self):
         import jax
         import jax.numpy as jnp
+        import optax
 
         c = self.config
-
-        def loss_fn(params, batch):
-            logits, values = core.forward(params, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=1
-            )[:, 0]
-            ratio = jnp.exp(logp - batch["logp"])
-            adv = batch["advantages"]
-            pg = -jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - c.clip_param, 1 + c.clip_param) * adv,
-            ).mean()
-            vf = 0.5 * ((values - batch["returns"]) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pg + c.vf_coeff * vf - c.entropy_coeff * entropy
-            return total, {
-                "policy_loss": pg,
-                "vf_loss": vf,
-                "entropy": entropy,
-            }
 
         def update(params, opt_state, batch, rng):
             n = batch["obs"].shape[0]
@@ -129,13 +108,11 @@ class PPOLearner:
                     sel = jax.lax.dynamic_slice_in_dim(perm, idx * mb, mb)
                     mb_batch = {k: v[sel] for k, v in batch.items()}
                     (_, metrics), grads = jax.value_and_grad(
-                        loss_fn, has_aux=True
+                        self._loss, has_aux=True
                     )(params, mb_batch)
                     updates, opt_state = self.optimizer.update(
                         grads, opt_state, params
                     )
-                    import optax
-
                     params = optax.apply_updates(params, updates)
                     return (params, opt_state), metrics
 
@@ -162,11 +139,6 @@ class PPOLearner:
         )
         return {k: float(v) for k, v in metrics.items()}
 
-    def get_weights(self):
-        import jax
-
-        return jax.tree.map(np.asarray, self.params)
-
 
 def compute_gae(
     rewards, values, dones, last_values, gamma: float, lambda_: float
@@ -186,26 +158,20 @@ def compute_gae(
     return adv, returns
 
 
-# -- the algorithm ---------------------------------------------------------
-
-
-class PPO:
+class PPO(Algorithm):
     """(ray: Algorithm.step:818 / PPO.training_step:419 analogue.)"""
 
-    def __init__(self, config: PPOConfig):
-        import gymnasium as gym
-
-        self.config = config
-        probe = (
-            config.env() if callable(config.env) else gym.make(config.env)
-        )
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
-        probe.close()
+    def _setup(self, config: PPOConfig):
+        spaces = probe_env_spaces(config.env)
         self.module_config = core.MLPModuleConfig(
-            obs_dim=obs_dim, num_actions=num_actions, hidden=config.hidden
+            obs_dim=spaces["obs_dim"],
+            num_actions=spaces["num_actions"],
+            hidden=config.hidden,
         )
-        self.learner = PPOLearner(config, self.module_config)
+        cfg, mc = config, self.module_config
+        self.learner_group = LearnerGroup(
+            lambda: PPOLearner(cfg, mc), num_learners=config.num_learners
+        )
         self.env_runner_group = EnvRunnerGroup(
             config.env,
             self.module_config,
@@ -213,12 +179,10 @@ class PPO:
             num_envs_per_runner=config.num_envs_per_runner,
             seed=config.seed,
         )
-        self.env_runner_group.sync_weights(self.learner.get_weights())
-        self.iteration = 0
-        self._total_steps = 0
-        self._recent_returns: List[float] = []
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self._np_rng = np.random.default_rng(config.seed)
 
-    def train(self) -> Dict[str, Any]:
+    def training_step(self) -> Dict[str, Any]:
         """One training iteration: sample → GAE → update → sync."""
         c = self.config
         t0 = time.monotonic()
@@ -237,8 +201,7 @@ class PPO:
             logps.append(frag["logp"].reshape(-1))
             advs.append(adv.reshape(-1))
             rets.append(ret.reshape(-1))
-            self._recent_returns.extend(frag["episode_returns"].tolist())
-        self._recent_returns = self._recent_returns[-100:]
+            self._record_returns(frag["episode_returns"])
 
         adv_flat = np.concatenate(advs)
         adv_flat = (adv_flat - adv_flat.mean()) / (adv_flat.std() + 1e-8)
@@ -252,54 +215,48 @@ class PPO:
         self._total_steps += len(batch["actions"])
 
         t1 = time.monotonic()
-        metrics = self.learner.update(batch)
+        metrics = self._update(batch)
         learn_time = time.monotonic() - t1
-        self.env_runner_group.sync_weights(self.learner.get_weights())
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
-        self.iteration += 1
         return {
-            "training_iteration": self.iteration,
-            "episode_return_mean": (
-                float(np.mean(self._recent_returns))
-                if self._recent_returns
-                else float("nan")
-            ),
-            "num_env_steps_sampled_lifetime": self._total_steps,
             "env_steps_this_iter": len(batch["actions"]),
             "time_sample_s": sample_time,
             "time_learn_s": learn_time,
             **metrics,
         }
 
-    # -- checkpointing (ray: Algorithm.save/restore) ---------------------
-    def save(self, path: str) -> str:
-        import os
-        import pickle
+    def _update(self, batch) -> Dict[str, float]:
+        if self.learner_group.is_local:
+            # fast path: the whole update is one jit on the local learner
+            return self.learner_group.update(batch)
+        # dp path: epochs × shuffled minibatches, each one averaged-grad
+        # step across the learner replicas
+        c = self.config
+        n = len(batch["actions"])
+        mb = min(c.minibatch_size, n)
+        num_mb = max(1, n // mb)
+        metrics: Dict[str, float] = {}
+        for _ in range(c.num_epochs):
+            perm = self._np_rng.permutation(n)
+            for i in range(num_mb):
+                sel = perm[i * mb:(i + 1) * mb]
+                metrics = self.learner_group.update(
+                    {k: v[sel] for k, v in batch.items()}
+                )
+        return metrics
 
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
-            pickle.dump(
-                {
-                    "params": self.learner.get_weights(),
-                    "opt_state": self.learner.opt_state,
-                    "iteration": self.iteration,
-                    "total_steps": self._total_steps,
-                },
-                f,
-            )
-        return path
+    def get_state(self) -> Dict[str, Any]:
+        state = {"params": self.learner_group.get_weights()}
+        if self.learner_group.is_local:
+            state["opt_state"] = self.learner_group.local.opt_state
+        return state
 
-    def restore(self, path: str) -> None:
-        import os
-        import pickle
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.learner_group.set_weights(state["params"])
+        if self.learner_group.is_local and "opt_state" in state:
+            self.learner_group.local.opt_state = state["opt_state"]
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
-        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
-            state = pickle.load(f)
-        self.learner.params = state["params"]
-        self.learner.opt_state = state["opt_state"]
-        self.iteration = state["iteration"]
-        self._total_steps = state["total_steps"]
-        self.env_runner_group.sync_weights(self.learner.get_weights())
 
-    def stop(self):
-        self.env_runner_group.stop()
+PPOConfig.algo_class = PPO

@@ -1,0 +1,190 @@
+"""CLI: cluster lifecycle + state inspection.
+
+Role-equivalent of ray: python/ray/scripts/scripts.py:568 (`ray start`,
+`ray stop`, `ray status`) and the `ray list ...` state commands —
+argparse instead of click (no extra deps) and a session file under
+/tmp/ray_tpu instead of a process table.
+
+    python -m ray_tpu start --head [--num-cpus N] [--num-tpus N]
+    python -m ray_tpu start --address HOST:PORT   # join as a worker node
+    python -m ray_tpu stop
+    python -m ray_tpu status [--address HOST:PORT]
+    python -m ray_tpu list actors|nodes|tasks|objects|workers|pgs
+    python -m ray_tpu metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+_SESSION_FILE = "/tmp/ray_tpu/latest_cli_session.json"
+
+
+def _save_session(info: dict) -> None:
+    os.makedirs(os.path.dirname(_SESSION_FILE), exist_ok=True)
+    with open(_SESSION_FILE, "w") as f:
+        json.dump(info, f)
+
+
+def _load_session() -> Optional[dict]:
+    try:
+        with open(_SESSION_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _resolve_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    env = os.environ.get("RT_ADDRESS")
+    if env:
+        return env
+    sess = _load_session()
+    if sess:
+        return sess["gcs_address"]
+    sys.exit(
+        "no cluster address: pass --address, set RT_ADDRESS, or start one "
+        "with `python -m ray_tpu start --head`"
+    )
+
+
+def cmd_start(args) -> None:
+    from ray_tpu.core import node as node_mod
+
+    session_dir = node_mod.default_session_dir()
+    if args.head:
+        gcs_proc, gcs_address = node_mod.start_gcs(session_dir)
+    else:
+        if not args.address:
+            sys.exit("--address required to join an existing cluster")
+        gcs_proc, gcs_address = None, args.address
+    resources = node_mod.detect_resources(
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus
+    )
+    raylet_proc, raylet_addr, node_id, _store = node_mod.start_raylet(
+        gcs_address, session_dir, resources
+    )
+    prev = _load_session() or {}
+    _save_session({
+        "gcs_address": gcs_address,
+        "session_dir": session_dir,
+        # a joining worker node must not clobber the recorded head pid
+        "gcs_pid": gcs_proc.pid if gcs_proc else prev.get("gcs_pid"),
+        "raylet_pids": prev.get("raylet_pids", []) + [raylet_proc.pid],
+    })
+    print(f"ray_tpu {'head' if args.head else 'worker node'} started")
+    print(f"  GCS address: {gcs_address}")
+    print(f"  node id:     {node_id}")
+    print(f"  session dir: {session_dir}")
+    print(f"connect with ray_tpu.init(address={gcs_address!r})")
+
+
+def cmd_stop(args) -> None:
+    import signal
+
+    sess = _load_session()
+    if not sess:
+        sys.exit("no recorded CLI session")
+    killed = 0
+    for pid in sess.get("raylet_pids", []) + (
+        [sess["gcs_pid"]] if sess.get("gcs_pid") else []
+    ):
+        try:
+            os.kill(pid, signal.SIGTERM)
+            killed += 1
+        except ProcessLookupError:
+            pass
+    os.unlink(_SESSION_FILE)
+    print(f"stopped {killed} control-plane processes")
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+
+
+def cmd_status(args) -> None:
+    from ray_tpu.util import state
+
+    _connect(args)
+    s = state.summarize()
+    print("======== cluster status ========")
+    print(f"nodes:  {s['nodes_alive']}/{s['nodes_total']} alive")
+    print(f"actors: {s['actors_alive']}/{s['actors_total']} alive")
+    print("resources:")
+    total, avail = s["resources_total"], s["resources_available"]
+    for k in sorted(total):
+        used = total[k] - avail.get(k, 0)
+        print(f"  {used:g}/{total[k]:g} {k}")
+    if s["pending_leases"] or s["pending_pg_bundles"]:
+        print(
+            f"pending demand: {s['pending_leases']} leases, "
+            f"{s['pending_pg_bundles']} PG bundles"
+        )
+
+
+def cmd_list(args) -> None:
+    from ray_tpu.util import state
+
+    _connect(args)
+    fn = {
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "workers": state.list_workers,
+        "pgs": state.list_placement_groups,
+    }[args.what]
+    rows = fn()
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_metrics(args) -> None:
+    from ray_tpu.util import state
+
+    _connect(args)
+    print(json.dumps(state.get_metrics(), indent=2))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="ray_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS address to join (worker node)")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop CLI-started nodes")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster summary")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument(
+        "what",
+        choices=["actors", "nodes", "tasks", "objects", "workers", "pgs"],
+    )
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("metrics", help="aggregated application metrics")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_metrics)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

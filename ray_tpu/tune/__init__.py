@@ -8,7 +8,11 @@ ResultGrid.
 """
 
 from ray_tpu.train.session import get_checkpoint, report  # noqa: F401
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune.search import (  # noqa: F401
     choice,
     grid_search,

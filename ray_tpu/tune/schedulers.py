@@ -1,18 +1,21 @@
 """Trial schedulers: decide per-result whether a trial lives on.
 
 Role-equivalent of ray: python/ray/tune/schedulers/ — FIFOScheduler
-(trial_scheduler.py) and ASHA (async_hyperband.py AsyncHyperBandScheduler):
-asynchronous successive halving with geometric rungs; a trial reaching a
-rung must be in the top 1/reduction_factor of that rung's recorded scores
-or it stops.
+(trial_scheduler.py), ASHA (async_hyperband.py AsyncHyperBandScheduler):
+asynchronous successive halving with geometric rungs, and PBT (pbt.py
+PopulationBasedTraining): exploit/explore — bottom-quantile trials clone
+a top-quantile trial's checkpoint and mutate its hyperparameters, then
+RESTART from that state.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+import random
+from typing import Any, Callable, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+RESTART = "RESTART"  # relaunch the trial from trial.checkpoint + new config
 
 
 class FIFOScheduler:
@@ -71,3 +74,100 @@ class ASHAScheduler:
         k = max(1, (len(scores) + self.rf - 1) // self.rf)
         cutoff = sorted(scores, reverse=True)[k - 1]
         return STOP if score < cutoff else CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT (ray: python/ray/tune/schedulers/pbt.py PopulationBasedTraining).
+
+    Every ``perturbation_interval`` iterations a trial is ranked against
+    the population's latest scores.  A bottom-quantile trial *exploits*
+    (adopts a random top-quantile trial's checkpoint and config) and
+    *explores* (mutates hyperparameters: resample with probability
+    ``resample_probability``, else perturb by x1.2 / x0.8, matching the
+    reference's _explore), then signals RESTART so the controller
+    relaunches it from the adopted checkpoint.
+
+    ``hyperparam_mutations`` maps config keys to either a list of
+    choices or a callable returning a sample.
+    """
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,  # None = inherit from TuneConfig
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 4,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        seed: Optional[int] = None,
+    ):
+        assert mode in (None, "min", "max")
+        assert 0.0 < quantile_fraction <= 0.5
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.mutations = hyperparam_mutations or {}
+        self._rng = random.Random(seed)
+        self._trials: Dict[str, Any] = {}
+        self._scores: Dict[str, float] = {}  # latest sign-normalized score
+        self._last_perturb: Dict[str, int] = {}
+        self.num_perturbations = 0
+
+    def set_trials(self, trials: List[Any]) -> None:
+        """Controller hands us the population (for checkpoint exchange)."""
+        self._trials = {t.trial_id: t for t in trials}
+
+    def _score(self, result: dict) -> float:
+        v = float(result[self.metric])
+        return v if (self.mode or "max") == "max" else -v
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in out:
+                continue
+            if self._rng.random() < self.resample_prob or not isinstance(
+                out[key], (int, float)
+            ):
+                if callable(spec):
+                    out[key] = spec()
+                else:
+                    out[key] = self._rng.choice(list(spec))
+            else:
+                factor = 1.2 if self._rng.random() > 0.5 else 0.8
+                out[key] = type(out[key])(out[key] * factor)
+        return out
+
+    def on_trial_result(self, trial_id: str, result: dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        self._scores[trial_id] = self._score(result)
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        n = len(self._scores)
+        # rank only once the whole population has reported — early in the
+        # run a 2-of-N comparison would mark the second reporter "bottom
+        # quantile" spuriously
+        if n < 2 or (self._trials and n < len(self._trials)):
+            return CONTINUE
+        ranked = sorted(
+            self._scores.items(), key=lambda kv: kv[1], reverse=True
+        )
+        k = max(1, int(n * self.quantile))
+        top = [tid for tid, _ in ranked[:k]]
+        bottom = {tid for tid, _ in ranked[-k:]}
+        if trial_id not in bottom or trial_id in top:
+            return CONTINUE
+        src_id = self._rng.choice(top)
+        src = self._trials.get(src_id)
+        me = self._trials.get(trial_id)
+        if src is None or me is None or src.checkpoint is None:
+            return CONTINUE  # nothing to exploit yet
+        me.checkpoint = src.checkpoint
+        me.config = self._explore(dict(src.config))
+        self.num_perturbations += 1
+        return RESTART

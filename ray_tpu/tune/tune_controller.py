@@ -19,7 +19,7 @@ from ray_tpu.core.errors import ActorDiedError, TaskError
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.session import TrainContext
 from ray_tpu.train.worker_group import TrainWorkerActor
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import CONTINUE, RESTART, STOP, FIFOScheduler
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
@@ -63,7 +63,7 @@ class TuneController:
         self.experiment_name = experiment_name
 
     # -- trial lifecycle -------------------------------------------------
-    def _launch(self, trial: Trial):
+    def _launch(self, trial: Trial, from_checkpoint: Optional[Checkpoint] = None):
         res = dict(trial.resources)
         extra = {k: v for k, v in res.items() if k != "CPU"}
         trial.actor = TrainWorkerActor.options(
@@ -79,7 +79,7 @@ class TuneController:
             trial_dir=f"{self.experiment_dir}/{trial.trial_id}",
         )
         trial.actor.start_training.remote(
-            self.trainable, trial.config, ctx, None
+            self.trainable, trial.config, ctx, from_checkpoint
         )
         trial.status = RUNNING
 
@@ -106,6 +106,9 @@ class TuneController:
             raise
 
     def _run_inner(self) -> List[Trial]:
+        # population-based schedulers exchange checkpoints between trials
+        if hasattr(self.scheduler, "set_trials"):
+            self.scheduler.set_trials(self.trials)
         pending = [t for t in self.trials if t.status == PENDING]
         outstanding: Dict[Any, Trial] = {}  # next_report ref -> trial
 
@@ -156,6 +159,18 @@ class TuneController:
                 if decision == STOP:
                     trial.early_stopped = True
                     self._finalize(trial, TERMINATED)
+                elif decision == RESTART:
+                    # exploit/explore (PBT): the scheduler already swapped
+                    # trial.config/checkpoint; relaunch from that state
+                    if trial.actor is not None:
+                        try:
+                            ray_tpu.kill(trial.actor)
+                        except Exception:
+                            pass
+                        trial.actor = None
+                    self._launch(trial, from_checkpoint=trial.checkpoint)
+                    nref = trial.actor.next_report.remote(timeout=30.0)
+                    outstanding[nref] = trial
                 else:
                     assert decision == CONTINUE
                     nref = trial.actor.next_report.remote(timeout=30.0)

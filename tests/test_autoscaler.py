@@ -1,0 +1,158 @@
+"""Autoscaler: pending demand launches nodes, idle nodes drain.
+
+Mirrors the reference's autoscaler v2 scheduler unit tests + the
+FakeMultiNodeProvider e2e pattern (ray: python/ray/autoscaler/v2/tests/
+test_scheduler.py, tests/test_autoscaler_fake_multinode.py) against real
+raylet subprocesses via LocalSubprocessProvider.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    LocalSubprocessProvider,
+    NodeTypeConfig,
+)
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.common.resources import ResourceSet
+
+
+def _mk(gcs_address, session_dir, **kw):
+    provider = LocalSubprocessProvider(gcs_address, session_dir)
+    cfg = AutoscalerConfig(
+        node_types=[
+            NodeTypeConfig("small", {"CPU": 2}, max_workers=4),
+            NodeTypeConfig("slice4", {"CPU": 4, "slice4": 1}, max_workers=2),
+        ],
+        **kw,
+    )
+    return Autoscaler(gcs_address, provider, cfg), provider
+
+
+class TestPlanning:
+    """Pure planning logic, no cluster."""
+
+    def _state(self, nodes=(), leases=(), bundles=()):
+        return {
+            "nodes": [
+                {
+                    "node_id": f"n{i}",
+                    "alive": True,
+                    "idle": False,
+                    "labels": {},
+                    "resources_total": t,
+                    "resources_available": a,
+                }
+                for i, (t, a) in enumerate(nodes)
+            ],
+            "pending_leases": [{"demand": d, "strategy": {}} for d in leases],
+            "pending_pg_bundles": [
+                {"pg_id": "x", "strategy": "STRICT_PACK", "bundles": bs}
+                for bs in bundles
+            ],
+        }
+
+    def test_no_demand_no_launch(self):
+        a, _ = _mk("127.0.0.1:1", "/tmp/x")
+        st = self._state(nodes=[({"CPU": 2}, {"CPU": 2})])
+        assert a._plan_launches(a._unmet_demands(st), st) == []
+
+    def test_existing_capacity_absorbs(self):
+        a, _ = _mk("127.0.0.1:1", "/tmp/x")
+        st = self._state(
+            nodes=[({"CPU": 4}, {"CPU": 4})], leases=[{"CPU": 2}]
+        )
+        assert a._unmet_demands(st) == []
+
+    def test_smallest_fitting_type_chosen(self):
+        a, _ = _mk("127.0.0.1:1", "/tmp/x")
+        st = self._state(leases=[{"CPU": 1}])
+        plan = a._plan_launches(a._unmet_demands(st), st)
+        assert plan == ["small"]
+
+    def test_strict_pack_bundle_needs_big_node(self):
+        a, _ = _mk("127.0.0.1:1", "/tmp/x")
+        st = self._state(bundles=[[{"CPU": 4}]])
+        plan = a._plan_launches(a._unmet_demands(st), st)
+        assert plan == ["slice4"]
+
+    def test_bin_packs_multiple_demands_per_node(self):
+        a, _ = _mk("127.0.0.1:1", "/tmp/x")
+        st = self._state(leases=[{"CPU": 1}, {"CPU": 1}])
+        plan = a._plan_launches(a._unmet_demands(st), st)
+        assert plan == ["small"]  # both fit one small node
+
+    def test_max_workers_respected(self):
+        a, _ = _mk("127.0.0.1:1", "/tmp/x")
+        st = self._state(bundles=[[{"CPU": 4}], [{"CPU": 4}], [{"CPU": 4}]])
+        plan = a._plan_launches(a._unmet_demands(st), st)
+        assert plan.count("slice4") == 2  # max_workers=2
+
+    def test_infeasible_demand_ignored(self):
+        a, _ = _mk("127.0.0.1:1", "/tmp/x")
+        st = self._state(leases=[{"CPU": 64}])
+        assert a._plan_launches(a._unmet_demands(st), st) == []
+
+
+@pytest.fixture()
+def scaling_cluster():
+    cluster = Cluster(initialize_head=True, connect=True,
+                      head_node_args={"num_cpus": 1})
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+class TestEndToEnd:
+    def test_pending_pg_triggers_scale_up_then_idle_drain(self, scaling_cluster):
+        from ray_tpu.util import placement_group, remove_placement_group
+
+        autoscaler, provider = _mk(
+            scaling_cluster.gcs_address,
+            scaling_cluster.session_dir,
+            idle_timeout_s=2.0,
+            interval_s=0.2,
+        )
+
+        async def drive(predicate, timeout):
+            autoscaler.gcs = __import__(
+                "ray_tpu.core.rpc", fromlist=["rpc"]
+            ).ReconnectingConnection(
+                scaling_cluster.gcs_address, name="autoscaler->gcs"
+            )
+            deadline = time.monotonic() + timeout
+            try:
+                while time.monotonic() < deadline:
+                    await autoscaler.reconcile()
+                    if predicate():
+                        return True
+                    await asyncio.sleep(0.2)
+                return False
+            finally:
+                await autoscaler.gcs.close()
+
+        # a STRICT_PACK PG for an absent slice shape -> scale up
+        pg = placement_group(
+            [{"CPU": 4}], strategy="STRICT_PACK"
+        )
+        assert not pg.wait(timeout_seconds=1)  # head has only 1 CPU
+
+        ok = asyncio.run(
+            drive(lambda: len(provider.non_terminated_nodes()) >= 1, 30)
+        )
+        assert ok, "autoscaler never launched a node"
+        assert pg.wait(timeout_seconds=30), "PG never placed on the new node"
+        launched = provider.non_terminated_nodes()
+        assert launched[0].node_type == "slice4"
+
+        # remove the PG -> the slice goes idle -> drained after timeout
+        remove_placement_group(pg)
+        ok = asyncio.run(
+            drive(lambda: len(provider.non_terminated_nodes()) == 0, 30)
+        )
+        assert ok, "idle node never drained"

@@ -33,6 +33,10 @@ def test_gpt2_training_loss_decreases(cluster, tmp_path):
 
         model_cfg = gpt2.GPTConfig.tiny()
         mesh = mesh_mod.make_mesh(mesh_mod.MeshConfig(dp=-1))
+        # batch must divide the data axes (workers now see the full
+        # virtual device mesh, not a single accidental TPU device)
+        data_shards = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        batch_size = ((4 + data_shards - 1) // data_shards) * data_shards
         optimizer = optax.adam(1e-2)
         state = spmd.sharded_init(
             mesh,
@@ -43,7 +47,7 @@ def test_gpt2_training_loss_decreases(cluster, tmp_path):
         )
         rng = np.random.default_rng(0)
         tokens = rng.integers(
-            0, model_cfg.vocab_size, (4, model_cfg.max_seq_len + 1),
+            0, model_cfg.vocab_size, (batch_size, model_cfg.max_seq_len + 1),
             dtype=np.int32,
         )
         with mesh_mod.use(mesh):

@@ -1,0 +1,149 @@
+"""Population Based Training: exploit/explore with checkpoint exchange.
+
+Mirrors ray: python/ray/tune/tests/test_trial_scheduler_pbt.py — unit
+tests on the perturbation decision logic plus an e2e run where a
+bad-hyperparameter trial must adopt a good trial's checkpoint+config and
+catch up.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import session as train_session
+from ray_tpu.tune.schedulers import CONTINUE, RESTART, PopulationBasedTraining
+
+
+class _FakeTrial:
+    def __init__(self, trial_id, config, checkpoint=None):
+        self.trial_id = trial_id
+        self.config = config
+        self.checkpoint = checkpoint
+
+
+class TestPBTDecisions:
+    def _pbt(self, **kw):
+        kw.setdefault("metric", "score")
+        kw.setdefault("mode", "max")
+        kw.setdefault("perturbation_interval", 1)
+        kw.setdefault("seed", 0)
+        return PopulationBasedTraining(**kw)
+
+    def test_top_trial_continues(self):
+        pbt = self._pbt()
+        trials = [
+            _FakeTrial("a", {"lr": 1.0}, checkpoint="ck_a"),
+            _FakeTrial("b", {"lr": 2.0}, checkpoint="ck_b"),
+            _FakeTrial("c", {"lr": 3.0}, checkpoint="ck_c"),
+            _FakeTrial("d", {"lr": 4.0}, checkpoint="ck_d"),
+        ]
+        pbt.set_trials(trials)
+        for t, s in zip(trials, [10, 5, 3, 1]):
+            assert (
+                pbt.on_trial_result(
+                    t.trial_id, {"score": s, "training_iteration": 1}
+                )
+                != RESTART
+                or t.trial_id == "d"
+            )
+
+    def test_bottom_trial_exploits_top(self):
+        pbt = self._pbt(hyperparam_mutations={"lr": [0.1, 1.0, 10.0]})
+        trials = [
+            _FakeTrial("good", {"lr": 1.0}, checkpoint="good_ck"),
+            _FakeTrial("mid1", {"lr": 2.0}, checkpoint="m1"),
+            _FakeTrial("mid2", {"lr": 3.0}, checkpoint="m2"),
+            _FakeTrial("bad", {"lr": 99.0}, checkpoint="bad_ck"),
+        ]
+        pbt.set_trials(trials)
+        pbt.on_trial_result("good", {"score": 100, "training_iteration": 1})
+        pbt.on_trial_result("mid1", {"score": 50, "training_iteration": 1})
+        pbt.on_trial_result("mid2", {"score": 40, "training_iteration": 1})
+        decision = pbt.on_trial_result(
+            "bad", {"score": 1, "training_iteration": 1}
+        )
+        assert decision == RESTART
+        bad = trials[3]
+        assert bad.checkpoint == "good_ck"  # exploited
+        # explored: lr either perturbed from 1.0 (x1.2/x0.8) or resampled
+        assert bad.config["lr"] != 99.0
+
+    def test_no_restart_before_interval(self):
+        pbt = self._pbt(perturbation_interval=5)
+        trials = [
+            _FakeTrial("a", {}, checkpoint="x"),
+            _FakeTrial("b", {}, checkpoint="y"),
+        ]
+        pbt.set_trials(trials)
+        pbt.on_trial_result("a", {"score": 10, "training_iteration": 2})
+        d = pbt.on_trial_result("b", {"score": 1, "training_iteration": 2})
+        assert d == CONTINUE  # iteration 2 < interval 5
+
+    def test_no_exploit_without_checkpoint(self):
+        pbt = self._pbt()
+        trials = [
+            _FakeTrial("a", {}, checkpoint=None),
+            _FakeTrial("b", {}, checkpoint=None),
+        ]
+        pbt.set_trials(trials)
+        pbt.on_trial_result("a", {"score": 10, "training_iteration": 1})
+        d = pbt.on_trial_result("b", {"score": 1, "training_iteration": 1})
+        assert d == CONTINUE
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def _trainable(config):
+    """Score grows by `rate` per iteration, accumulated in the checkpoint.
+    A trial restarted from a better trial's checkpoint + mutated rate
+    resumes from the donor's accumulated score."""
+    sess = train_session.get_session()
+    score = 0.0
+    ck = sess.get_checkpoint()
+    if ck is not None:
+        score = float(ck.to_dict()["score"])
+    for _ in range(20):
+        score += float(config["rate"])
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        sess.report(
+            {"score": score}, checkpoint=Checkpoint.from_dict({"score": score})
+        )
+
+
+class TestPBTEndToEnd:
+    def test_bad_trial_catches_up(self, cluster, tmp_path):
+        from ray_tpu.train.config import RunConfig
+
+        pbt = PopulationBasedTraining(
+            perturbation_interval=4,
+            quantile_fraction=0.25,
+            resample_probability=0.0,
+            hyperparam_mutations={"rate": [1.0, 5.0]},
+            seed=7,
+        )
+        tuner = tune.Tuner(
+            _trainable,
+            param_space={"rate": tune.grid_search([5.0, 4.0, 3.0, 0.01])},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", scheduler=pbt
+            ),
+            run_config=RunConfig(
+                name="pbt_test", storage_path=str(tmp_path)
+            ),
+        )
+        grid = tuner.fit()
+        assert not grid.errors
+        assert pbt.num_perturbations >= 1, "PBT never perturbed"
+        scores = sorted(
+            r.metrics["score"] for r in grid if r.metrics
+        )
+        # the 0.01-rate trial would finish near 0.2 alone; having adopted a
+        # winner's checkpoint + rate it must land far above that
+        assert scores[0] > 10, scores
