@@ -70,6 +70,10 @@ D("gcs_reconnect_max_downtime_s", float, 60.0)
 D("gcs_checkpoint_debounce_s", float, 0.05)
 # how often each process ships its util.metrics registry to the GCS
 D("metrics_push_interval_s", float, 5.0)
+# node-to-node object transfer: chunk size + pipelined chunks in flight
+# (ray analogue: object_manager 64MB chunks / ObjectBufferPool)
+D("transfer_chunk_bytes", int, 8 * 1024 * 1024)
+D("transfer_inflight_chunks", int, 4)
 
 # --- object store ---
 D("object_store_bytes", int, 0)  # 0 = auto (30% of /dev/shm free, capped)

@@ -30,6 +30,7 @@ logger = logging.getLogger(__name__)
 
 _HEADER = struct.Struct("<I")
 _BUFHDR = struct.Struct("<Q")
+_BYTES_OOB_THRESHOLD = 64 * 1024
 
 
 class SerializedObject:
@@ -152,6 +153,27 @@ class _Pickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
+class _LargeBytes:
+    """Wrapper that moves a big bytes/bytearray payload out-of-band.
+
+    The C pickler serializes primitive bytes with a dedicated opcode
+    BEFORE consulting reducer_override, embedding the payload in the
+    metadata stream (a full extra copy through the put path) — so the
+    top-level raw-buffer case (`put(b"...")`, ray's plasma raw-buffer
+    analogue) is wrapped here instead.  Deserialization pays the one
+    unavoidable copy (`bytes(buffer)` owns its memory).
+    """
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data
+
+    def __reduce_ex__(self, protocol):
+        ctor = bytearray if isinstance(self.data, bytearray) else bytes
+        return (ctor, (pickle.PickleBuffer(self.data),))
+
+
 class SerializationContext:
     """Pickles python objects with out-of-band buffer extraction."""
 
@@ -164,6 +186,11 @@ class SerializationContext:
     def serialize(self, obj: Any) -> SerializedObject:
         import io
 
+        if (
+            isinstance(obj, (bytes, bytearray))
+            and len(obj) >= _BYTES_OOB_THRESHOLD
+        ):
+            obj = _LargeBytes(obj)
         buffers: List[memoryview] = []
 
         def cb(pb: pickle.PickleBuffer):

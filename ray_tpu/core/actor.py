@@ -97,6 +97,7 @@ class ActorClass:
             max_task_retries=max_task_retries,
             detached=(o.get("lifetime") == "detached"),
             strategy=_strategy_dict(o.get("scheduling_strategy")),
+            runtime_env=o.get("runtime_env"),
         )
         return ActorHandle(actor_id, max_task_retries)
 

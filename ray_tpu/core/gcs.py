@@ -75,6 +75,11 @@ class LeaseEntry:
     pg_ref: Optional[Tuple[PlacementGroupID, int]] = None
 
 
+RUNNING_JOB = "RUNNING"
+SUCCEEDED_JOB = "SUCCEEDED"
+FAILED_JOB = "FAILED"
+STOPPED_JOB = "STOPPED"
+
 ACTOR_PENDING = "PENDING_CREATION"
 ACTOR_ALIVE = "ALIVE"
 ACTOR_RESTARTING = "RESTARTING"
@@ -297,7 +302,8 @@ _READONLY_RPCS = frozenset({
     "get_placement_group", "list_placement_groups",
     "wait_placement_group_ready", "ping", "subscribe", "unsubscribe",
     "get_autoscaler_state", "list_tasks", "list_objects",
-    "metrics_push", "get_metrics",
+    "metrics_push", "get_metrics", "get_job_info", "get_job_logs",
+    "list_jobs",
 })
 
 
@@ -361,6 +367,9 @@ class GcsServer:
         self._start_time = time.time()
         # observability: reporter id -> latest metric snapshot
         self.metrics_by_reporter: Dict[str, dict] = {}
+        # submitted driver jobs (job_submission.py): sub_id -> info
+        self.submitted_jobs: Dict[str, dict] = {}
+        self.session_dir = session_dir
 
     # ---- persistence ---------------------------------------------------
     def _mark_dirty(self):
@@ -400,6 +409,10 @@ class GcsServer:
                 pid: copy.copy(pg) for pid, pg in self.placement_groups.items()
             },
             "named_pgs": dict(self.named_pgs),
+            "submitted_jobs": {
+                k: {kk: vv for kk, vv in v.items() if not kk.startswith("_")}
+                for k, v in self.submitted_jobs.items()
+            },
         }
 
     def _snapshot_object_state(self) -> dict:
@@ -448,6 +461,13 @@ class GcsServer:
         self.kv.update(st["kv"])
         self.placement_groups.update(st["placement_groups"])
         self.named_pgs.update(st["named_pgs"])
+        for k, v in st.get("submitted_jobs", {}).items():
+            # a restart orphans the driver subprocess handle; a job still
+            # marked RUNNING has unknown fate — report FAILED conservatively
+            if v.get("status") == RUNNING_JOB:
+                v = dict(v, status=FAILED_JOB,
+                         end_time=v.get("end_time") or time.time())
+            self.submitted_jobs[k] = v
         # A PENDING actor's creating client must re-drive creation itself
         # (its conn died with us); mid-restart actors get their restart
         # replayed once nodes have had a chance to re-register.  Leases
@@ -566,6 +586,13 @@ class GcsServer:
     async def _health_loop(self):
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
+            # reap finished driver subprocesses even when nobody polls
+            # (zombies otherwise; and the checkpoint must not persist a
+            # finished job as RUNNING)
+            try:
+                self._poll_submitted_jobs()
+            except Exception:
+                pass
             now = time.monotonic()
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
@@ -1152,6 +1179,163 @@ class GcsServer:
     async def rpc_list_placement_groups(self, conn, p):
         return [self._pg_info(pg) for pg in self.placement_groups.values()]
 
+    # ---- blob store (runtime-env packages and other large artifacts;
+    # files under the session dir, so they survive GCS restarts without
+    # riding the control checkpoint) ------------------------------------
+    def _blob_path(self, sha: str) -> str:
+        import os
+
+        base = self.session_dir or "/tmp/ray_tpu"
+        return os.path.join(base, "blobs", sha)
+
+    async def rpc_put_blob(self, conn, p):
+        import os
+
+        sha = p["sha"]
+        path = self._blob_path(sha)
+
+        def write():
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(p["data"])
+            os.replace(tmp, path)
+
+        if not os.path.exists(path):
+            await asyncio.get_running_loop().run_in_executor(None, write)
+        return True
+
+    async def rpc_get_blob(self, conn, p):
+        path = self._blob_path(p["sha"])
+
+        def read():
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                return None
+
+        return await asyncio.get_running_loop().run_in_executor(None, read)
+
+    # ---- job submission (ray: dashboard/modules/job/job_manager.py:529,
+    # embedded here instead of a dashboard process) ---------------------
+    async def rpc_submit_job(self, conn, p):
+        import os
+        import subprocess
+        import uuid
+
+        sub_id = p.get("submission_id") or f"rtjob-{uuid.uuid4().hex[:12]}"
+        if sub_id in self.submitted_jobs:
+            raise rpc.RpcError(f"submission_id {sub_id!r} already used")
+        base = self.session_dir or "/tmp/ray_tpu"
+        jobs_dir = os.path.join(base, "jobs", sub_id)
+        os.makedirs(jobs_dir, exist_ok=True)
+        env = dict(os.environ)
+        env["RT_ADDRESS"] = self.address
+        env.pop("JAX_PLATFORMS", None)  # driver decides its own platform
+        cwd = jobs_dir
+        desc = p.get("runtime_env") or {}
+        env.update(desc.get("env_vars") or {})
+        if desc.get("working_dir_pkg"):
+            import io
+            import zipfile
+
+            blob = await self.rpc_get_blob(
+                conn, {"sha": desc["working_dir_pkg"]}
+            )
+            if blob is None:
+                raise rpc.RpcError("job working_dir package missing")
+            cwd = os.path.join(jobs_dir, "working_dir")
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: zipfile.ZipFile(io.BytesIO(bytes(blob))).extractall(
+                    cwd
+                ),
+            )
+        log_path = os.path.join(jobs_dir, "driver.log")
+        log_f = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                ["bash", "-c", p["entrypoint"]],
+                cwd=cwd, env=env, stdout=log_f, stderr=subprocess.STDOUT,
+            )
+        finally:
+            log_f.close()
+        self.submitted_jobs[sub_id] = {
+            "submission_id": sub_id,
+            "entrypoint": p["entrypoint"],
+            "metadata": p.get("metadata", {}),
+            "status": RUNNING_JOB,
+            "start_time": time.time(),
+            "end_time": None,
+            "log_path": log_path,
+            "pid": proc.pid,
+            "_proc": proc,
+        }
+        self._mark_dirty()
+        return {"submission_id": sub_id}
+
+    def _poll_submitted_jobs(self):
+        for info in self.submitted_jobs.values():
+            proc = info.get("_proc")
+            if info["status"] == RUNNING_JOB and proc is not None:
+                rc = proc.poll()
+                if rc is not None:
+                    info["status"] = (
+                        SUCCEEDED_JOB if rc == 0 else FAILED_JOB
+                    )
+                    info["end_time"] = time.time()
+                    info["returncode"] = rc
+                    self._mark_dirty()
+
+    async def rpc_get_job_info(self, conn, p):
+        self._poll_submitted_jobs()
+        info = self.submitted_jobs.get(p["submission_id"])
+        if info is None:
+            raise rpc.RpcError(f"no job {p['submission_id']!r}")
+        return {k: v for k, v in info.items() if not k.startswith("_")}
+
+    async def rpc_get_job_logs(self, conn, p):
+        info = self.submitted_jobs.get(p["submission_id"])
+        if info is None:
+            raise rpc.RpcError(f"no job {p['submission_id']!r}")
+        try:
+            with open(info["log_path"], "rb") as f:
+                return f.read().decode("utf-8", "replace")
+        except FileNotFoundError:
+            return ""
+
+    async def rpc_stop_job(self, conn, p):
+        info = self.submitted_jobs.get(p["submission_id"])
+        if info is None:
+            return False
+        proc = info.get("_proc")
+        if info["status"] == RUNNING_JOB and proc is not None:
+            proc.terminate()
+
+            def wait_or_kill():
+                try:
+                    proc.wait(timeout=5)
+                except Exception:
+                    proc.kill()
+
+            # off-loop: an entrypoint ignoring SIGTERM must not stall the
+            # control plane for the grace period
+            await asyncio.get_running_loop().run_in_executor(
+                None, wait_or_kill
+            )
+            info["status"] = STOPPED_JOB
+            info["end_time"] = time.time()
+            self._mark_dirty()
+        return True
+
+    async def rpc_list_jobs(self, conn, p):
+        self._poll_submitted_jobs()
+        return [
+            {k: v for k, v in info.items() if not k.startswith("_")}
+            for info in self.submitted_jobs.values()
+        ]
+
     async def rpc_list_tasks(self, conn, p):
         """Cluster-wide live tasks: fan out to raylets → workers (ray:
         python/ray/util/state/api.py list_tasks, sourced live instead of
@@ -1567,7 +1751,14 @@ class GcsServer:
             max_restarts=p.get("max_restarts", 0),
             creation_spec=p.get("creation_spec"),
             resources=p["resources"],
-            scheduling=p.get("strategy", {}),
+            scheduling=dict(
+                p.get("strategy", {}) or {},
+                **(
+                    {"_runtime_env": p["runtime_env"]}
+                    if p.get("runtime_env")
+                    else {}
+                ),
+            ),
             detached=p.get("detached", False),
             creator_conn=conn,
         )
@@ -1725,7 +1916,12 @@ class GcsServer:
                         )
                     grant = await self._try_grant_pg_lease(
                         pg, cands, demand, _GCS_SELF_CONN,
-                        {"actor_id": actor.actor_id.binary()},
+                        {
+                            "actor_id": actor.actor_id.binary(),
+                            "runtime_env": actor.scheduling.get(
+                                "_runtime_env"
+                            ),
+                        },
                     )
                     if grant is None:
                         await self._pg_state_wait(pg.pg_id, 5.0)
@@ -1745,7 +1941,10 @@ class GcsServer:
                     await fut
                 grant = await self._grant_lease(
                     node, demand, _GCS_SELF_CONN,
-                    {"actor_id": actor.actor_id.binary()},
+                    {
+                        "actor_id": actor.actor_id.binary(),
+                        "runtime_env": actor.scheduling.get("_runtime_env"),
+                    },
                 )
             worker_conn = None
             deadline = time.monotonic() + cfg.worker_start_timeout_s

@@ -43,6 +43,7 @@ class WorkerEntry:
     conn: Optional[rpc.Connection] = None  # worker's connection to us
     addr: Optional[str] = None  # worker's own rpc server address
     bound_env: Optional[Dict[str, str]] = None  # accelerator env, once set
+    rtenv_key: str = ""  # runtime-env binding (core/runtime_env.py)
     lease_id: Optional[int] = None
     tpu_chips: tuple = ()
     started_at: float = field(default_factory=time.monotonic)
@@ -82,6 +83,7 @@ class Raylet:
             range(int(self.resources.get("TPU", 0)))
         )
         self._peer_conns: Dict[str, rpc.Connection] = {}
+        self._inflight_pulls: Dict[bytes, asyncio.Future] = {}
         self._tasks: List[asyncio.Task] = []
         self._closing = False
 
@@ -246,7 +248,7 @@ class Raylet:
         w.conn = conn
         w.addr = p["address"]
         conn.peer_info["worker_id"] = wid
-        key = _env_key(w.bound_env)
+        key = _env_key(w.bound_env, w.rtenv_key) if w.bound_env else ()
         self._idle_by_env.setdefault(key, []).append(w)
         return True
 
@@ -291,7 +293,9 @@ class Raylet:
             for c in chips.split(","):
                 self._tpu_chips_free.add(int(c))
 
-    def _find_idle_tpu_worker(self, n_tpu: int) -> Optional[WorkerEntry]:
+    def _find_idle_tpu_worker(
+        self, n_tpu: int, rtenv_key: str = ""
+    ) -> Optional[WorkerEntry]:
         """An idle worker already bound to exactly n_tpu chips — reusing
         it avoids allocating fresh chips (which may all be bound to such
         idle workers; the old chips stay with the worker by design)."""
@@ -305,22 +309,42 @@ class Raylet:
                 ):
                     pool.pop()
                     continue
-                if len(cand.tpu_chips) == n_tpu:
+                if len(cand.tpu_chips) == n_tpu and cand.rtenv_key == rtenv_key:
                     pool.pop()
                     return cand
                 break  # pools are homogeneous per binding
         return None
 
+    async def _evict_idle_chip_holders(self, n_tpu_needed: int):
+        """Kill idle workers holding chips until n_tpu_needed are free."""
+        for pool in list(self._idle_by_env.values()):
+            for cand in list(pool):
+                if len(self._tpu_chips_free) >= n_tpu_needed:
+                    return
+                if cand.tpu_chips and cand.idle:
+                    pool.remove(cand)
+                    await self._on_worker_exit(cand, kill=True)
+
     async def rpc_lease_worker(self, conn: rpc.Connection, p):
-        """GCS asks for a worker bound to `resources`. Returns its address."""
+        """GCS asks for a worker bound to `resources` (+ runtime env).
+        Returns its address."""
+        from ray_tpu.core import runtime_env as rtenv_mod
+
         resources = p["resources"]
+        rtenv = p.get("runtime_env")
+        rtenv_key = rtenv_mod.descriptor_key(rtenv)
         n_tpu = int(resources.get("TPU", 0))
         if n_tpu <= 0 and resources.get("TPU", 0) > 0:
             n_tpu = 1
         if n_tpu > 0:
             # chip-bound reuse must come BEFORE allocation: the free set
             # may be empty precisely because idle workers hold the chips
-            w = self._find_idle_tpu_worker(n_tpu)
+            w = self._find_idle_tpu_worker(n_tpu, rtenv_key)
+            if w is None and len(self._tpu_chips_free) < n_tpu:
+                # no compatible idle worker and not enough free chips:
+                # evict idle chip holders bound to other envs (the
+                # docstring contract: conflicting idle workers are killed)
+                await self._evict_idle_chip_holders(n_tpu)
             if w is not None:
                 w.lease_id = p["lease_id"]
                 return {
@@ -333,7 +357,7 @@ class Raylet:
                     },
                 }
         accel_env = self._accel_env_for(resources)
-        key = _env_key(accel_env)
+        key = _env_key(accel_env, rtenv_key)
         # exact-match idle worker?
         w: Optional[WorkerEntry] = None
         pool = self._idle_by_env.get(key, [])
@@ -359,8 +383,19 @@ class Raylet:
                 if w in pool:
                     pool.remove(w)
         if w.bound_env is None:
-            await w.conn.call("bind_env", {"env": accel_env})
+            try:
+                await w.conn.call(
+                    "bind_env", {"env": accel_env, "runtime_env": rtenv}
+                )
+            except Exception:
+                # failed bind (e.g. missing runtime-env package): the
+                # chips allocated above and the worker itself must not
+                # leak — refund and retire it
+                self._release_accel_env(accel_env)
+                await self._on_worker_exit(w, kill=True)
+                raise
             w.bound_env = accel_env
+            w.rtenv_key = rtenv_key
             w.tpu_chips = tuple(
                 int(c)
                 for c in accel_env.get("_RT_TPU_CHIPS", "").split(",")
@@ -389,7 +424,9 @@ class Raylet:
         ):
             await self._on_worker_exit(w, kill=True)
             return True
-        self._idle_by_env.setdefault(_env_key(w.bound_env), []).append(w)
+        self._idle_by_env.setdefault(
+            _env_key(w.bound_env, w.rtenv_key), []
+        ).append(w)
         return True
 
     async def _on_worker_exit(self, w: WorkerEntry, kill: bool = False):
@@ -418,10 +455,29 @@ class Raylet:
         """Local runtime asks us to fetch an object into the node store.
 
         (ray: object_manager pull_manager.h:52 analogue, pull-based only.)
-        """
+        Concurrent requests for one object coalesce into a single
+        transfer (several tasks landing on a node with the same large
+        argument is the broadcast-ingest common case)."""
         oid: bytes = p["object_id"]
         if self.store.contains(oid):
             return True
+        existing = self._inflight_pulls.get(oid)
+        if existing is not None:
+            return await asyncio.shield(existing)
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight_pulls[oid] = fut
+        try:
+            ok = await self._pull_object_inner(oid, p)
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            self._inflight_pulls.pop(oid, None)
+            if not fut.done():
+                fut.set_result(ok)
+        return ok
+
+    async def _pull_object_inner(self, oid: bytes, p) -> bool:
         reply = await self.gcs.call(
             "get_object_locations",
             {"object_id": oid, "timeout": p.get("timeout", 30.0)},
@@ -429,38 +485,24 @@ class Raylet:
         locations = reply["locations"]
         if not locations:
             return False
-        last_err = None
-        for loc in locations:
-            if loc["node_id"] == self.node_id.hex():
-                # registered on this very node — the owner wrote it into our
-                # shared arena after the caller's first check
-                if self.store.contains(oid):
-                    return True
-                continue  # stale directory entry
-            try:
-                peer = await self._peer(loc["address"])
-                data = await peer.call(
-                    "fetch_object", {"object_id": oid},
-                    timeout=cfg.rpc_call_timeout_s,
-                )
-                if data is None:
-                    continue
-                try:
-                    self.store.put(oid, data)
-                except Exception as e:
-                    from ray_tpu._native.store import ObjectExistsError
+        # Shuffle: under a broadcast (N nodes pulling one seeder's object)
+        # each completed pull registers a new location, and randomized
+        # source choice spreads the remaining pulls across all replicas —
+        # an emergent broadcast tree instead of N full reads of one node
+        # (ray: push_manager.h broadcast role, inverted pull-side).
+        import random
 
-                    if not isinstance(e, ObjectExistsError):
-                        raise
-                await self.gcs.notify(
-                    "add_object_location",
-                    {
-                        "object_id": oid,
-                        "node_id": self.node_id.binary(),
-                        "size": len(data),
-                    },
-                )
-                return True
+        peers = [
+            loc for loc in locations if loc["node_id"] != self.node_id.hex()
+        ]
+        random.shuffle(peers)
+        if not peers and self.store.contains(oid):
+            return True
+        last_err = None
+        for loc in peers:
+            try:
+                if await self._pull_from(oid, loc, peers):
+                    return True
             except Exception as e:
                 last_err = e
                 continue
@@ -468,13 +510,137 @@ class Raylet:
             logger.warning("pull of %s failed: %r", oid.hex()[:12], last_err)
         return False
 
+    async def _pull_from(self, oid: bytes, loc, all_peers) -> bool:
+        """Fetch one object from `loc` (chunked + pipelined when large,
+        striped across additional replicas when available)."""
+        peer = await self._peer(loc["address"])
+        meta = await peer.call(
+            "fetch_object_meta", {"object_id": oid},
+            timeout=cfg.rpc_call_timeout_s,
+        )
+        if meta is None:
+            return False
+        size = meta["size"]
+        chunk = cfg.transfer_chunk_bytes
+        if size <= chunk:
+            data = await peer.call(
+                "fetch_object", {"object_id": oid},
+                timeout=cfg.rpc_call_timeout_s,
+            )
+            if data is None:
+                return False
+            self._store_put_new(oid, data)
+            await self._announce(oid, size)
+            return True
+        # large object: write chunks straight into the shm allocation,
+        # several in flight, round-robining across known replicas
+        try:
+            view = self.store.create(oid, size)
+        except Exception:
+            from ray_tpu._native.store import ObjectExistsError
+
+            if self.store.contains(oid):
+                return True
+            raise
+        sources = [peer]
+        for other in all_peers:
+            if other is loc:
+                continue
+            try:
+                sources.append(await self._peer(other["address"]))
+            except Exception:
+                continue
+        offsets = list(range(0, size, chunk))
+        sem = asyncio.Semaphore(cfg.transfer_inflight_chunks)
+
+        async def fetch_one(i: int, off: int):
+            src = sources[i % len(sources)]
+            length = min(chunk, size - off)
+            async with sem:
+                data = None
+                try:
+                    data = await src.call(
+                        "fetch_object_chunk",
+                        {"object_id": oid, "offset": off, "length": length},
+                        timeout=cfg.rpc_call_timeout_s,
+                    )
+                except Exception:
+                    pass  # replica died mid-transfer: fall through
+                if (data is None or len(data) != length) and src is not peer:
+                    data = await peer.call(
+                        "fetch_object_chunk",
+                        {"object_id": oid, "offset": off, "length": length},
+                        timeout=cfg.rpc_call_timeout_s,
+                    )
+                if data is None or len(data) != length:
+                    raise rpc.RpcError(
+                        f"chunk {off}+{length} of {oid.hex()[:12]} unavailable"
+                    )
+                view[off:off + length] = data
+
+        # return_exceptions: every fetch task must have FINISHED before the
+        # allocation can be aborted — a cancelled-but-running writer on a
+        # released memoryview would corrupt the arena
+        results = await asyncio.gather(
+            *(fetch_one(i, off) for i, off in enumerate(offsets)),
+            return_exceptions=True,
+        )
+        errs = [r for r in results if isinstance(r, BaseException)]
+        if errs:
+            try:
+                self.store.abort(oid)
+            except Exception:
+                pass
+            raise errs[0]
+        self.store.seal(oid)
+        await self._announce(oid, size)
+        return True
+
+    def _store_put_new(self, oid: bytes, data) -> None:
+        try:
+            self.store.put(oid, data)
+        except Exception as e:
+            from ray_tpu._native.store import ObjectExistsError
+
+            if not isinstance(e, ObjectExistsError):
+                raise
+
+    async def _announce(self, oid: bytes, size: int) -> None:
+        await self.gcs.notify(
+            "add_object_location",
+            {
+                "object_id": oid,
+                "node_id": self.node_id.binary(),
+                "size": size,
+            },
+        )
+
     async def rpc_fetch_object(self, conn: rpc.Connection, p):
-        """A remote raylet asks for an object's bytes."""
+        """A remote raylet asks for an object's bytes (small objects)."""
         pin = self.store.get(p["object_id"])
         if pin is None:
             return None
         try:
             return bytes(pin.view)
+        finally:
+            pin.release()
+
+    async def rpc_fetch_object_meta(self, conn: rpc.Connection, p):
+        pin = self.store.get(p["object_id"])
+        if pin is None:
+            return None
+        try:
+            return {"size": pin.view.nbytes}
+        finally:
+            pin.release()
+
+    async def rpc_fetch_object_chunk(self, conn: rpc.Connection, p):
+        pin = self.store.get(p["object_id"])
+        if pin is None:
+            return None
+        try:
+            off, ln = p["offset"], p["length"]
+            return bytes(pin.view[off:off + ln])
         finally:
             pin.release()
 
@@ -494,10 +660,10 @@ class Raylet:
         return c
 
 
-def _env_key(env: Optional[Dict[str, str]]) -> tuple:
-    if env is None:
+def _env_key(env: Optional[Dict[str, str]], rtenv_key: str = "") -> tuple:
+    if env is None and not rtenv_key:
         return ()
-    return tuple(sorted(env.items()))
+    return (tuple(sorted((env or {}).items())), rtenv_key)
 
 
 # --------------------------------------------------------------------------

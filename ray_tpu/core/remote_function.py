@@ -62,6 +62,7 @@ class RemoteFunction:
                 "max_retries", cfg.task_max_retries_default
             ),
             strategy=strategy,
+            runtime_env=o.get("runtime_env"),
         )
         return refs[0] if num_returns == 1 else refs
 

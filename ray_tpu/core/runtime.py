@@ -194,7 +194,27 @@ class Runtime:
         self._serialization = ser.SerializationContext()
         self._serialization.register_reducer(ObjectRef, self._reduce_ref)
         self._nested_ref_sink = threading.local()
+        self._class_runtime_envs: Dict[Any, dict] = {}
         self._closed = False
+
+    def _normalize_runtime_env(self, env: Optional[dict]) -> Optional[dict]:
+        """Package + upload a runtime_env once; returns the descriptor."""
+        if not env:
+            return None
+        from ray_tpu.core import runtime_env as rtenv_mod
+
+        def kv_put(sha, value):
+            if threading.current_thread() is self._thread:
+                raise RuntimeError(
+                    "runtime_env with working_dir/py_modules cannot be "
+                    "packaged from inside an async actor method; submit "
+                    "from a sync context"
+                )
+            self._run(
+                self.gcs.call("put_blob", {"sha": sha, "data": value})
+            )
+
+        return rtenv_mod.normalize(env, kv_put)
 
     # ---- loop bridging -------------------------------------------------
     def _run(self, coro, timeout: Optional[float] = None):
@@ -640,6 +660,7 @@ class Runtime:
         resources: Optional[Dict[str, float]] = None,
         max_retries: int = 0,
         strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
         self._task_index += 1
         task_id = TaskID.random()
@@ -662,11 +683,17 @@ class Runtime:
         # SchedulingClass (ray: common/task/task_spec.h) — so leased workers
         # are only reused for the same function shape and a slow function
         # can't head-of-line-block unrelated tasks.
+        rtenv_desc = self._normalize_runtime_env(runtime_env)
+        from ray_tpu.core import runtime_env as rtenv_mod
+
         class_key = (
             fn_hash,
             tuple(sorted(resources.items())),
             tuple(sorted((strategy or {}).items(), key=lambda kv: kv[0])),
+            rtenv_mod.descriptor_key(rtenv_desc),
         )
+        if rtenv_desc is not None:
+            self._class_runtime_envs[class_key] = rtenv_desc
         # Dependencies this process itself is producing.  They must resolve
         # BEFORE the task may occupy a lease — a worker blocking on an
         # in-flight upstream result while holding the worker that upstream
@@ -797,7 +824,13 @@ class Runtime:
                 try:
                     grant = await self.gcs.call(
                         "request_lease",
-                        {"resources": resources, "strategy": strategy},
+                        {
+                            "resources": resources,
+                            "strategy": strategy,
+                            "runtime_env": self._class_runtime_envs.get(
+                                class_key
+                            ),
+                        },
                         timeout=cfg.sched_max_pending_lease_s
                         + cfg.worker_start_timeout_s,
                     )
@@ -952,8 +985,10 @@ class Runtime:
         max_task_retries=0,
         detached=False,
         strategy=None,
+        runtime_env=None,
     ) -> "ActorID":
         actor_id = ActorID.random()
+        rtenv_desc = self._normalize_runtime_env(runtime_env)
         cls_hash = self.fn_hash_and_register(cls)
         creation_spec = {
             "cls_hash": cls_hash,
@@ -975,16 +1010,18 @@ class Runtime:
                     "resources": resources,
                     "strategy": strategy or {},
                     "detached": detached,
+                    "runtime_env": rtenv_desc,
                 },
             )
         )
         if reply.get("existing"):
             return ActorID(reply["actor_id"])
         self._spawn(self._create_actor_async(actor_id, creation_spec, resources,
-                                             strategy or {}))
+                                             strategy or {}, rtenv_desc))
         return actor_id
 
-    async def _create_actor_async(self, actor_id, creation_spec, resources, strategy):
+    async def _create_actor_async(self, actor_id, creation_spec, resources,
+                                  strategy, runtime_env=None):
         try:
             while True:
                 try:
@@ -994,6 +1031,7 @@ class Runtime:
                             "resources": resources,
                             "strategy": strategy,
                             "actor_id": actor_id.binary(),
+                            "runtime_env": runtime_env,
                         },
                         timeout=cfg.sched_max_pending_lease_s
                         + cfg.worker_start_timeout_s,

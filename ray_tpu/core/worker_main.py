@@ -71,6 +71,13 @@ class WorkerServer:
         if method == "bind_env":
             os.environ.update(p["env"])
             _apply_jax_platform(p["env"])
+            if p.get("runtime_env"):
+                from ray_tpu.core import runtime_env as rtenv_mod
+
+                async def _kv_get(sha):
+                    return await self.rt.gcs.call("get_blob", {"sha": sha})
+
+                await rtenv_mod.apply(p["runtime_env"], _kv_get)
             return True
         if method == "cancel_task":
             return self._cancel(p["task_id"])

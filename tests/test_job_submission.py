@@ -1,0 +1,104 @@
+"""Job submission: drive a cluster from outside via entrypoint jobs.
+
+Mirrors ray: dashboard/modules/job/tests/test_job_manager.py — submit,
+status lifecycle, logs, stop, runtime_env working_dir.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.job_submission import (
+    FAILED,
+    STOPPED,
+    SUCCEEDED,
+    JobSubmissionClient,
+)
+
+
+@pytest.fixture(scope="module")
+def job_cluster():
+    cluster = Cluster(initialize_head=True, connect=False,
+                      head_node_args={"num_cpus": 4})
+    yield cluster
+    cluster.shutdown()
+
+
+class TestJobSubmission:
+    def test_submit_and_succeed(self, job_cluster):
+        client = JobSubmissionClient(job_cluster.gcs_address)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('job ran ok')\""
+        )
+        assert client.wait_until_finished(job_id, timeout=120) == SUCCEEDED
+        assert "job ran ok" in client.get_job_logs(job_id)
+
+    def test_driver_connects_to_cluster(self, job_cluster, tmp_path):
+        script = tmp_path / "driver.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            sys.path.insert(0, os.environ["RT_REPO"])
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import ray_tpu
+            ray_tpu.init(address=os.environ["RT_ADDRESS"])
+
+            @ray_tpu.remote
+            def f(x):
+                return x * 2
+
+            print("cluster result:", ray_tpu.get(f.remote(21), timeout=60))
+            ray_tpu.shutdown()
+        """))
+        import os
+
+        client = JobSubmissionClient(job_cluster.gcs_address)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} {script}",
+            runtime_env={"env_vars": {
+                "RT_REPO": os.path.dirname(os.path.dirname(
+                    os.path.abspath(ray_tpu.__file__)))
+            }},
+        )
+        status = client.wait_until_finished(job_id, timeout=180)
+        logs = client.get_job_logs(job_id)
+        assert status == SUCCEEDED, logs
+        assert "cluster result: 42" in logs
+
+    def test_failed_job_reports_failed(self, job_cluster):
+        client = JobSubmissionClient(job_cluster.gcs_address)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'"
+        )
+        assert client.wait_until_finished(job_id, timeout=120) == FAILED
+        assert client.get_job_info(job_id)["returncode"] == 3
+
+    def test_stop_job(self, job_cluster):
+        client = JobSubmissionClient(job_cluster.gcs_address)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'"
+        )
+        assert client.stop_job(job_id)
+        assert client.wait_until_finished(job_id, timeout=60) == STOPPED
+
+    def test_working_dir_job(self, job_cluster, tmp_path):
+        app = tmp_path / "app"
+        app.mkdir()
+        (app / "main.py").write_text("print(open('cfg.txt').read())")
+        (app / "cfg.txt").write_text("from-working-dir")
+        client = JobSubmissionClient(job_cluster.gcs_address)
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} main.py",
+            runtime_env={"working_dir": str(app)},
+        )
+        assert client.wait_until_finished(job_id, timeout=120) == SUCCEEDED
+        assert "from-working-dir" in client.get_job_logs(job_id)
+
+    def test_list_jobs(self, job_cluster):
+        client = JobSubmissionClient(job_cluster.gcs_address)
+        jobs = client.list_jobs()
+        assert len(jobs) >= 4
+        assert all("status" in j and "entrypoint" in j for j in jobs)

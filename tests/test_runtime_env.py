@@ -1,0 +1,120 @@
+"""Runtime environments: env vars + code shipping per task/actor.
+
+Mirrors ray: python/ray/tests/test_runtime_env_env_vars.py and
+test_runtime_env_working_dir.py on the lease-bound design: workers are
+bound to (accelerator env, runtime env) pairs and never leak one into
+another.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import runtime_env as rtenv_mod
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestNormalize:
+    def test_env_vars_only(self):
+        desc = rtenv_mod.normalize({"env_vars": {"A": "1"}}, kv_put=None)
+        assert desc == {"env_vars": {"A": "1"}}
+
+    def test_pip_rejected(self):
+        with pytest.raises(ValueError, match="hermetic"):
+            rtenv_mod.normalize({"pip": ["requests"]}, kv_put=None)
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown"):
+            rtenv_mod.normalize({"wat": 1}, kv_put=None)
+
+    def test_descriptor_key_stable(self):
+        a = rtenv_mod.descriptor_key({"env_vars": {"A": "1", "B": "2"}})
+        b = rtenv_mod.descriptor_key({"env_vars": {"B": "2", "A": "1"}})
+        assert a == b and a != rtenv_mod.descriptor_key(None)
+
+
+class TestEnvVars:
+    def test_task_sees_env_vars(self, cluster):
+        @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_PROBE": "yes"}})
+        def probe():
+            import os
+
+            return os.environ.get("RTENV_PROBE")
+
+        assert ray_tpu.get(probe.remote(), timeout=120) == "yes"
+
+    def test_isolation_between_envs(self, cluster):
+        """A task without the env must not see a leaked var from a worker
+        bound to a different runtime env."""
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_LEAK": "set"}})
+        def with_env():
+            import os
+
+            return os.environ.get("RTENV_LEAK")
+
+        @ray_tpu.remote
+        def without_env():
+            import os
+
+            return os.environ.get("RTENV_LEAK")
+
+        assert ray_tpu.get(with_env.remote(), timeout=120) == "set"
+        assert ray_tpu.get(without_env.remote(), timeout=120) is None
+
+    def test_actor_runtime_env(self, cluster):
+        @ray_tpu.remote
+        class Probe:
+            def env(self):
+                import os
+
+                return os.environ.get("RTENV_ACTOR")
+
+        a = Probe.options(
+            runtime_env={"env_vars": {"RTENV_ACTOR": "actor-env"}}
+        ).remote()
+        assert ray_tpu.get(a.env.remote(), timeout=120) == "actor-env"
+        ray_tpu.kill(a)
+
+
+class TestWorkingDir:
+    def test_working_dir_ships_code(self, cluster, tmp_path):
+        pkg = tmp_path / "mylib"
+        pkg.mkdir()
+        (pkg / "helper_mod_xyz.py").write_text(
+            "def value():\n    return 'shipped-code'\n"
+        )
+        (pkg / "data.txt").write_text("payload")
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+        def use_shipped():
+            import os
+
+            import helper_mod_xyz
+
+            with open("data.txt") as f:
+                data = f.read()
+            return helper_mod_xyz.value(), data, os.path.basename(os.getcwd())
+
+        val, data, cwd = ray_tpu.get(use_shipped.remote(), timeout=120)
+        assert val == "shipped-code"
+        assert data == "payload"
+
+    def test_py_modules(self, cluster, tmp_path):
+        mod = tmp_path / "extra_mod_abc.py"
+        mod.write_text("X = 77\n")
+
+        @ray_tpu.remote(runtime_env={"py_modules": [str(tmp_path)]})
+        def use_mod():
+            import extra_mod_abc
+
+            return extra_mod_abc.X
+
+        assert ray_tpu.get(use_mod.remote(), timeout=120) == 77
