@@ -74,6 +74,8 @@ D("metrics_push_interval_s", float, 5.0)
 # (ray analogue: object_manager 64MB chunks / ObjectBufferPool)
 D("transfer_chunk_bytes", int, 8 * 1024 * 1024)
 D("transfer_inflight_chunks", int, 4)
+# timeline ring size per process (api.timeline())
+D("timeline_max_events", int, 10_000)
 
 # --- object store ---
 D("object_store_bytes", int, 0)  # 0 = auto (30% of /dev/shm free, capped)

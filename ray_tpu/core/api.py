@@ -234,5 +234,9 @@ def get_runtime_context() -> _RuntimeContext:
 
 
 def timeline() -> list:
-    """Task timeline events (observability; fuller version in util.state)."""
-    return []
+    """Task lifecycle events recorded by this process: submit events plus
+    worker-side execution spans piggybacked on task replies (ray:
+    ray.timeline chrome-trace export role)."""
+    from ray_tpu.core.runtime import get_runtime
+
+    return get_runtime().timeline()

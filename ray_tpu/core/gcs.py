@@ -126,6 +126,7 @@ class ActorEntry:
     node_id: Optional[NodeID] = None
     lease_id: Optional[int] = None
     detached: bool = False
+    runtime_env: Optional[dict] = None  # descriptor for restart replay
     death_cause: Optional[str] = None
     num_pending_restart_waiters: int = 0
     # conn of the creating client while PENDING_CREATION; a PENDING actor
@@ -1253,14 +1254,19 @@ class GcsServer:
                 ),
             )
         log_path = os.path.join(jobs_dir, "driver.log")
-        log_f = open(log_path, "ab")
-        try:
-            proc = subprocess.Popen(
-                ["bash", "-c", p["entrypoint"]],
-                cwd=cwd, env=env, stdout=log_f, stderr=subprocess.STDOUT,
-            )
-        finally:
-            log_f.close()
+
+        def launch():
+            log_f = open(log_path, "ab")
+            try:
+                return subprocess.Popen(
+                    ["bash", "-c", p["entrypoint"]],
+                    cwd=cwd, env=env, stdout=log_f,
+                    stderr=subprocess.STDOUT,
+                )
+            finally:
+                log_f.close()
+
+        proc = await asyncio.get_running_loop().run_in_executor(None, launch)
         self.submitted_jobs[sub_id] = {
             "submission_id": sub_id,
             "entrypoint": p["entrypoint"],
@@ -1299,11 +1305,16 @@ class GcsServer:
         info = self.submitted_jobs.get(p["submission_id"])
         if info is None:
             raise rpc.RpcError(f"no job {p['submission_id']!r}")
-        try:
-            with open(info["log_path"], "rb") as f:
-                return f.read().decode("utf-8", "replace")
-        except FileNotFoundError:
-            return ""
+
+        def read():
+            try:
+                with open(info["log_path"], "rb") as f:
+                    return f.read().decode("utf-8", "replace")
+            except FileNotFoundError:
+                return ""
+
+        # off-loop: a multi-GB driver log must not stall heartbeats
+        return await asyncio.get_running_loop().run_in_executor(None, read)
 
     async def rpc_stop_job(self, conn, p):
         info = self.submitted_jobs.get(p["submission_id"])
@@ -1751,14 +1762,8 @@ class GcsServer:
             max_restarts=p.get("max_restarts", 0),
             creation_spec=p.get("creation_spec"),
             resources=p["resources"],
-            scheduling=dict(
-                p.get("strategy", {}) or {},
-                **(
-                    {"_runtime_env": p["runtime_env"]}
-                    if p.get("runtime_env")
-                    else {}
-                ),
-            ),
+            scheduling=p.get("strategy", {}),
+            runtime_env=p.get("runtime_env"),
             detached=p.get("detached", False),
             creator_conn=conn,
         )
@@ -1918,8 +1923,8 @@ class GcsServer:
                         pg, cands, demand, _GCS_SELF_CONN,
                         {
                             "actor_id": actor.actor_id.binary(),
-                            "runtime_env": actor.scheduling.get(
-                                "_runtime_env"
+                            "runtime_env": getattr(
+                                actor, "runtime_env", None
                             ),
                         },
                     )
@@ -1943,7 +1948,7 @@ class GcsServer:
                     node, demand, _GCS_SELF_CONN,
                     {
                         "actor_id": actor.actor_id.binary(),
-                        "runtime_env": actor.scheduling.get("_runtime_env"),
+                        "runtime_env": getattr(actor, "runtime_env", None),
                     },
                 )
             worker_conn = None

@@ -195,7 +195,24 @@ class Runtime:
         self._serialization.register_reducer(ObjectRef, self._reduce_ref)
         self._nested_ref_sink = threading.local()
         self._class_runtime_envs: Dict[Any, dict] = {}
+        # timeline: bounded ring of task lifecycle events for
+        # api.timeline() (ray: ray.timeline / chrome-trace export role)
+        from collections import deque
+
+        self._timeline = deque(maxlen=cfg.timeline_max_events)
         self._closed = False
+
+    def record_event(self, phase: str, name: str, task_id_hex: str,
+                     **extra) -> None:
+        self._timeline.append(
+            dict(phase=phase, name=name, task_id=task_id_hex,
+                 ts=time.time(), pid=os.getpid(), **extra)
+        )
+
+    def timeline(self) -> list:
+        """Chrome-trace-style task lifecycle events recorded by this
+        process (submit/start/end with worker-side execution spans)."""
+        return list(self._timeline)
 
     def _normalize_runtime_env(self, env: Optional[dict]) -> Optional[dict]:
         """Package + upload a runtime_env once; returns the descriptor."""
@@ -214,7 +231,7 @@ class Runtime:
                 self.gcs.call("put_blob", {"sha": sha, "data": value})
             )
 
-        return rtenv_mod.normalize(env, kv_put)
+        return rtenv_mod.normalize(env, kv_put, scope=self.gcs_address)
 
     # ---- loop bridging -------------------------------------------------
     def _run(self, coro, timeout: Optional[float] = None):
@@ -705,6 +722,7 @@ class Runtime:
             if item[0] in ("ref", "kwref")
         ]
         pending = PendingTask(spec, return_ids, max_retries, dep_oids=dep_oids)
+        self.record_event("submit", spec["name"], task_id.hex())
         # ref args stay pinned while the task is in flight, even if the
         # caller drops its own refs (reference: task-argument references,
         # reference_count.h)
@@ -882,6 +900,16 @@ class Runtime:
         )
         try:
             reply = await lease.conn.call("push_task", task.spec, timeout=-1)
+            if isinstance(reply, dict) and reply.get("exec_span"):
+                t0, t1 = reply["exec_span"]
+                self.record_event(
+                    "exec", task.spec["name"],
+                    task.spec["task_id"].hex(),
+                    worker=lease.worker_id.hex()
+                    if hasattr(lease.worker_id, "hex")
+                    else str(lease.worker_id),
+                    start=t0, dur=t1 - t0,
+                )
             self._apply_task_reply(task, reply)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             lease.broken = True

@@ -27,8 +27,11 @@ from typing import Any, Dict, List, Optional
 _MAX_PACKAGE_BYTES = 256 * 1024 * 1024
 _EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
 
-_uploaded_hashes: set = set()  # per-driver upload dedupe
-_normalize_cache: dict = {}  # json(env) -> descriptor (skip re-zipping)
+# caches are scoped by cluster (scope key = GCS address): a package
+# uploaded to cluster A must be re-uploaded when the driver reconnects
+# to cluster B with a fresh blob store
+_uploaded_hashes: set = set()  # (scope, sha) upload dedupe
+_normalize_cache: dict = {}  # (scope, json(env)) -> descriptor
 
 
 def _zip_path(path: str) -> bytes:
@@ -53,14 +56,17 @@ def _zip_path(path: str) -> bytes:
     return data
 
 
-def normalize(env: Optional[Dict[str, Any]], kv_put) -> Optional[dict]:
+def normalize(
+    env: Optional[Dict[str, Any]], kv_put, scope: str = ""
+) -> Optional[dict]:
     """Driver side: validate, package, upload; return the wire descriptor.
 
-    ``kv_put(key, value)`` stores a package once (content-addressed).
+    ``kv_put(key, value)`` stores a package once (content-addressed);
+    ``scope`` identifies the target cluster for cache invalidation.
     """
     if not env:
         return None
-    cache_key = json.dumps(env, sort_keys=True, default=str)
+    cache_key = (scope, json.dumps(env, sort_keys=True, default=str))
     cached = _normalize_cache.get(cache_key)
     if cached is not None:
         return cached
@@ -86,9 +92,9 @@ def normalize(env: Optional[Dict[str, Any]], kv_put) -> Optional[dict]:
     def upload(path: str) -> str:
         data = _zip_path(path)
         sha = hashlib.sha256(data).hexdigest()[:32]
-        if sha not in _uploaded_hashes:
+        if (scope, sha) not in _uploaded_hashes:
             kv_put(sha, data)
-            _uploaded_hashes.add(sha)
+            _uploaded_hashes.add((scope, sha))
         return sha
 
     if env.get("working_dir"):

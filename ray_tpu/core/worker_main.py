@@ -127,8 +127,11 @@ class WorkerServer:
             "start_time": time.time(),
         }
         try:
+            t0 = time.time()
             result = fn(*args, **kwargs)
-            return self._exec_pack(spec, result)
+            reply = self._exec_pack(spec, result)
+            reply["exec_span"] = (t0, time.time())
+            return reply
         except TaskCancelledError as e:
             return self._error_reply(e, spec)
         except BaseException as e:

@@ -19,3 +19,9 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_parquet,
     read_text,
 )
+
+from ray_tpu.data.datasource import (  # noqa: F401
+    Datasource,
+    FileBasedDatasource,
+    read_datasource,
+)

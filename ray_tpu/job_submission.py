@@ -64,6 +64,7 @@ class JobSubmissionClient:
                 kv_put=lambda sha, v: self._call(
                     "put_blob", {"sha": sha, "data": v}
                 ),
+                scope=self.address,
             )
         reply = self._call(
             "submit_job",
@@ -97,11 +98,14 @@ class JobSubmissionClient:
         self, submission_id: str, timeout: float = 300.0
     ) -> str:
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            status = self.get_job_status(submission_id)
+        status = self.get_job_status(submission_id)
+        while True:
             if status in (SUCCEEDED, FAILED, STOPPED):
                 return status
+            if time.monotonic() >= deadline:
+                break
             time.sleep(0.5)
+            status = self.get_job_status(submission_id)
         raise TimeoutError(
             f"job {submission_id} still {status!r} after {timeout}s"
         )

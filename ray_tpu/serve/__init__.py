@@ -20,4 +20,12 @@ from ray_tpu.serve.deployment import (  # noqa: F401
     Deployment,
     deployment,
 )
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.handle import (  # noqa: F401
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
+from ray_tpu.serve.schema import (  # noqa: F401
+    deploy_config,
+    deploy_config_file,
+)

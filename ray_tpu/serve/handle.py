@@ -175,6 +175,75 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming handle result: pull chunks from the pinned replica.
+
+    Role-equivalent of ray: serve's streaming DeploymentResponseGenerator
+    (ObjectRefGenerator-backed) — here a replica-pinned pull loop over
+    the actor transport.  Replica death mid-stream raises (generator
+    state is not reconstructible on another replica)."""
+
+    def __init__(self, router: Router, replica, sid: int, batch: int = 8):
+        self._router = router
+        self._replica = replica
+        self._sid = sid
+        self._batch = batch
+        self._buf: List[Any] = []
+        self._done = False
+        self._settled = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._buf:
+            if self._done:
+                self._settle()
+                raise StopIteration
+            try:
+                # no deadline: stream_next returns promptly (replica-side
+                # time budget), and arbitrarily slow generators are legal;
+                # replica death still raises via the actor error path
+                out = ray_tpu.get(
+                    self._replica.stream_next.remote(self._sid, self._batch),
+                    timeout=None,
+                )
+            except BaseException:
+                self._settle()
+                raise
+            self._buf.extend(out["items"])
+            self._done = out["done"]
+        return self._buf.pop(0)
+
+    def cancel(self):
+        if not self._done:
+            try:
+                ray_tpu.get(
+                    self._replica.stream_cancel.remote(self._sid), timeout=30
+                )
+            except Exception:
+                pass
+        self._done = True
+        self._settle()
+
+    def _settle(self):
+        if not self._settled:
+            self._settled = True
+            self._router.done(self._replica)
+
+    def __del__(self):
+        # abandoned mid-iteration (break without cancel): free the
+        # replica's stream state and ongoing-count, or the autoscaling
+        # signal counts a phantom in-flight request forever
+        try:
+            if not self._done:
+                self.cancel()
+            else:
+                self._settle()
+        except Exception:
+            pass
+
+
 class DeploymentHandle:
     def __init__(
         self,
@@ -182,21 +251,43 @@ class DeploymentHandle:
         app_name: str,
         deployment_name: str,
         method_name: str = "__call__",
+        stream: bool = False,
     ):
         self._controller = controller
         self._app = app_name
         self._deployment = deployment_name
         self._method = method_name
+        self._stream = stream
         self._router = Router(controller, app_name, deployment_name)
 
-    def options(self, method_name: str) -> "DeploymentHandle":
+    def options(
+        self, method_name: Optional[str] = None, stream: Optional[bool] = None
+    ) -> "DeploymentHandle":
         h = DeploymentHandle(
-            self._controller, self._app, self._deployment, method_name
+            self._controller,
+            self._app,
+            self._deployment,
+            method_name if method_name is not None else self._method,
+            stream if stream is not None else self._stream,
         )
         h._router = self._router  # share routing state
         return h
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            replica = self._router.pick()
+            try:
+                sid = ray_tpu.get(
+                    replica.handle_request_stream_start.remote(
+                        self._method, args, kwargs
+                    ),
+                    timeout=60,
+                )
+            except BaseException:
+                self._router.done(replica)
+                raise
+            return DeploymentResponseGenerator(self._router, replica, sid)
+
         def dispatch():
             replica = self._router.pick()
             ref = replica.handle_request.remote(self._method, args, kwargs)

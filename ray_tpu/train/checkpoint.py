@@ -87,6 +87,38 @@ class Checkpoint:
                 shutil.rmtree(self.path, ignore_errors=True)
         return Checkpoint(dest)
 
+    # -- jax pytree checkpoints (orbax) ----------------------------------
+    @classmethod
+    def from_pytree(cls, tree: Any) -> "Checkpoint":
+        """Save a jax pytree (params/opt state, sharded arrays included)
+        with orbax — the SPMD-native model-state path (the reference
+        delegates to torch.save/lightning; ray:
+        python/ray/train/torch/torch_checkpoint.py role)."""
+        import orbax.checkpoint as ocp
+
+        d = tempfile.mkdtemp(prefix="rt_ckpt_")
+        target = os.path.join(d, "pytree")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(target, tree)
+        ckptr.wait_until_finished()
+        return cls(d, _temp=True)
+
+    def to_pytree(self, abstract_tree: Any = None) -> Any:
+        """Restore an orbax pytree.  Pass ``abstract_tree`` (e.g.
+        jax.eval_shape output with shardings attached) to restore
+        sharded onto a mesh; None restores as host arrays."""
+        import orbax.checkpoint as ocp
+
+        target = os.path.join(self.path, "pytree")
+        if not os.path.isdir(target):
+            raise ValueError(
+                f"checkpoint at {self.path} was not created with from_pytree"
+            )
+        ckptr = ocp.StandardCheckpointer()
+        if abstract_tree is None:
+            return ckptr.restore(target)
+        return ckptr.restore(target, abstract_tree)
+
     def __repr__(self):
         return f"Checkpoint({self.path})"
 

@@ -252,3 +252,51 @@ class TestReviewRegressions:
         ).fit()
         assert len(grid.errors) == 1
         assert grid.get_best_result().metrics["acc"] == 2
+
+
+class TestDatasourcePlugin:
+    """Custom Datasource surface (ray: ray.data.read_datasource)."""
+
+    def test_custom_datasource(self, cluster):
+        import ray_tpu.data as rtd
+        from ray_tpu.data.dataset import ReadTask
+
+        class SquaresSource(rtd.Datasource):
+            def __init__(self, n_blocks):
+                self.n_blocks = n_blocks
+
+            def get_read_tasks(self, parallelism):
+                from ray_tpu.data import block as block_mod
+
+                def load(i):
+                    return block_mod.from_rows(
+                        [{"v": (i * 10 + j) ** 2} for j in range(3)]
+                    )
+
+                return [ReadTask(load, i) for i in range(self.n_blocks)]
+
+        ds = rtd.read_datasource(SquaresSource(3))
+        vals = sorted(r["v"] for r in ds.take_all())
+        expect = sorted((i * 10 + j) ** 2 for i in range(3) for j in range(3))
+        assert vals == expect
+
+    def test_file_based_datasource_custom_reader(self, cluster, tmp_path):
+        import ray_tpu.data as rtd
+
+        for i in range(3):
+            (tmp_path / f"f{i}.vals").write_text("\n".join(
+                str(i * 100 + j) for j in range(4)))
+
+        def read_vals(path):
+            from ray_tpu.data import block as block_mod
+
+            with open(path) as f:
+                return block_mod.from_rows(
+                    [{"n": int(line)} for line in f if line.strip()]
+                )
+
+        src = rtd.FileBasedDatasource(
+            str(tmp_path), suffix=".vals", reader=read_vals
+        )
+        ds = rtd.read_datasource(src)
+        assert ds.count() == 12

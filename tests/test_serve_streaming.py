@@ -1,0 +1,130 @@
+"""Serve streaming responses + declarative config; runtime timeline.
+
+Mirrors ray: serve streaming (test_streaming_response.py) and the
+ServeDeploySchema declarative deploy path (test_schema.py), plus the
+ray.timeline() event surface.
+"""
+
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+class TestStreaming:
+    def test_generator_streams_in_order(self, cluster):
+        @serve.deployment
+        class Streamer:
+            def stream(self, n):
+                for i in range(n):
+                    yield i * i
+
+        h = serve.run(Streamer.bind(), name="stream_app", route_prefix=None)
+        gen = h.options(method_name="stream", stream=True).remote(25)
+        assert list(gen) == [i * i for i in range(25)]
+        serve.delete("stream_app")
+
+    def test_stream_cancel_releases_slot(self, cluster):
+        @serve.deployment
+        class Inf:
+            def forever(self):
+                i = 0
+                while True:
+                    yield i
+                    i += 1
+
+        h = serve.run(Inf.bind(), name="inf_app", route_prefix=None)
+        gen = h.options(method_name="forever", stream=True).remote()
+        got = [next(gen) for _ in range(5)]
+        assert got == [0, 1, 2, 3, 4]
+        gen.cancel()
+        # slot released: a fresh unary call still routes fine
+        assert (
+            h.options(method_name="forever", stream=True).remote()
+            is not None
+        )
+        serve.delete("inf_app")
+
+    def test_non_generator_stream_call_errors(self, cluster):
+        @serve.deployment
+        class Plain:
+            def __call__(self):
+                return 42
+
+        h = serve.run(Plain.bind(), name="plain_app", route_prefix=None)
+        from ray_tpu.core.errors import TaskError
+
+        with pytest.raises(Exception, match="expected a generator"):
+            h.options(stream=True).remote()
+        serve.delete("plain_app")
+
+
+class TestDeclarativeConfig:
+    def test_deploy_config_import_path(self, cluster, tmp_path):
+        mod_dir = tmp_path / "servemods"
+        mod_dir.mkdir()
+        (mod_dir / "my_serve_app_xyz.py").write_text(
+            "from ray_tpu import serve\n"
+            "@serve.deployment\n"
+            "class Echo:\n"
+            "    def __call__(self, x):\n"
+            "        return ('echo', x)\n"
+            "app = Echo.bind()\n"
+        )
+        sys.path.insert(0, str(mod_dir))
+        try:
+            handles = serve.deploy_config({
+                "applications": [
+                    {
+                        "name": "cfg_app",
+                        "import_path": "my_serve_app_xyz:app",
+                        "route_prefix": None,
+                        "deployments": [
+                            {"name": "Echo", "num_replicas": 2}
+                        ],
+                    }
+                ]
+            })
+            h = handles["cfg_app"]
+            assert h.remote(7).result(timeout_s=60) == ("echo", 7)
+            st = serve.status()
+            assert st["cfg_app"]["Echo"]["target_replicas"] == 2
+        finally:
+            sys.path.remove(str(mod_dir))
+            serve.delete("cfg_app")
+
+    def test_unknown_deployment_option_rejected(self, cluster):
+        with pytest.raises(ValueError, match="unknown deployment option"):
+            serve.deploy_config({
+                "applications": [{
+                    "name": "x",
+                    "import_path": "mod:app",
+                    "deployments": [{"name": "d", "wat": 1}],
+                }]
+            })
+
+
+class TestTimeline:
+    def test_timeline_records_submit_and_exec(self, cluster):
+        @ray_tpu.remote
+        def traced_task():
+            return 1
+
+        assert ray_tpu.get(traced_task.remote(), timeout=60) == 1
+        events = ray_tpu.timeline()
+        submits = [e for e in events if e["phase"] == "submit"
+                   and "traced_task" in e["name"]]
+        execs = [e for e in events if e["phase"] == "exec"
+                 and "traced_task" in e["name"]]
+        assert submits, events[-5:]
+        assert execs and execs[-1]["dur"] >= 0
