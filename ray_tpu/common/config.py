@@ -110,6 +110,9 @@ D("actor_max_restarts_default", int, 0)
 # --- data streaming ---
 D("data_streaming_window", int, 8)  # max blocks in production at once
 
+# --- workflows ---
+D("workflow_storage", str, "/tmp/ray_tpu/workflows")
+
 # --- refcounting / lineage ---
 D("ref_flush_interval_s", float, 0.05)  # batch window for holder updates
 D("lineage_reconstruction_max", int, 3)  # re-executions per lost task

@@ -24,6 +24,13 @@ class ActorMethod:
     def options(self, num_returns: int = 1) -> "ActorMethod":
         return ActorMethod(self._handle, self._name, num_returns)
 
+    def bind(self, *args):
+        """Lazy DAG binding (ray: python/ray/dag/class_node.py).  Returns
+        a ClassMethodNode for `experimental_compile()`."""
+        from ray_tpu.dag.compiled_dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args)
+
     def remote(self, *args, **kwargs):
         from ray_tpu.core.runtime import get_runtime
 
@@ -47,6 +54,14 @@ class ActorHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         return ActorMethod(self, name)
+
+    def _apply(self, fn, *args, **kwargs):
+        """Run `fn(actor_instance, *args, **kwargs)` inside the actor
+        process (reference: ActorHandle.__ray_call__).  Used by compiled
+        DAGs to park exec loops on actors; generally useful for
+        introspection and weight extraction without touching the user's
+        class."""
+        return ActorMethod(self, "__rt_apply__").remote(fn, *args, **kwargs)
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()[:12]})"

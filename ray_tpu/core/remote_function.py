@@ -41,6 +41,13 @@ class RemoteFunction:
         merged.update(opts)
         return RemoteFunction(self._fn, **merged)
 
+    def bind(self, *args, **kwargs):
+        """Lazy task-DAG binding (ray: python/ray/dag/function_node.py);
+        consumed by ray_tpu.workflow for durable graphs."""
+        from ray_tpu.workflow.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         from ray_tpu.core.runtime import get_runtime
 

@@ -231,10 +231,19 @@ class WorkerServer:
             return self._error_reply(
                 RuntimeError("actor instance not created on this worker"), spec
             )
-        try:
-            method = getattr(self.actor_instance, spec["method"])
-        except AttributeError as e:
-            return self._error_reply(e, spec)
+        if spec["method"] == "__rt_apply__":
+            # generic in-actor apply (reference: __ray_call__): first arg
+            # is a function called as fn(instance, *rest) — the compiled
+            # DAG exec loop rides this, as can any diagnostic.
+            inst = self.actor_instance
+
+            def method(__fn, *a, **kw):
+                return __fn(inst, *a, **kw)
+        else:
+            try:
+                method = getattr(self.actor_instance, spec["method"])
+            except AttributeError as e:
+                return self._error_reply(e, spec)
 
         caller = spec.get("caller_id", b"")
         seq = spec.get("seq")

@@ -1,0 +1,127 @@
+"""Durable workflow storage: one directory per workflow id.
+
+Role-equivalent of ray: python/ray/workflow/workflow_storage.py — step
+results and the pickled DAG live as files; writes are atomic
+(tmp + rename) so a crash mid-checkpoint never leaves a half step that
+resume would trust.
+
+Layout::
+
+    <root>/<workflow_id>/
+        dag.pkl            cloudpickled FunctionNode graph
+        meta.json          {status, created_at, finished_at, error}
+        steps/<step_id>.pkl   checkpointed step outputs
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, List, Optional
+
+import cloudpickle
+
+from ray_tpu.common.config import cfg
+
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str, root: Optional[str] = None):
+        self.workflow_id = workflow_id
+        self.root = root or cfg.workflow_storage
+        self.dir = os.path.join(self.root, workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        # NOTE: directories are created lazily by the write paths —
+        # read-only queries of unknown ids must not pollute the root.
+
+    # -- dag -----------------------------------------------------------
+
+    def save_dag(self, node) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        _atomic_write(
+            os.path.join(self.dir, "dag.pkl"), cloudpickle.dumps(node)
+        )
+
+    def load_dag(self):
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return pickle.loads(f.read())
+
+    # -- meta ----------------------------------------------------------
+
+    def save_meta(self, **updates) -> dict:
+        meta = self.load_meta() or {
+            "workflow_id": self.workflow_id,
+            "created_at": time.time(),
+        }
+        meta.update(updates)
+        os.makedirs(self.dir, exist_ok=True)
+        _atomic_write(
+            os.path.join(self.dir, "meta.json"),
+            json.dumps(meta).encode(),
+        )
+        return meta
+
+    def load_meta(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.dir, "meta.json")) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    # -- steps ---------------------------------------------------------
+
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.steps_dir, step_id + ".pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def save_step(self, step_id: str, value: Any) -> None:
+        os.makedirs(self.steps_dir, exist_ok=True)
+        _atomic_write(self._step_path(step_id), cloudpickle.dumps(value))
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self._step_path(step_id), "rb") as f:
+            return pickle.loads(f.read())
+
+    def completed_steps(self) -> List[str]:
+        try:
+            return sorted(
+                f[:-4]
+                for f in os.listdir(self.steps_dir)
+                if f.endswith(".pkl")
+            )
+        except FileNotFoundError:
+            return []
+
+    def delete(self) -> None:
+        import shutil
+
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def list_workflow_ids(root: Optional[str] = None) -> List[str]:
+    root = root or cfg.workflow_storage
+    try:
+        return sorted(
+            d
+            for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+    except FileNotFoundError:
+        return []

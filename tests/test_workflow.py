@@ -1,0 +1,126 @@
+"""Workflow tests: durable execution, resume-from-checkpoint, status API.
+
+Mirrors ray: python/ray/workflow/tests/test_basic_workflows.py areas on
+the wave-based executor + file storage.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def wf_storage(tmp_path):
+    return str(tmp_path / "wfs")
+
+
+@ray_tpu.remote
+def const(x):
+    return x
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def mul(a, b):
+    return a * b
+
+
+class TestWorkflowBasics:
+    def test_diamond_dag(self, cluster, wf_storage):
+        a = const.bind(2)
+        b = mul.bind(a, 3)
+        c = mul.bind(a, 5)
+        root = add.bind(b, c)
+        assert workflow.run(root, workflow_id="diamond",
+                            storage=wf_storage) == 16
+        assert workflow.get_status("diamond", storage=wf_storage) == (
+            workflow.SUCCEEDED
+        )
+        assert workflow.get_output("diamond", storage=wf_storage) == 16
+
+    def test_kwargs_and_consts(self, cluster, wf_storage):
+        @ray_tpu.remote
+        def lin(x, m=1, c=0):
+            return x * m + c
+
+        root = lin.bind(const.bind(10), m=3, c=4)
+        assert workflow.run(root, storage=wf_storage) == 34
+
+    def test_list_and_delete(self, cluster, wf_storage):
+        workflow.run(const.bind(1), workflow_id="keep", storage=wf_storage)
+        workflow.run(const.bind(2), workflow_id="drop", storage=wf_storage)
+        ids = {m["workflow_id"] for m in workflow.list_all(storage=wf_storage)}
+        assert {"keep", "drop"} <= ids
+        workflow.delete("drop", storage=wf_storage)
+        ids = {m["workflow_id"] for m in workflow.list_all(storage=wf_storage)}
+        assert "drop" not in ids
+
+    def test_run_async(self, cluster, wf_storage):
+        fut = workflow.run_async(add.bind(const.bind(1), const.bind(2)),
+                                 storage=wf_storage)
+        assert fut.result(timeout=120) == 3
+
+
+class TestWorkflowResume:
+    def test_resume_skips_completed_steps(self, cluster, wf_storage,
+                                          tmp_path):
+        """A step fails on first run; resume re-runs ONLY that step."""
+        marker_dir = str(tmp_path / "markers")
+        os.makedirs(marker_dir, exist_ok=True)
+
+        @ray_tpu.remote
+        def counted(tag, x, markers):
+            # side-effect file counts executions of each step
+            path = os.path.join(markers, tag)
+            n = int(open(path).read()) if os.path.exists(path) else 0
+            with open(path, "w") as f:
+                f.write(str(n + 1))
+            return x
+
+        @ray_tpu.remote
+        def flaky(x, markers):
+            flag = os.path.join(markers, "flaky_ok")
+            if not os.path.exists(flag):
+                with open(flag, "w") as f:
+                    f.write("armed")
+                raise RuntimeError("first attempt dies")
+            return x + 100
+
+        a = counted.options(max_retries=0).bind("a", 7, marker_dir)
+        root = flaky.options(max_retries=0).bind(a, marker_dir)
+
+        with pytest.raises(Exception):
+            workflow.run(root, workflow_id="flaky-wf", storage=wf_storage)
+        assert workflow.get_status("flaky-wf", storage=wf_storage) == (
+            workflow.FAILED
+        )
+        assert workflow.resume("flaky-wf", storage=wf_storage) == 107
+        # step "a" checkpointed on the first run — executed exactly once
+        assert open(os.path.join(marker_dir, "a")).read() == "1"
+
+    def test_get_output_of_unfinished_raises(self, cluster, wf_storage):
+        with pytest.raises(Exception):
+            workflow.run(
+                add.bind(const.bind(1), "not-a-number"),
+                workflow_id="bad", storage=wf_storage,
+            )
+        with pytest.raises(workflow.WorkflowError):
+            workflow.get_output("bad", storage=wf_storage)
+
+    def test_unknown_workflow(self, cluster, wf_storage):
+        with pytest.raises(workflow.WorkflowNotFoundError):
+            workflow.get_status("nope", storage=wf_storage)
