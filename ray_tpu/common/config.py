@@ -110,6 +110,12 @@ D("actor_max_restarts_default", int, 0)
 # --- data streaming ---
 D("data_streaming_window", int, 8)  # max blocks in production at once
 
+# --- memory monitor (OOM protection) ---
+D("memory_usage_threshold", float, 0.95)  # kill workers above this
+D("memory_monitor_interval_s", float, 1.0)  # 0 disables the monitor
+D("memory_monitor_kill_grace_s", float, 3.0)  # min spacing between kills
+D("memory_monitor_fake_usage_file", str, "")  # test override
+
 # --- workflows ---
 D("workflow_storage", str, "/tmp/ray_tpu/workflows")
 

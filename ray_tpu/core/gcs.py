@@ -364,6 +364,7 @@ class GcsServer:
         self._conn_node: Dict[rpc.Connection, NodeID] = {}
         self._conn_job: Dict[rpc.Connection, JobID] = {}
         self._worker_conns: Dict[WorkerID, rpc.Connection] = {}
+        self._worker_death_reasons: Dict[bytes, str] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._start_time = time.time()
         # observability: reporter id -> latest metric snapshot
@@ -1990,6 +1991,13 @@ class GcsServer:
     async def rpc_worker_died(self, conn, p):
         """Raylet reports a worker process exited."""
         wid = WorkerID(p["worker_id"])
+        # keep a bounded trail of death reasons so drivers can enrich
+        # their WorkerCrashedError (e.g. "killed by the memory monitor")
+        self._worker_death_reasons[wid.binary()] = p.get("reason") or ""
+        while len(self._worker_death_reasons) > 1000:
+            self._worker_death_reasons.pop(
+                next(iter(self._worker_death_reasons))
+            )
         self._worker_conns.pop(wid, None)
         self._scrub_holder(wid.binary())
         for lease_id, lease in list(self.leases.items()):
@@ -2003,6 +2011,11 @@ class GcsServer:
                             actor, f"worker died: {p.get('reason', 'unknown')}"
                         )
         return True
+
+    async def rpc_get_worker_death_info(self, conn, p):
+        return {
+            "reason": self._worker_death_reasons.get(p["worker_id"], "")
+        }
 
     async def rpc_list_actors(self, conn, p):
         return [

@@ -47,6 +47,7 @@ class WorkerEntry:
     lease_id: Optional[int] = None
     tpu_chips: tuple = ()
     started_at: float = field(default_factory=time.monotonic)
+    leased_at: float = 0.0  # monotonic time of the CURRENT lease grant
 
     @property
     def idle(self) -> bool:
@@ -116,6 +117,11 @@ class Raylet:
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         self._tasks.append(loop.create_task(self._reaper_loop()))
+        if cfg.memory_monitor_interval_s > 0:
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(self)
+            self._tasks.append(loop.create_task(self.memory_monitor.loop()))
         n_prestart = min(int(self.resources.get("CPU", 0)), cfg.worker_pool_prestart)
         for _ in range(n_prestart):
             self._spawn_worker()
@@ -347,6 +353,7 @@ class Raylet:
                 await self._evict_idle_chip_holders(n_tpu)
             if w is not None:
                 w.lease_id = p["lease_id"]
+                w.leased_at = time.monotonic()
                 return {
                     "worker_id": w.worker_id.binary(),
                     "worker_addr": w.addr,
@@ -405,6 +412,7 @@ class Raylet:
             # reused exact-match worker: give back the duplicate allocation
             self._release_accel_env(accel_env)
         w.lease_id = p["lease_id"]
+        w.leased_at = time.monotonic()
         return {
             "worker_id": w.worker_id.binary(),
             "worker_addr": w.addr,
@@ -429,7 +437,10 @@ class Raylet:
         ).append(w)
         return True
 
-    async def _on_worker_exit(self, w: WorkerEntry, kill: bool = False):
+    async def _on_worker_exit(
+        self, w: WorkerEntry, kill: bool = False,
+        reason: Optional[str] = None,
+    ):
         self.workers.pop(w.worker_id, None)
         for pool in self._idle_by_env.values():
             if w in pool:
@@ -441,7 +452,8 @@ class Raylet:
                 w.proc.terminate()
             except Exception:
                 pass
-        reason = f"exit code {w.proc.poll()}"
+        if reason is None:
+            reason = f"exit code {w.proc.poll()}"
         try:
             await self.gcs.notify(
                 "worker_died",

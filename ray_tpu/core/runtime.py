@@ -487,6 +487,27 @@ class Runtime:
                 results[oid] = await self._resolve_one(oid, deadline)
         return [results[oid] for oid in oids]
 
+    async def _worker_death_detail(self, worker_id) -> str:
+        """Ask the GCS why a worker died (e.g. the memory monitor killed
+        it).  The raylet's death notification races our ConnectionLost,
+        so poll briefly; empty string when nothing is recorded."""
+        wid = (
+            worker_id.binary() if hasattr(worker_id, "binary") else worker_id
+        )
+        for _ in range(4):
+            try:
+                info = await asyncio.wait_for(
+                    self.gcs.call("get_worker_death_info",
+                                  {"worker_id": wid}),
+                    timeout=2.0,
+                )
+                if info.get("reason"):
+                    return f" ({info['reason']})"
+            except Exception:
+                return ""
+            await asyncio.sleep(0.5)
+        return ""
+
     async def _resolve_one(self, oid: bytes, deadline) -> Any:
         failed_pulls = 0
         while True:
@@ -917,10 +938,12 @@ class Runtime:
                 task.retries_left -= 1
                 st.queue.append(task)
             else:
+                detail = await self._worker_death_detail(lease.worker_id)
                 self._fail_task(
                     task,
                     WorkerCrashedError(
-                        f"worker died while running {task.spec['name']}: {e}"
+                        f"worker died while running {task.spec['name']}: "
+                        f"{e}{detail}"
                     ),
                 )
         finally:

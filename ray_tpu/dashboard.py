@@ -1,0 +1,222 @@
+"""Cluster dashboard: HTTP JSON API + single-page UI over live state.
+
+Role-equivalent of ray: dashboard/head.py:81 + dashboard/modules/
+{node,actor,job,metrics,state}/ — collapsed into one aiohttp server fed
+directly from the GCS tables through the state API, instead of a head
+process + per-node agents + React build.  The UI is a self-contained
+HTML page (no build step) polling the JSON endpoints.
+
+Endpoints::
+
+    GET /                       single-page UI
+    GET /healthz                liveness probe
+    GET /api/summary            cluster summary (ray status analogue)
+    GET /api/nodes|actors|tasks|workers|objects|placement_groups
+    GET /api/jobs               submitted jobs (job_submission)
+    GET /api/metrics            aggregated Counter/Gauge/Histogram points
+    GET /api/timeline           chrome-trace events
+    GET /api/logs               log files in this node's session dir
+    GET /api/logs/{name}?lines=N   tail one log file
+
+Logs are served from the dashboard node's own session dir; in this
+repo's single-host test topology every raylet shares the host, so all
+worker logs are visible.  (A per-node log RPC is the multi-host
+extension point, like the reference's dashboard agents.)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+DASHBOARD_NAME = "_rt_dashboard"
+
+_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.4rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:4px 8px;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} pre{background:#fff;border:1px solid #ddd;padding:8px}
+ .pill{display:inline-block;padding:0 6px;border-radius:8px;background:#e8f0fe}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="summary"></div>
+<h2>Nodes</h2><div id="nodes"></div>
+<h2>Actors</h2><div id="actors"></div>
+<h2>Placement groups</h2><div id="placement_groups"></div>
+<h2>Jobs</h2><div id="jobs"></div>
+<h2>Metrics</h2><div id="metrics"></div>
+<script>
+function table(rows){
+  if(!rows || !rows.length) return '<i>none</i>';
+  const cols=[...new Set(rows.flatMap(r=>Object.keys(r)))];
+  let h='<table><tr>'+cols.map(c=>'<th>'+c+'</th>').join('')+'</tr>';
+  for(const r of rows) h+='<tr>'+cols.map(c=>'<td>'+
+    (typeof r[c]==='object'?JSON.stringify(r[c]):String(r[c]??''))+'</td>').join('')+'</tr>';
+  return h+'</table>';
+}
+async function refresh(){
+  for(const name of ['nodes','actors','placement_groups','jobs','metrics']){
+    try{const r=await fetch('/api/'+name);
+        document.getElementById(name).innerHTML=table(await r.json());}catch(e){}
+  }
+  try{const s=await(await fetch('/api/summary')).json();
+      document.getElementById('summary').innerHTML='<pre>'+JSON.stringify(s,null,1)+'</pre>';}catch(e){}
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
+@ray_tpu.remote
+class DashboardActor:
+    """Serves the dashboard; runs as a detached actor on the cluster."""
+
+    def __init__(self, port: int = 8265):
+        self._port = port
+        self._runner = None
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        if self._runner is not None:  # idempotent under get_if_exists reuse
+            return self._port
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/api/summary", self._summary)
+        for name in ("nodes", "actors", "tasks", "workers", "objects",
+                     "placement_groups"):
+            app.router.add_get(f"/api/{name}", self._make_list(name))
+        app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/metrics", self._metrics)
+        app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/api/logs", self._logs_index)
+        app.router.add_get("/api/logs/{name}", self._logs_tail)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "0.0.0.0", self._port)
+        await site.start()
+        if self._port == 0:  # ephemeral: report the bound port
+            for server in self._runner.sites:
+                sock = server._server.sockets[0]
+                self._port = sock.getsockname()[1]
+                break
+        return self._port
+
+    def ping(self) -> bool:
+        return True
+
+    # -- handlers ------------------------------------------------------
+
+    async def _index(self, req):
+        from aiohttp import web
+
+        return web.Response(text=_HTML, content_type="text/html")
+
+    async def _healthz(self, req):
+        from aiohttp import web
+
+        return web.json_response({"ok": True})
+
+    def _json(self, payload):
+        from aiohttp import web
+
+        return web.Response(
+            text=json.dumps(payload, default=str),
+            content_type="application/json",
+        )
+
+    async def _offload(self, fn):
+        # state calls block on runtime._run, which posts to THIS actor's
+        # event loop — run them in an executor thread or they deadlock
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(None, fn)
+
+    async def _summary(self, req):
+        from ray_tpu.util import state
+
+        return self._json(await self._offload(state.summarize))
+
+    def _make_list(self, name):
+        async def handler(req):
+            from ray_tpu.util import state
+
+            fn = getattr(state, f"list_{name}")
+            return self._json(await self._offload(fn))
+
+        return handler
+
+    async def _jobs(self, req):
+        from ray_tpu.core.runtime import get_runtime
+
+        def call():
+            rt = get_runtime()
+            return rt._run(rt.gcs.call("list_jobs", {}))
+
+        return self._json(await self._offload(call))
+
+    async def _metrics(self, req):
+        from ray_tpu.util import state
+
+        return self._json(await self._offload(state.get_metrics))
+
+    async def _timeline(self, req):
+        return self._json(await self._offload(ray_tpu.timeline))
+
+    def _session_dir(self) -> str:
+        return os.environ.get("RT_SESSION_DIR", "/tmp/ray_tpu")
+
+    async def _logs_index(self, req):
+        d = self._session_dir()
+        try:
+            files = sorted(
+                f for f in os.listdir(d) if f.endswith(".log")
+            )
+        except FileNotFoundError:
+            files = []
+        return self._json([{"name": f, "size": os.path.getsize(
+            os.path.join(d, f))} for f in files])
+
+    async def _logs_tail(self, req):
+        from aiohttp import web
+
+        name = req.match_info["name"]
+        if "/" in name or ".." in name or not name.endswith(".log"):
+            return web.Response(status=400, text="bad log name")
+        path = os.path.join(self._session_dir(), name)
+        if not os.path.exists(path):
+            return web.Response(status=404, text="no such log")
+        lines = int(req.query.get("lines", "200"))
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 256 * 1024))
+            tail = f.read().decode("utf-8", "replace").splitlines()[-lines:]
+        return web.Response(text="\n".join(tail), content_type="text/plain")
+
+
+def start_dashboard(port: int = 8265) -> str:
+    """Start (or reuse) the cluster dashboard; returns its URL."""
+    actor = DashboardActor.options(
+        name=DASHBOARD_NAME, get_if_exists=True, lifetime="detached",
+        num_cpus=0.1,
+    ).remote(port)
+    bound = ray_tpu.get(actor.start.remote(), timeout=120)
+    return f"http://127.0.0.1:{bound}"
+
+
+def stop_dashboard() -> None:
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_NAME)
+        ray_tpu.kill(actor)
+    except Exception:
+        pass
