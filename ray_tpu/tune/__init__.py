@@ -10,10 +10,15 @@ ResultGrid.
 from ray_tpu.train.session import get_checkpoint, report  # noqa: F401
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
+    AsyncHyperBandScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,

@@ -171,3 +171,113 @@ class PopulationBasedTraining:
         me.config = self._explore(dict(src.config))
         self.num_perturbations += 1
         return RESTART
+
+
+class AsyncHyperBandScheduler:
+    """Multi-bracket asynchronous HyperBand.
+
+    Role-equivalent of ray: python/ray/tune/schedulers/async_hyperband.py
+    (AsyncHyperBandScheduler with brackets > 1; the repo's ASHAScheduler
+    is the single-bracket special case).  Trials are assigned round-robin
+    to `brackets` ASHA instances whose grace periods grow geometrically
+    (grace, grace*rf, grace*rf^2, ...), hedging the early-culling
+    aggressiveness against slow starters.  Pair with TPESearcher for the
+    BOHB pairing (schedulers cull, searcher models; ray: tune/schedulers/
+    hb_bohb.py + search/bohb/).
+    """
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = None,
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        brackets: int = 3,
+    ):
+        assert mode in (None, "min", "max")
+        self.metric = metric
+        self.mode = mode
+        self._brackets = []
+        for s in range(max(1, brackets)):
+            g = grace_period * (reduction_factor ** s)
+            if g >= max_t:
+                break
+            b = ASHAScheduler(
+                metric=metric, mode=mode, time_attr=time_attr, max_t=max_t,
+                grace_period=g, reduction_factor=reduction_factor,
+            )
+            self._brackets.append(b)
+        if not self._brackets:
+            self._brackets.append(
+                ASHAScheduler(metric=metric, mode=mode, time_attr=time_attr,
+                              max_t=max_t, grace_period=grace_period,
+                              reduction_factor=reduction_factor)
+            )
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def __setattr__(self, name, value):
+        # metric/mode set late by the Tuner propagate into the brackets
+        super().__setattr__(name, value)
+        if name in ("metric", "mode") and getattr(self, "_brackets", None):
+            for b in self._brackets:
+                setattr(b, name, value)
+
+    def on_trial_result(self, trial_id: str, result: dict) -> str:
+        i = self._assignment.get(trial_id)
+        if i is None:
+            i = self._assignment[trial_id] = self._next % len(self._brackets)
+            self._next += 1
+        return self._brackets[i].on_trial_result(trial_id, result)
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average falls below the median of the
+    other trials' running averages at the same step.
+
+    Role-equivalent of ray: python/ray/tune/schedulers/median_stopping_rule.py
+    (MedianStoppingRule): per-trial mean over reported scores so far,
+    compared against the median of completed means after a grace period.
+    """
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = None,
+        time_attr: str = "training_iteration",
+        grace_period: int = 4,
+        min_samples_required: int = 3,
+    ):
+        assert mode in (None, "min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._sums: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    def _score(self, result: dict) -> float:
+        v = float(result[self.metric])
+        return v if (self.mode or "max") == "max" else -v
+
+    def on_trial_result(self, trial_id: str, result: dict) -> str:
+        s = self._score(result)
+        self._sums[trial_id] = self._sums.get(trial_id, 0.0) + s
+        self._counts[trial_id] = self._counts.get(trial_id, 0) + 1
+        t = int(result.get(self.time_attr, self._counts[trial_id]))
+        if t < self.grace_period:
+            return CONTINUE
+        means = [
+            self._sums[tid] / self._counts[tid]
+            for tid in self._sums
+            if tid != trial_id
+        ]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        means.sort()
+        median = means[len(means) // 2]
+        my_mean = self._sums[trial_id] / self._counts[trial_id]
+        return STOP if my_mean < median else CONTINUE

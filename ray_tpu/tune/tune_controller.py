@@ -54,6 +54,8 @@ class TuneController:
         max_concurrent: int = 0,
         experiment_dir: str = "/tmp/ray_tpu_results/tune",
         experiment_name: str = "tune",
+        searcher=None,
+        trial_factory: Optional[Callable[[Dict[str, Any]], Trial]] = None,
     ):
         self.trainable = trainable
         self.trials = trials
@@ -61,6 +63,12 @@ class TuneController:
         self.max_concurrent = max_concurrent  # 0 = unlimited
         self.experiment_dir = experiment_dir
         self.experiment_name = experiment_name
+        # sequential search (TPE etc.): trials are created on demand from
+        # searcher.suggest() instead of all up front (reference:
+        # tune/search/search_generator.py)
+        self.searcher = searcher
+        self.trial_factory = trial_factory
+        self._search_exhausted = searcher is None
 
     # -- trial lifecycle -------------------------------------------------
     def _launch(self, trial: Trial, from_checkpoint: Optional[Checkpoint] = None):
@@ -92,6 +100,13 @@ class TuneController:
             except Exception:
                 pass
             trial.actor = None
+        if self.searcher is not None:
+            try:
+                self.searcher.on_trial_complete(
+                    trial.trial_id, trial.last_result or None
+                )
+            except Exception:
+                pass
 
     # -- the loop --------------------------------------------------------
     def run(self) -> List[Trial]:
@@ -112,13 +127,29 @@ class TuneController:
         pending = [t for t in self.trials if t.status == PENDING]
         outstanding: Dict[Any, Trial] = {}  # next_report ref -> trial
 
+        def top_up():
+            """Pull new trials from the searcher up to free capacity."""
+            if self._search_exhausted:
+                return
+            while len(pending) < max(1, capacity()):
+                tid = f"{self.experiment_name}_{len(self.trials):05d}"
+                cfg = self.searcher.suggest(tid)
+                if cfg is None:
+                    self._search_exhausted = True
+                    return
+                trial = self.trial_factory(tid, cfg)
+                self.trials.append(trial)
+                pending.append(trial)
+
         def capacity() -> int:
             running = sum(1 for t in self.trials if t.status == RUNNING)
             if self.max_concurrent <= 0:
                 return len(pending)
             return max(0, self.max_concurrent - running)
 
-        while pending or outstanding:
+        top_up()
+        while pending or outstanding or not self._search_exhausted:
+            top_up()
             for _ in range(min(capacity(), len(pending))):
                 trial = pending.pop(0)
                 self._launch(trial)

@@ -30,6 +30,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 0  # 0 = unlimited
     scheduler: Any = None
+    search_alg: Any = None  # a tune.search.Searcher (e.g. TPESearcher)
     seed: Optional[int] = None
 
 
@@ -139,9 +140,12 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
-        configs = generate_variants(
-            self.param_space, num_samples=tc.num_samples, seed=tc.seed
-        )
+        if tc.search_alg is not None:
+            configs = []  # trials come from the searcher, one at a time
+        else:
+            configs = generate_variants(
+                self.param_space, num_samples=tc.num_samples, seed=tc.seed
+            )
         name = self.run_config.name or "tune_run"
         exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
         scheduler = tc.scheduler or FIFOScheduler()
@@ -166,6 +170,24 @@ class Tuner:
             )
             for i, cfg in enumerate(configs)
         ]
+        searcher = tc.search_alg
+        trial_factory = None
+        if searcher is not None:
+            searcher.set_search_properties(tc.metric, tc.mode)
+            if getattr(searcher, "metric", None) is None:
+                # without a metric the searcher would silently drop every
+                # completed-trial observation and degrade to random search
+                raise ValueError(
+                    "search_alg needs a metric: set it on the searcher or "
+                    "in TuneConfig(metric=...)"
+                )
+            if getattr(searcher, "max_trials", None) is None:
+                searcher.max_trials = tc.num_samples
+
+            def trial_factory(tid, cfg):
+                return Trial(trial_id=tid, config=cfg,
+                             resources=dict(resources))
+
         controller = TuneController(
             self._resolve_trainable(),
             trials,
@@ -173,8 +195,11 @@ class Tuner:
             max_concurrent=tc.max_concurrent_trials,
             experiment_dir=exp_dir,
             experiment_name=name,
+            searcher=searcher,
+            trial_factory=trial_factory,
         )
         controller.run()
+        trials = controller.trials
         results = [
             Result(
                 metrics=t.last_result,

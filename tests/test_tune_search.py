@@ -1,0 +1,180 @@
+"""Schedulers (HyperBand, MedianStopping) + TPE searcher tests.
+
+Mirrors ray: python/ray/tune/tests/{test_trial_scheduler.py,
+test_searchers.py} areas: pure scheduler-decision unit tests plus an
+end-to-end TPE run that must concentrate samples near the optimum.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    AsyncHyperBandScheduler,
+    MedianStoppingRule,
+)
+from ray_tpu.tune.search import TPESearcher
+
+
+class TestMedianStopping:
+    def test_below_median_stops(self):
+        sched = MedianStoppingRule(metric="score", mode="max",
+                                   grace_period=2, min_samples_required=2)
+        # three strong trials, one weak one
+        for t in range(1, 4):
+            for tid in ("a", "b", "c"):
+                assert sched.on_trial_result(
+                    tid, {"score": 10.0, "training_iteration": t}
+                ) == CONTINUE
+        decisions = [
+            sched.on_trial_result(
+                "weak", {"score": 1.0, "training_iteration": t}
+            )
+            for t in range(1, 4)
+        ]
+        assert decisions[0] == CONTINUE  # inside grace period
+        assert STOP in decisions[1:]
+
+    def test_min_mode(self):
+        sched = MedianStoppingRule(metric="loss", mode="min",
+                                   grace_period=1, min_samples_required=2)
+        for t in range(1, 4):
+            sched.on_trial_result("good1", {"loss": 0.1,
+                                            "training_iteration": t})
+            sched.on_trial_result("good2", {"loss": 0.2,
+                                            "training_iteration": t})
+        assert sched.on_trial_result(
+            "bad", {"loss": 5.0, "training_iteration": 2}
+        ) == STOP
+
+
+class TestAsyncHyperBand:
+    def test_brackets_get_distinct_grace(self):
+        sched = AsyncHyperBandScheduler(
+            metric="score", mode="max", max_t=64, grace_period=1,
+            reduction_factor=4, brackets=3,
+        )
+        graces = [b.grace_period for b in sched._brackets]
+        assert graces == [1, 4, 16]
+
+    def test_round_robin_assignment_and_culling(self):
+        sched = AsyncHyperBandScheduler(
+            metric="score", mode="max", max_t=64, grace_period=1,
+            reduction_factor=2, brackets=2,
+        )
+        # trial A lands in bracket 0 (grace 1) and reports a bad score at
+        # t=1 after a better one seeds the rung
+        assert sched.on_trial_result("t0", {"score": 9,
+                                            "training_iteration": 1}) \
+            == CONTINUE
+        d = sched.on_trial_result("t2", {"score": 1,
+                                         "training_iteration": 1})
+        # t2 went to bracket 1 (grace 2): no rung at t=1 yet
+        assert d == CONTINUE
+        d = sched.on_trial_result("t4", {"score": 1,
+                                         "training_iteration": 1})
+        # t4 is bracket 0 again: rung 1 holds {9}: 1 < cutoff -> STOP
+        assert d == STOP
+
+    def test_late_metric_propagation(self):
+        sched = AsyncHyperBandScheduler(max_t=16)
+        sched.metric = "m"
+        sched.mode = "max"
+        assert all(b.metric == "m" and b.mode == "max"
+                   for b in sched._brackets)
+
+
+class TestTPESearcher:
+    def test_concentrates_near_optimum(self):
+        """After warmup, TPE samples of a quadratic objective must be
+        closer to the optimum than uniform-random ones on average."""
+        space = {"x": tune.uniform(-10.0, 10.0)}
+        s = TPESearcher(space, metric="score", mode="max", n_startup=10,
+                        seed=7)
+        xs_early, xs_late = [], []
+        for i in range(60):
+            cfg = s.suggest(f"t{i}")
+            x = cfg["x"]
+            (xs_early if i < 10 else xs_late).append(x)
+            s.on_trial_complete(f"t{i}", {"score": -(x - 3.0) ** 2})
+        late = xs_late[-20:]
+        mean_err = sum(abs(x - 3.0) for x in late) / len(late)
+        assert mean_err < 3.0, (mean_err, late)
+
+    def test_choice_and_loguniform_dims(self):
+        space = {
+            "lr": tune.loguniform(1e-5, 1e-1),
+            "opt": tune.choice(["adam", "sgd"]),
+            "layers": tune.randint(1, 5),
+        }
+        s = TPESearcher(space, metric="score", mode="min", n_startup=5,
+                        seed=3)
+        for i in range(30):
+            cfg = s.suggest(f"t{i}")
+            assert 1e-5 <= cfg["lr"] <= 1e-1
+            assert cfg["opt"] in ("adam", "sgd")
+            assert 1 <= cfg["layers"] < 5
+            # best: small lr, adam, layers=2
+            score = (abs(cfg["layers"] - 2) + (0.0 if cfg["opt"] == "adam"
+                                               else 1.0) + cfg["lr"] * 10)
+            s.on_trial_complete(f"t{i}", {"score": score})
+        # adam should dominate late suggestions
+        late = [s.suggest(f"x{i}")["opt"] for i in range(10)]
+        assert late.count("adam") >= 6
+
+    def test_max_trials_exhausts(self):
+        s = TPESearcher({"x": tune.uniform(0, 1)}, metric="m", mode="max",
+                        max_trials=3)
+        assert [s.suggest(f"t{i}") is not None for i in range(4)] == [
+            True, True, True, False
+        ]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestSearcherEndToEnd:
+    def test_tpe_with_tuner(self, cluster):
+        def objective(config):
+            x = config["x"]
+            tune.report({"score": -(x - 2.0) ** 2})
+
+        space = {"x": tune.uniform(-5.0, 5.0)}
+        tuner = tune.Tuner(
+            objective,
+            param_space=space,
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", num_samples=20,
+                max_concurrent_trials=4,
+                search_alg=TPESearcher(space, n_startup=8, seed=11),
+            ),
+        )
+        grid = tuner.fit()
+        best = grid.get_best_result()
+        assert best.metrics["score"] > -1.5
+        assert len(grid._results) == 20
+
+    def test_hyperband_with_tuner(self, cluster):
+        def objective(config):
+            for t in range(1, 17):
+                tune.report({"score": config["q"] * t})
+
+        tuner = tune.Tuner(
+            objective,
+            param_space={"q": tune.grid_search([1, 2, 3, 4])},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max",
+                scheduler=AsyncHyperBandScheduler(max_t=16, grace_period=1,
+                                                  reduction_factor=2,
+                                                  brackets=2),
+            ),
+        )
+        grid = tuner.fit()
+        best = grid.get_best_result()
+        assert best.metrics.get("score", 0) >= 16
