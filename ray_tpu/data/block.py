@@ -82,8 +82,23 @@ class BlockAccessor:
         return self.block.to_pylist()
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
-        for batch in self.block.to_batches():
-            yield from batch.to_pylist()
+        # tensor columns come back as per-row ndarrays with their original
+        # shape (reference: row access on tensor extension columns), not
+        # nested pylists
+        tensor_cols = {
+            f.name
+            for f in self.block.schema
+            if f.metadata and b"rt_tensor_shape" in f.metadata
+        }
+        if not tensor_cols:
+            for batch in self.block.to_batches():
+                yield from batch.to_pylist()
+            return
+        arrays = self.to_numpy()
+        n = self.block.num_rows
+        names = self.block.schema.names
+        for i in range(n):
+            yield {name: arrays[name][i] for name in names}
 
     def to_pandas(self):
         return self.block.to_pandas()

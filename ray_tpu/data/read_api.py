@@ -159,6 +159,67 @@ def read_binary_files(paths, **kwargs) -> Dataset:
     return _file_dataset(paths, "", _read_binary_file)
 
 
+def _make_image_reader(size, mode):
+    def _read_image_file(path):
+        from PIL import Image
+
+        img = Image.open(path)
+        if mode is not None:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))  # PIL takes (W, H)
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return block_mod.from_numpy({"image": arr[None, ...]})
+
+    return _read_image_file
+
+
+def read_images(paths, *, size=None, mode=None, **kwargs) -> Dataset:
+    """Image files → tensor-column blocks (ray: read_images,
+    data/_internal/datasource/image_datasource.py).  `size=(H, W)`
+    resizes (required for batching images of mixed sizes); `mode` is a
+    PIL convert mode ("RGB", "L", ...)."""
+    reader = _make_image_reader(size, mode)
+    paths = _expand_paths(paths, "")
+    imgs = [
+        p for p in paths
+        if p.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".gif",
+                               ".webp"))
+    ]
+    if not imgs:
+        raise FileNotFoundError(f"no image files in {paths!r}")
+    return Dataset([ReadTask(reader, f) for f in imgs])
+
+
+def read_sql(sql: str, connection_factory) -> Dataset:
+    """A SQL query → Dataset (ray: read_sql,
+    data/_internal/datasource/sql_datasource.py).  `connection_factory`
+    is a zero-arg callable returning a DBAPI2 connection (sqlite3,
+    psycopg2, ...) — it must be picklable since the query runs on a
+    worker inside the streaming window.
+
+    The query runs as ONE read task (one block); shard large tables by
+    issuing several read_sql calls with disjoint predicates and
+    `Dataset.union`, like the reference's sharded read_sql."""
+
+    def _read_sql_task():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        return block_mod.from_rows(
+            [dict(zip(cols, r)) for r in rows]
+        )
+
+    return Dataset([ReadTask(_read_sql_task)])
+
+
 # -- writers (attached to Dataset) ----------------------------------------
 
 

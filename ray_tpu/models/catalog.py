@@ -1,0 +1,128 @@
+"""RLlib model catalog: obs space → module family.
+
+Role-equivalent of ray: rllib/models/catalog.py (ModelCatalog) +
+rllib/models/torch/visionnet.py — the CNN family for image observations
+and the dispatch that picks MLP vs CNN from the obs shape.  TPU-first:
+convolutions are NHWC jax.lax.conv_general_dilated calls XLA maps onto
+the MXU; the module is functional (params in, (logits, value) out) so
+the identical code runs CPU inference in EnvRunners and pjit'd training
+in Learners.
+
+Modules accept FLAT observations (B, prod(obs_shape)) and reshape
+internally using the static config — rollout fragments stay flat
+through buffers and minibatching, and the reshape is free under XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib import core
+
+
+@dataclasses.dataclass(frozen=True)
+class CNNModuleConfig:
+    obs_shape: Tuple[int, int, int]  # (H, W, C)
+    num_actions: int
+    # (out_channels, kernel, stride) per conv layer (reference default
+    # vision-net filters, scaled down)
+    conv_filters: Tuple[Tuple[int, int, int], ...] = (
+        (16, 8, 4),
+        (32, 4, 2),
+    )
+    hidden: Tuple[int, ...] = (256,)
+
+
+def _conv_out_hw(h: int, w: int,
+                 filters: Tuple[Tuple[int, int, int], ...]) -> Tuple[int, int]:
+    for _, k, s in filters:
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+    return h, w
+
+
+def cnn_init(rng, cfg: CNNModuleConfig) -> core.Params:
+    H, W, C = cfg.obs_shape
+    keys = jax.random.split(rng, len(cfg.conv_filters) + len(cfg.hidden) + 2)
+    params: core.Params = {"conv": [], "layers": []}
+    cin = C
+    for i, (cout, k, _s) in enumerate(cfg.conv_filters):
+        fan_in = k * k * cin
+        params["conv"].append({
+            "w": jax.random.normal(keys[i], (k, k, cin, cout))
+            * jnp.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((cout,)),
+        })
+        cin = cout
+    oh, ow = _conv_out_hw(H, W, cfg.conv_filters)
+    din = oh * ow * cin
+    base = len(cfg.conv_filters)
+    for j, dout in enumerate(cfg.hidden):
+        params["layers"].append({
+            "w": jax.random.normal(keys[base + j], (din, dout))
+            * jnp.sqrt(2.0 / din),
+            "b": jnp.zeros((dout,)),
+        })
+        din = dout
+    params["pi"] = {
+        "w": jax.random.normal(keys[-2], (din, cfg.num_actions)) * 0.01,
+        "b": jnp.zeros((cfg.num_actions,)),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[-1], (din, 1)),
+        "b": jnp.zeros((1,)),
+    }
+    return params
+
+
+def cnn_make_forward(cfg: CNNModuleConfig):
+    H, W, C = cfg.obs_shape
+    strides = [s for _, _, s in cfg.conv_filters]
+
+    def fwd(params: core.Params, obs):
+        x = obs.reshape((-1, H, W, C)).astype(jnp.float32)
+        for layer, s in zip(params["conv"], strides):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + layer["b"]
+            x = jax.nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for layer in params["layers"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return logits, value
+
+    return fwd
+
+
+core.register_module_family(CNNModuleConfig, cnn_init, cnn_make_forward)
+
+
+def get_module_config(obs_shape, num_actions: int, model_config=None):
+    """Pick a module family from the obs shape (ray: ModelCatalog
+    get_model_v2 dispatch): rank-3 obs → CNN, else MLP."""
+    model_config = model_config or {}
+    if len(obs_shape) == 3:
+        return CNNModuleConfig(
+            obs_shape=tuple(obs_shape),
+            num_actions=num_actions,
+            conv_filters=tuple(
+                tuple(f) for f in model_config.get(
+                    "conv_filters", ((16, 8, 4), (32, 4, 2))
+                )
+            ),
+            hidden=tuple(model_config.get("hidden", (256,))),
+        )
+    import numpy as np
+
+    return core.MLPModuleConfig(
+        obs_dim=int(np.prod(obs_shape)),
+        num_actions=num_actions,
+        hidden=tuple(model_config.get("hidden", (64, 64))),
+    )

@@ -1,0 +1,113 @@
+"""HuggingFace Transformers interop for the flagship GPT-2.
+
+Role-equivalent of ray: python/ray/train/huggingface/ (Transformers
+integration) — here the useful TPU form: convert a `transformers`
+GPT2LMHeadModel's torch weights into this repo's stacked-layer jax
+params (models/gpt2.py layout) so pretrained checkpoints train/serve on
+the TPU stack.  The reverse of a "wrapper": weights move into the
+TPU-native model rather than wrapping torch in actors.
+
+Layout notes:
+- HF Conv1D stores (in, out); our einsum kernels are (in, ...) too, so
+  no transposes except the qkv head split.
+- HF c_attn is (E, 3E) = [q|k|v]; ours is (E, 3H, D) with q heads at
+  [0:H], k at [H:2H], v at [2H:3H] (models/gpt2.py _block split).
+- Per-layer tensors stack into a leading L axis (lax.scan-friendly,
+  one pytree leaf per parameter kind instead of L dicts).
+- The vocab pads with zero rows to a multiple of 128 for MXU tiling
+  (models/gpt2.py GPTConfig.vocab_size comment).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ray_tpu.models.gpt2 import GPTConfig
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def config_from_hf(hf_config, *, pad_vocab_to: int = 128,
+                   **overrides) -> GPTConfig:
+    """Map a transformers GPT2Config onto GPTConfig."""
+    import jax.numpy as jnp
+
+    kwargs: Dict[str, Any] = dict(
+        vocab_size=_round_up(hf_config.vocab_size, pad_vocab_to),
+        max_seq_len=hf_config.n_positions,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        embed_dim=hf_config.n_embd,
+        dtype=jnp.bfloat16,
+    )
+    kwargs.update(overrides)
+    return GPTConfig(**kwargs)
+
+
+def params_from_hf(model, *, pad_vocab_to: int = 128,
+                   **config_overrides) -> Tuple[Dict[str, Any], GPTConfig]:
+    """(params, config) from a transformers GPT2LMHeadModel instance.
+
+    Works on any loaded checkpoint (`GPT2LMHeadModel.from_pretrained` or
+    a fresh config-built model); no network access here.
+    """
+    import jax.numpy as jnp
+
+    config = config_from_hf(
+        model.config, pad_vocab_to=pad_vocab_to, **config_overrides
+    )
+    sd = {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
+    L, E, H = config.num_layers, config.embed_dim, config.num_heads
+    D = config.head_dim
+    dt = config.param_dtype
+
+    def stacked(key_fmt: str) -> np.ndarray:
+        return np.stack(
+            [sd[key_fmt.format(i=i)] for i in range(L)], axis=0
+        )
+
+    # qkv: (L, E, 3E) -> (L, E, 3, H, D) -> (L, E, 3H, D)
+    c_attn_w = stacked("transformer.h.{i}.attn.c_attn.weight")
+    qkv_kernel = c_attn_w.reshape(L, E, 3, H, D).reshape(L, E, 3 * H, D)
+    c_attn_b = stacked("transformer.h.{i}.attn.c_attn.bias")
+    qkv_bias = c_attn_b.reshape(L, 3, H, D).reshape(L, 3 * H, D)
+    # attn out proj: (L, E, E) -> (L, H, D, E)
+    proj_kernel = stacked("transformer.h.{i}.attn.c_proj.weight").reshape(
+        L, H, D, E
+    )
+
+    wte = sd["transformer.wte.weight"]
+    if config.vocab_size > wte.shape[0]:
+        pad = np.zeros(
+            (config.vocab_size - wte.shape[0], E), wte.dtype
+        )
+        wte = np.concatenate([wte, pad], axis=0)
+
+    j = lambda a: jnp.asarray(a, dt)  # noqa: E731
+    params = {
+        "wte": j(wte),
+        "wpe": j(sd["transformer.wpe.weight"]),
+        "blocks": {
+            "ln1_scale": j(stacked("transformer.h.{i}.ln_1.weight")),
+            "ln1_bias": j(stacked("transformer.h.{i}.ln_1.bias")),
+            "qkv_kernel": j(qkv_kernel),
+            "qkv_bias": j(qkv_bias),
+            "proj_kernel": j(proj_kernel),
+            "proj_bias": j(stacked("transformer.h.{i}.attn.c_proj.bias")),
+            "ln2_scale": j(stacked("transformer.h.{i}.ln_2.weight")),
+            "ln2_bias": j(stacked("transformer.h.{i}.ln_2.bias")),
+            "fc_kernel": j(stacked("transformer.h.{i}.mlp.c_fc.weight")),
+            "fc_bias": j(stacked("transformer.h.{i}.mlp.c_fc.bias")),
+            "out_kernel": j(stacked("transformer.h.{i}.mlp.c_proj.weight")),
+            "out_bias": j(stacked("transformer.h.{i}.mlp.c_proj.bias")),
+        },
+        "lnf_scale": j(sd["transformer.ln_f.weight"]),
+        "lnf_bias": j(sd["transformer.ln_f.bias"]),
+    }
+    return params, config

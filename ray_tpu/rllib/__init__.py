@@ -11,4 +11,17 @@ from ray_tpu.rllib.core import MLPModuleConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, ReplayBuffer  # noqa: F401
 from ray_tpu.rllib.env_runner import EnvRunnerGroup  # noqa: F401
 from ray_tpu.rllib.learner_group import Learner, LearnerGroup  # noqa: F401
+from ray_tpu.rllib.offline import (  # noqa: F401
+    BC,
+    BCConfig,
+    JsonEpisodeReader,
+    record_episodes,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae  # noqa: F401
+from ray_tpu.rllib import connectors  # noqa: F401
+
+# NOTE: the model catalog (CNN family) lives in ray_tpu.models.catalog —
+# imported there, not here, to keep rllib importable from the catalog
+# module itself (registration into core.MODULE_FAMILIES happens on
+# catalog import, including implicitly when a CNNModuleConfig unpickles
+# inside a worker).

@@ -29,11 +29,18 @@ class AlgorithmConfig:
     rollout_fragment_length: int = 64
     num_learners: int = 0  # 0 = in-process learner; >=2 = LearnerGroup dp
     seed: int = 0
+    # factory returning a connectors.Pipeline — one fresh (stateful)
+    # instance per EnvRunner (ray: config.env_runners(
+    # env_to_module_connector=...))
+    env_to_module: Optional[Any] = None
 
     algo_class = None  # set by subclasses
 
     def environment(self, env):
         return dataclasses.replace(self, env=env)
+
+    def connectors(self, env_to_module=None):
+        return dataclasses.replace(self, env_to_module=env_to_module)
 
     def env_runners(
         self, num_env_runners=None, num_envs_per_env_runner=None,
@@ -63,14 +70,23 @@ class AlgorithmConfig:
         return self.algo_class(self)
 
 
-def probe_env_spaces(env) -> Dict[str, int]:
+def probe_env_spaces(env, env_to_module_fn=None) -> Dict[str, int]:
     """Spin the env up once to read its spaces (ray: Algorithm._get_env_id
-    + spaces inference in env_runner setup)."""
+    + spaces inference in env_runner setup).  With an env→module
+    connector pipeline, obs_dim is the module-side dim AFTER transforms
+    (frame stacking widens it, flattening collapses it)."""
     import gymnasium as gym
 
     probe = env() if callable(env) else gym.make(env)
+    obs_shape = probe.observation_space.shape
+    if env_to_module_fn is not None:
+        from ray_tpu.rllib.connectors import obs_dim_after
+
+        obs_dim = obs_dim_after(env_to_module_fn(), obs_shape)
+    else:
+        obs_dim = int(np.prod(obs_shape))
     spaces = {
-        "obs_dim": int(np.prod(probe.observation_space.shape)),
+        "obs_dim": obs_dim,
         "num_actions": int(probe.action_space.n),
     }
     probe.close()

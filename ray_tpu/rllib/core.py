@@ -53,27 +53,73 @@ def forward(params: Params, obs) -> Tuple[jax.Array, jax.Array]:
     return logits, value
 
 
-def sample_actions(params: Params, obs, rng):
-    """Categorical sample + logp + value (env-runner inference path)."""
-    logits, value = forward(params, obs)
-    action = jax.random.categorical(rng, logits)
-    logp_all = jax.nn.log_softmax(logits)
-    logp = jnp.take_along_axis(logp_all, action[:, None], axis=1)[:, 0]
-    return action, logp, value
+def _sample_fns_from_forward(fwd):
+    """The single implementation of action sampling, parameterized by a
+    module family's forward fn."""
+
+    def _sample(params: Params, obs, rng):
+        """Categorical sample + logp + value (env-runner inference)."""
+        logits, value = fwd(params, obs)
+        action = jax.random.categorical(rng, logits)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, action[:, None], axis=1)[:, 0]
+        return action, logp, value
+
+    def _sample_eps(params: Params, obs, rng, epsilon):
+        """ε-greedy over the logits head read as Q-values (DQN).
+
+        Same module, different readout: the "pi" head is the Q function
+        and the value slot carries max-Q.  Returned logp is 0 —
+        off-policy methods don't use it."""
+        q, _ = fwd(params, obs)
+        B, A = q.shape
+        k_pick, k_rand = jax.random.split(rng)
+        greedy = jnp.argmax(q, axis=-1)
+        rand = jax.random.randint(k_rand, (B,), 0, A)
+        explore = jax.random.uniform(k_pick, (B,)) < epsilon
+        action = jnp.where(explore, rand, greedy)
+        return action, jnp.zeros((B,)), q.max(axis=-1)
+
+    return _sample, _sample_eps
 
 
-def sample_actions_epsilon(params: Params, obs, rng, epsilon):
-    """ε-greedy over the logits head read as Q-values (DQN inference).
+# MLP-family globals (back-compat names)
+sample_actions, sample_actions_epsilon = _sample_fns_from_forward(forward)
 
-    Same module, different readout: the "pi" head is the Q function and
-    the value slot carries max-Q (useful for diagnostics; unused by the
-    replay path).  Returned logp is 0 — off-policy methods don't use it.
-    """
-    q, _ = forward(params, obs)
-    B, A = q.shape
-    k_pick, k_rand = jax.random.split(rng)
-    greedy = jnp.argmax(q, axis=-1)
-    rand = jax.random.randint(k_rand, (B,), 0, A)
-    explore = jax.random.uniform(k_pick, (B,)) < epsilon
-    action = jnp.where(explore, rand, greedy)
-    return action, jnp.zeros((B,)), q.max(axis=-1)
+
+# ---------------------------------------------------------------------------
+# Module families (catalog dispatch)
+# ---------------------------------------------------------------------------
+
+# config type -> (init_fn(rng, cfg) -> params, make_forward(cfg) -> fn)
+# populated by ray_tpu.rllib.models for non-MLP families
+MODULE_FAMILIES: Dict[type, Tuple[Any, Any]] = {}
+
+
+def register_module_family(config_cls, init_fn, make_forward) -> None:
+    """Plug a new module family (CNN, transformer, ...) into the shared
+    init/forward dispatch (ray: rllib/models/catalog.py role)."""
+    MODULE_FAMILIES[config_cls] = (init_fn, make_forward)
+
+
+def module_init(rng, config) -> Params:
+    """Family-dispatching init (falls back to the builtin MLP)."""
+    fam = MODULE_FAMILIES.get(type(config))
+    if fam is not None:
+        return fam[0](rng, config)
+    return init(rng, config)
+
+
+def get_forward(config):
+    """Family-dispatching forward closure; the config rides the closure
+    (static under jit), never the params pytree."""
+    fam = MODULE_FAMILIES.get(type(config))
+    if fam is not None:
+        return fam[1](config)
+    return forward
+
+
+def make_sample_fns(config):
+    """(sample_actions, sample_actions_epsilon) for any module family —
+    what EnvRunners jit instead of the MLP-only globals."""
+    return _sample_fns_from_forward(get_forward(config))

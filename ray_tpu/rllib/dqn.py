@@ -93,7 +93,8 @@ class DQNLearner(Learner):
 
         self.config = config
         self.module_config = module_config
-        self.params = core.init(jax.random.key(config.seed), module_config)
+        self._fwd = core.get_forward(module_config)
+        self.params = core.module_init(jax.random.key(config.seed), module_config)
         self.target_params = jax.tree.map(lambda x: x, self.params)
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(config.grad_clip),
@@ -108,11 +109,11 @@ class DQNLearner(Learner):
         import jax.numpy as jnp
 
         c = self.config
-        q_all, _ = core.forward(params, batch["obs"])
+        q_all, _ = self._fwd(params, batch["obs"])
         q = jnp.take_along_axis(q_all, batch["actions"][:, None], axis=1)[:, 0]
-        q_next_target, _ = core.forward(batch["target_params"], batch["next_obs"])
+        q_next_target, _ = self._fwd(batch["target_params"], batch["next_obs"])
         if c.double_q:
-            q_next_online, _ = core.forward(params, batch["next_obs"])
+            q_next_online, _ = self._fwd(params, batch["next_obs"])
             best = jnp.argmax(q_next_online, axis=-1)
         else:
             best = jnp.argmax(q_next_target, axis=-1)
@@ -148,7 +149,7 @@ class DQNLearner(Learner):
 
 class DQN(Algorithm):
     def _setup(self, config: DQNConfig):
-        spaces = probe_env_spaces(config.env)
+        spaces = probe_env_spaces(config.env, config.env_to_module)
         self.module_config = core.MLPModuleConfig(
             obs_dim=spaces["obs_dim"],
             num_actions=spaces["num_actions"],
@@ -168,6 +169,7 @@ class DQN(Algorithm):
             num_runners=config.num_env_runners,
             num_envs_per_runner=config.num_envs_per_runner,
             seed=config.seed,
+            env_to_module_fn=config.env_to_module,
         )
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         self._rng = np.random.default_rng(config.seed)

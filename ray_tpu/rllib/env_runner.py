@@ -18,7 +18,8 @@ import ray_tpu
 
 @ray_tpu.remote
 class EnvRunnerActor:
-    def __init__(self, env_fn, module_config, num_envs: int, seed: int):
+    def __init__(self, env_fn, module_config, num_envs: int, seed: int,
+                 env_to_module_fn=None):
         import gymnasium as gym
         import jax
 
@@ -29,14 +30,32 @@ class EnvRunnerActor:
         )
         self._num_envs = num_envs
         self._config = module_config
-        self._params = core.init(jax.random.key(seed), module_config)
+        self._params = core.module_init(jax.random.key(seed), module_config)
         self._rng = jax.random.key(seed + 10_000)
-        self._sample_fn = jax.jit(core.sample_actions)
+        # family-dispatching sample fns (MLP or catalog CNN)
+        _sample, _sample_eps = core.make_sample_fns(module_config)
+        self._forward = core.get_forward(module_config)
+        self._sample_fn = jax.jit(_sample)
+        # each runner owns its connector pipeline instance so stateful
+        # transforms (frame stacks, running normalizers) stay runner-local
+        # (ray: per-EnvRunner ConnectorV2 instances)
+        self._env_to_module = (
+            env_to_module_fn() if env_to_module_fn is not None else None
+        )
         self._obs, _ = self._envs.reset(seed=seed)
-        self._sample_eps_fn = jax.jit(core.sample_actions_epsilon)
+        self._proc = self._process(self._obs)
+        self._sample_eps_fn = jax.jit(_sample_eps)
         # per-env running episode returns for metrics
         self._ep_return = np.zeros(num_envs, np.float64)
         self._completed: List[float] = []
+
+    def _process(self, obs, dones=None) -> np.ndarray:
+        if self._env_to_module is None:
+            return obs.astype(np.float32)
+        if dones is not None:
+            for i in np.nonzero(dones)[0]:
+                self._env_to_module.reset(int(i))
+        return self._env_to_module(obs)
 
     @staticmethod
     def _make_env_fn(env_fn, seed):
@@ -65,7 +84,7 @@ class EnvRunnerActor:
         import jax
 
         B, T = self._num_envs, num_steps
-        obs_buf = np.zeros((T, B) + self._obs.shape[1:], np.float32)
+        obs_buf = np.zeros((T, B) + self._proc.shape[1:], np.float32)
         act_buf = np.zeros((T, B), np.int32)
         rew_buf = np.zeros((T, B), np.float32)
         done_buf = np.zeros((T, B), np.float32)
@@ -76,20 +95,22 @@ class EnvRunnerActor:
             self._rng, key = jax.random.split(self._rng)
             if epsilon is None:
                 action, logp, value = self._sample_fn(
-                    self._params, self._obs.astype(np.float32), key
+                    self._params, self._proc, key
                 )
             else:
                 action, logp, value = self._sample_eps_fn(
-                    self._params, self._obs.astype(np.float32), key,
-                    float(epsilon),
+                    self._params, self._proc, key, float(epsilon),
                 )
             action = np.asarray(action)
-            obs_buf[t] = self._obs
+            obs_buf[t] = self._proc
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
             self._obs, reward, term, trunc, _ = self._envs.step(action)
             done = np.logical_or(term, trunc)
+            # connector state for finished envs resets before the new
+            # episode's first (autoreset) obs is processed
+            self._proc = self._process(self._obs, dones=done)
             rew_buf[t] = reward
             done_buf[t] = done
             self._ep_return += reward
@@ -98,11 +119,7 @@ class EnvRunnerActor:
                 self._ep_return[i] = 0.0
 
         # bootstrap value of the next obs (for the unfinished fragment tail)
-        from ray_tpu.rllib import core
-
-        _, last_val = core.forward(
-            self._params, self._obs.astype(np.float32)
-        )
+        _, last_val = self._forward(self._params, self._proc)
         episode_returns = self._completed
         self._completed = []
         return {
@@ -114,8 +131,9 @@ class EnvRunnerActor:
             "values": val_buf,
             "last_values": np.asarray(last_val, np.float32),
             # the observation AFTER the final step: replay-buffer algos
-            # need next_obs for the fragment tail
-            "final_obs": np.asarray(self._obs, np.float32),
+            # need next_obs for the fragment tail (module view, i.e.
+            # post-connector)
+            "final_obs": np.asarray(self._proc, np.float32),
             "episode_returns": np.asarray(episode_returns, np.float64),
         }
 
@@ -130,10 +148,12 @@ class EnvRunnerGroup:
         num_runners: int = 2,
         num_envs_per_runner: int = 4,
         seed: int = 0,
+        env_to_module_fn=None,
     ):
         self.runners = [
             EnvRunnerActor.options(num_cpus=1).remote(
-                env_fn, module_config, num_envs_per_runner, seed + 1000 * i
+                env_fn, module_config, num_envs_per_runner, seed + 1000 * i,
+                env_to_module_fn,
             )
             for i in range(num_runners)
         ]

@@ -1,0 +1,234 @@
+"""Offline RL: episode recording, dataset reading, and behavior cloning.
+
+Role-equivalent of ray: rllib/offline/ (JsonWriter/JsonReader,
+offline_data.py OfflineData) + rllib/algorithms/bc/ (BCConfig, BC).
+Episodes are JSONL — one episode per line with obs/actions/rewards
+lists — readable without this framework, like the reference's JSON
+sample format.  BC trains the shared MLP RLModule with cross-entropy on
+expert actions (the reference's BC loss, rllib/algorithms/bc/bc_learner
+minus the torch), then evaluates by rolling the learned policy in a
+live EnvRunnerGroup — exercising the offline→online loop end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.rllib import core
+from ray_tpu.rllib.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    probe_env_spaces,
+)
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner_group import Learner
+
+# ---------------------------------------------------------------------------
+# Recording + reading
+# ---------------------------------------------------------------------------
+
+
+def record_episodes(
+    env_fn,
+    policy_fn: Callable[[np.ndarray], int],
+    num_episodes: int,
+    path: str,
+    seed: int = 0,
+    max_steps: int = 1000,
+) -> Dict[str, float]:
+    """Roll `policy_fn` in the env and append one JSONL line per episode
+    (ray: rllib/offline/json_writer.py role).  Returns summary stats."""
+    import gymnasium as gym
+
+    env = env_fn() if callable(env_fn) else gym.make(env_fn)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    returns = []
+    with open(path, "a") as f:
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            traj = {"obs": [], "actions": [], "rewards": []}
+            for _ in range(max_steps):
+                a = int(policy_fn(np.asarray(obs, np.float32)))
+                traj["obs"].append(np.asarray(obs, np.float32).tolist())
+                traj["actions"].append(a)
+                obs, r, term, trunc, _ = env.step(a)
+                traj["rewards"].append(float(r))
+                if term or trunc:
+                    break
+            returns.append(sum(traj["rewards"]))
+            f.write(json.dumps(traj) + "\n")
+    env.close()
+    return {
+        "episodes": num_episodes,
+        "mean_return": float(np.mean(returns)),
+    }
+
+
+class JsonEpisodeReader:
+    """Read JSONL episode files into flat (obs, action) arrays
+    (ray: rllib/offline/json_reader.py JsonReader).
+
+    `env_to_module_fn` (a connector Pipeline factory) replays each
+    episode through a FRESH pipeline instance, one step at a time —
+    exactly the transform an online EnvRunner would apply — so a
+    BC learner trained on this data sees the same input distribution
+    the cloned policy will see at evaluation time.
+    """
+
+    def __init__(self, paths: Sequence[str], env_to_module_fn=None):
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        self.paths = [str(p) for p in paths]
+        obs, acts = [], []
+        self.num_episodes = 0
+        self.mean_return = 0.0
+        total_ret = 0.0
+        for p in self.paths:
+            with open(p) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    ep = json.loads(line)
+                    ep_obs = np.asarray(ep["obs"], np.float32)
+                    if env_to_module_fn is not None:
+                        pipeline = env_to_module_fn()
+                        ep_obs = np.concatenate(
+                            [pipeline(step[None, ...]) for step in ep_obs]
+                        )
+                    obs.append(ep_obs)
+                    acts.extend(ep["actions"])
+                    total_ret += sum(ep.get("rewards", []))
+                    self.num_episodes += 1
+        if not obs:
+            raise ValueError(f"no episodes found in {self.paths}")
+        self.obs = np.concatenate(obs).astype(np.float32)
+        self.actions = np.asarray(acts, np.int32)
+        self.mean_return = total_ret / max(self.num_episodes, 1)
+
+    def __len__(self) -> int:
+        return len(self.actions)
+
+    def iter_batches(self, batch_size: int, rng: np.random.Generator,
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+        idx = rng.permutation(len(self.actions))
+        for i in range(0, len(idx) - batch_size + 1, batch_size):
+            sel = idx[i:i + batch_size]
+            yield {"obs": self.obs[sel], "actions": self.actions[sel]}
+
+
+# ---------------------------------------------------------------------------
+# Behavior cloning
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BCConfig(AlgorithmConfig):
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    updates_per_iteration: int = 50
+    hidden: tuple = (64, 64)
+    input_paths: Optional[Sequence[str]] = None
+    # rollout evaluation of the cloned policy each iteration
+    evaluation_num_steps: int = 200
+
+    def offline_data(self, input_paths) -> "BCConfig":
+        return dataclasses.replace(self, input_paths=input_paths)
+
+
+class BCLearner(Learner):
+    def __init__(self, config: BCConfig, module_config):
+        import jax
+        import optax
+
+        self.config = config
+        self.module_config = module_config
+        self._fwd = core.get_forward(module_config)
+        self.params = core.module_init(jax.random.key(config.seed), module_config)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._init_jit()
+
+    def _loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        logits, _ = self._fwd(params, batch["obs"])
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, batch["actions"][:, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        return nll.mean(), {"bc_loss": nll.mean()}
+
+
+class BC(Algorithm):
+    def _setup(self, config: BCConfig):
+        assert config.input_paths, "BCConfig.offline_data(paths) is required"
+        spaces = probe_env_spaces(config.env, config.env_to_module)
+        self.module_config = core.MLPModuleConfig(
+            obs_dim=spaces["obs_dim"],
+            num_actions=spaces["num_actions"],
+            hidden=config.hidden,
+        )
+        self.reader = JsonEpisodeReader(
+            config.input_paths, env_to_module_fn=config.env_to_module
+        )
+        self.learner = BCLearner(config, self.module_config)
+        self.env_runner_group = EnvRunnerGroup(
+            config.env,
+            self.module_config,
+            num_runners=max(1, config.num_env_runners),
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed,
+            env_to_module_fn=config.env_to_module,
+        )
+        self._np_rng = np.random.default_rng(config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.monotonic()
+        losses: List[float] = []
+        batches = self.reader.iter_batches(c.train_batch_size, self._np_rng)
+        for _ in range(c.updates_per_iteration):
+            try:
+                batch = next(batches)
+            except StopIteration:
+                batches = self.reader.iter_batches(
+                    c.train_batch_size, self._np_rng
+                )
+                batch = next(batches)
+            stats = self.learner.update(batch)
+            losses.append(float(stats["bc_loss"]))
+        learn_time = time.monotonic() - t0
+        # evaluation rollout with the cloned weights
+        self.env_runner_group.sync_weights(self.learner.params)
+        frags = self.env_runner_group.sample(c.evaluation_num_steps)
+        ep_returns = np.concatenate(
+            [f["episode_returns"] for f in frags]
+        ) if frags else np.zeros(0)
+        self._record_returns(ep_returns)
+        return {
+            "bc_loss": float(np.mean(losses)),
+            "num_offline_samples": len(self.reader),
+            "dataset_mean_return": self.reader.mean_return,
+            "learn_time_s": learn_time,
+            "episodes_this_iter": len(ep_returns),
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.learner.params}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.learner.params = state["params"]
+        self.env_runner_group.sync_weights(self.learner.params)
+
+    def stop(self) -> None:
+        self.env_runner_group.stop()
+
+
+BCConfig.algo_class = BC

@@ -38,13 +38,13 @@ class PPOConfig(AlgorithmConfig):
     hidden: tuple = (64, 64)
 
 
-def ppo_loss(params, batch, config: PPOConfig):
+def ppo_loss(params, batch, config: PPOConfig, forward_fn=None):
     """Clipped-surrogate + value + entropy loss on one minibatch."""
     import jax
     import jax.numpy as jnp
 
     c = config
-    logits, values = core.forward(params, batch["obs"])
+    logits, values = (forward_fn or core.forward)(params, batch["obs"])
     logp_all = jax.nn.log_softmax(logits)
     logp = jnp.take_along_axis(
         logp_all, batch["actions"][:, None], axis=1
@@ -75,7 +75,8 @@ class PPOLearner(Learner):
 
         self.config = config
         self.module_config = module_config
-        self.params = core.init(jax.random.key(config.seed), module_config)
+        self._fwd = core.get_forward(module_config)
+        self.params = core.module_init(jax.random.key(config.seed), module_config)
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(config.grad_clip),
             optax.adam(config.lr),
@@ -85,7 +86,7 @@ class PPOLearner(Learner):
         self._init_jit()
 
     def _loss(self, params, batch):
-        return ppo_loss(params, batch, self.config)
+        return ppo_loss(params, batch, self.config, forward_fn=self._fwd)
 
     def _build_update(self):
         import jax
@@ -162,7 +163,7 @@ class PPO(Algorithm):
     """(ray: Algorithm.step:818 / PPO.training_step:419 analogue.)"""
 
     def _setup(self, config: PPOConfig):
-        spaces = probe_env_spaces(config.env)
+        spaces = probe_env_spaces(config.env, config.env_to_module)
         self.module_config = core.MLPModuleConfig(
             obs_dim=spaces["obs_dim"],
             num_actions=spaces["num_actions"],
@@ -178,6 +179,7 @@ class PPO(Algorithm):
             num_runners=config.num_env_runners,
             num_envs_per_runner=config.num_envs_per_runner,
             seed=config.seed,
+            env_to_module_fn=config.env_to_module,
         )
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         self._np_rng = np.random.default_rng(config.seed)

@@ -1,0 +1,93 @@
+"""Image + SQL datasource tests (ray: python/ray/data/tests/
+test_image.py, test_sql.py areas)."""
+
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def image_dir(tmp_path_factory):
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("imgs")
+    for i in range(4):
+        arr = np.full((10 + i, 12, 3), i * 10, np.uint8)
+        Image.fromarray(arr).save(d / f"img_{i}.png")
+    (d / "notes.txt").write_text("not an image")
+    return str(d)
+
+
+class TestReadImages:
+    def test_resized_batchable(self, cluster, image_dir):
+        ds = data.read_images(image_dir, size=(8, 8), mode="RGB")
+        rows = ds.take_all()
+        assert len(rows) == 4
+        for row in rows:
+            assert row["image"].shape == (8, 8, 3)
+
+    def test_grayscale_mode(self, cluster, image_dir):
+        ds = data.read_images(image_dir, size=(6, 6), mode="L")
+        row = ds.take(1)[0]
+        assert row["image"].shape == (6, 6, 1)
+
+    def test_pipeline_into_map(self, cluster, image_dir):
+        ds = data.read_images(image_dir, size=(8, 8), mode="RGB")
+        means = ds.map_batches(
+            lambda b: {"mean": b["image"].reshape(len(b["image"]), -1)
+                       .mean(axis=1)}
+        ).take_all()
+        assert len(means) == 4
+
+    def test_no_images_raises(self, cluster, tmp_path):
+        (tmp_path / "only.txt").write_text("x")
+        with pytest.raises(FileNotFoundError):
+            data.read_images(str(tmp_path))
+
+
+class TestReadSql:
+    @pytest.fixture(scope="class")
+    def db_path(self, tmp_path_factory):
+        p = str(tmp_path_factory.mktemp("db") / "t.sqlite")
+        conn = sqlite3.connect(p)
+        conn.execute("CREATE TABLE pts (x REAL, label TEXT)")
+        conn.executemany(
+            "INSERT INTO pts VALUES (?, ?)",
+            [(float(i), f"l{i % 3}") for i in range(30)],
+        )
+        conn.commit()
+        conn.close()
+        return p
+
+    def test_query_roundtrip(self, cluster, db_path):
+        import functools
+
+        ds = data.read_sql(
+            "SELECT x, label FROM pts WHERE x < 10 ORDER BY x",
+            functools.partial(sqlite3.connect, db_path),
+        )
+        rows = ds.take_all()
+        assert len(rows) == 10
+        assert rows[0]["x"] == 0.0 and rows[0]["label"] == "l0"
+
+    def test_aggregate_then_ops(self, cluster, db_path):
+        import functools
+
+        ds = data.read_sql(
+            "SELECT label, COUNT(*) AS n FROM pts GROUP BY label",
+            functools.partial(sqlite3.connect, db_path),
+        )
+        assert ds.count() == 3
+        assert sum(r["n"] for r in ds.take_all()) == 30
