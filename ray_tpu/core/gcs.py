@@ -304,7 +304,7 @@ _READONLY_RPCS = frozenset({
     "wait_placement_group_ready", "ping", "subscribe", "unsubscribe",
     "get_autoscaler_state", "list_tasks", "list_objects",
     "metrics_push", "get_metrics", "get_job_info", "get_job_logs",
-    "list_jobs",
+    "list_jobs", "list_events", "report_event", "get_worker_death_info",
 })
 
 
@@ -365,6 +365,7 @@ class GcsServer:
         self._conn_job: Dict[rpc.Connection, JobID] = {}
         self._worker_conns: Dict[WorkerID, rpc.Connection] = {}
         self._worker_death_reasons: Dict[bytes, str] = {}
+        self._events: List[dict] = []  # bounded structured event log
         self._health_task: Optional[asyncio.Task] = None
         self._start_time = time.time()
         # observability: reporter id -> latest metric snapshot
@@ -609,6 +610,10 @@ class GcsServer:
             return
         node.alive = False
         logger.warning("node %s died: %s", node_id, reason)
+        self.record_cluster_event(
+            "ERROR", "gcs", f"node died: {reason}",
+            node_id=node_id.hex(),
+        )
         # drop object locations on that node
         for oid, locs in list(self.object_locations.items()):
             locs.discard(node_id)
@@ -1377,6 +1382,38 @@ class GcsServer:
             })
         return out
 
+    def record_cluster_event(self, severity: str, source: str,
+                             message: str, **fields) -> None:
+        """Append a structured event to the bounded cluster event log
+        (ray: src/ray/util/event.h RAY_EVENT + dashboard/modules/event).
+        Core transitions (node/actor/worker lifecycle) record here
+        automatically; applications report via util.events."""
+        self._events.append({
+            "ts": time.time(),
+            "severity": severity,
+            "source": source,
+            "message": message,
+            **fields,
+        })
+        while len(self._events) > 2000:
+            self._events.pop(0)
+
+    async def rpc_report_event(self, conn, p):
+        self.record_cluster_event(
+            p.get("severity", "INFO"), p.get("source", "app"),
+            p.get("message", ""), **(p.get("fields") or {}),
+        )
+        return True
+
+    async def rpc_list_events(self, conn, p):
+        sev = p.get("severity")
+        rows = [
+            e for e in self._events
+            if sev is None or e["severity"] == sev
+        ]
+        limit = int(p.get("limit", 500))
+        return rows[-limit:] if limit > 0 else []
+
     async def rpc_metrics_push(self, conn, p):
         """A process pushes its metric snapshot (ray: stats exporter →
         dashboard agent; here straight into the GCS aggregate table)."""
@@ -1892,6 +1929,12 @@ class GcsServer:
             actor.restarts_used += 1
             actor.state = ACTOR_RESTARTING
             actor.worker_addr = None
+            self.record_cluster_event(
+                "WARNING", "gcs",
+                f"actor restarting ({reason})",
+                actor_id=actor.actor_id.hex(),
+                restarts_used=actor.restarts_used,
+            )
             await self.publish(
                 f"actor:{actor.actor_id.hex()}", {"state": ACTOR_RESTARTING}
             )
@@ -1997,6 +2040,12 @@ class GcsServer:
         while len(self._worker_death_reasons) > 1000:
             self._worker_death_reasons.pop(
                 next(iter(self._worker_death_reasons))
+            )
+        reason = p.get("reason") or ""
+        if "memory monitor" in reason:
+            self.record_cluster_event(
+                "WARNING", "memory_monitor", reason,
+                worker_id=wid.hex(),
             )
         self._worker_conns.pop(wid, None)
         self._scrub_holder(wid.binary())

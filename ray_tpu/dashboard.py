@@ -97,6 +97,7 @@ class DashboardActor:
             app.router.add_get(f"/api/{name}", self._make_list(name))
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/metrics", self._metrics)
+        app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/logs", self._logs_index)
         app.router.add_get("/api/logs/{name}", self._logs_tail)
@@ -168,6 +169,16 @@ class DashboardActor:
         from ray_tpu.util import state
 
         return self._json(await self._offload(state.get_metrics))
+
+    async def _events(self, req):
+        from ray_tpu.util import events
+
+        sev = req.query.get("severity")
+
+        def call():
+            return events.list_events(severity=sev)
+
+        return self._json(await self._offload(call))
 
     async def _timeline(self, req):
         return self._json(await self._offload(ray_tpu.timeline))

@@ -20,7 +20,31 @@ Block = pa.Table
 def from_rows(rows: List[Dict[str, Any]]) -> Block:
     if not rows:
         return pa.table({})
-    return pa.Table.from_pylist(rows)
+    # rows from tensor-column blocks carry per-row ndarrays (iter_rows);
+    # stack those columns back into tensor columns — from_pylist cannot
+    # convert multi-dim ndarray cells
+    first = rows[0]
+    tensor_cols = [
+        k for k, v in first.items()
+        if isinstance(v, np.ndarray) and v.ndim >= 1
+    ]
+    if not tensor_cols:
+        return pa.Table.from_pylist(rows)
+    plain = [
+        {k: v for k, v in r.items() if k not in tensor_cols} for r in rows
+    ]
+    arrays = {
+        k: np.stack([r[k] for r in rows]) for k in tensor_cols
+    }
+    tensor_tbl = from_numpy(arrays)
+    if plain[0]:
+        plain_tbl = pa.Table.from_pylist(plain)
+        for i, name in enumerate(tensor_tbl.schema.names):
+            plain_tbl = plain_tbl.append_column(
+                tensor_tbl.schema.field(name), tensor_tbl.column(i)
+            )
+        return plain_tbl
+    return tensor_tbl
 
 def from_numpy(arrays: Dict[str, np.ndarray]) -> Block:
     import json

@@ -165,6 +165,24 @@ class Dataset:
         return self._chain(_Filter(fn))
 
     def select_columns(self, cols: List[str]) -> "Dataset":
+        # logical-optimizer rule: projection pushdown (ray: data/_internal/
+        # logical/rules — Project into Read).  A select directly over
+        # column-capable read tasks (parquet) rewrites the readers to
+        # fetch ONLY those columns instead of filtering post-read.
+        if not self._ops and all(
+            isinstance(s, ReadTask)
+            and getattr(s.fn, "__rt_projectable__", False)
+            for s in self._input_refs
+        ):
+            import functools
+
+            pushed = [
+                ReadTask(
+                    functools.partial(s.fn, columns=list(cols)), *s.args
+                )
+                for s in self._input_refs
+            ]
+            return Dataset(pushed)
         return self.map_batches(
             lambda t: t.select(cols), batch_format="pyarrow"
         )

@@ -92,10 +92,15 @@ def _expand_paths(paths, suffix: str) -> List[str]:
     return out
 
 
-def _read_parquet_file(path):
+def _read_parquet_file(path, columns=None):
     import pyarrow.parquet as pq
 
-    return pq.read_table(path)
+    return pq.read_table(path, columns=columns)
+
+
+# marks readers that accept a `columns=` kwarg, enabling the
+# projection-pushdown rule in Dataset.select_columns
+_read_parquet_file.__rt_projectable__ = True
 
 
 def _read_csv_file(path):
@@ -134,7 +139,12 @@ def _file_dataset(paths, suffix: str, reader) -> Dataset:
     )
 
 
-def read_parquet(paths, **kwargs) -> Dataset:
+def read_parquet(paths, *, columns=None, **kwargs) -> Dataset:
+    if columns is not None:
+        import functools
+
+        reader = functools.partial(_read_parquet_file, columns=list(columns))
+        return _file_dataset(paths, ".parquet", reader)
     return _file_dataset(paths, ".parquet", _read_parquet_file)
 
 

@@ -33,6 +33,9 @@ class AlgorithmConfig:
     # instance per EnvRunner (ray: config.env_runners(
     # env_to_module_connector=...))
     env_to_module: Optional[Any] = None
+    # extra model-catalog options (conv_filters, hidden, ...); the
+    # catalog picks CNN vs MLP from the (post-connector) obs shape
+    model_config: Optional[dict] = None
 
     algo_class = None  # set by subclasses
 
@@ -80,17 +83,38 @@ def probe_env_spaces(env, env_to_module_fn=None) -> Dict[str, int]:
     probe = env() if callable(env) else gym.make(env)
     obs_shape = probe.observation_space.shape
     if env_to_module_fn is not None:
-        from ray_tpu.rllib.connectors import obs_dim_after
+        from ray_tpu.rllib.connectors import obs_shape_after
 
-        obs_dim = obs_dim_after(env_to_module_fn(), obs_shape)
-    else:
-        obs_dim = int(np.prod(obs_shape))
+        # the pipeline's OUTPUT shape drives catalog dispatch: a
+        # normalize-only pipeline keeps image rank (CNN), FlattenObs
+        # collapses it (MLP)
+        obs_shape = obs_shape_after(env_to_module_fn(), obs_shape)
+    obs_dim = int(np.prod(obs_shape))
     spaces = {
         "obs_dim": obs_dim,
+        "obs_shape": tuple(obs_shape),
         "num_actions": int(probe.action_space.n),
     }
     probe.close()
     return spaces
+
+
+def build_module_config(config, spaces: Dict[str, Any]):
+    """Catalog dispatch shared by every algorithm's _setup: rank-3 obs
+    (no flattening connector) → CNN family, else MLP
+    (ray: rllib/models/catalog.py get_model_v2 role)."""
+    from ray_tpu.models.catalog import get_module_config
+
+    model_config = dict(getattr(config, "model_config", None) or {})
+    model_config.setdefault("hidden", config.hidden)
+    shape = spaces["obs_shape"]
+    if len(shape) not in (1, 3):
+        raise ValueError(
+            f"module catalog supports rank-1 (MLP) or rank-3 HWC (CNN) "
+            f"observations, got shape {shape}; add a FlattenObs "
+            "connector (config.connectors) for other ranks"
+        )
+    return get_module_config(shape, spaces["num_actions"], model_config)
 
 
 class Algorithm:

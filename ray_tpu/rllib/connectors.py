@@ -133,11 +133,17 @@ class ClipActions(Connector):
         return np.clip(batch, self.low, self.high)
 
 
-def obs_dim_after(pipeline: Optional[Pipeline], obs_shape: tuple) -> int:
-    """Probe the flattened obs dim the module will see after env→module
-    connectors (so module configs can be built before any env steps)."""
+def obs_shape_after(pipeline: Optional[Pipeline], obs_shape: tuple) -> tuple:
+    """Probe the per-row obs SHAPE the module will see after env→module
+    connectors (so module configs can be built — and the CNN/MLP catalog
+    dispatched — before any env steps).  A normalize-only pipeline keeps
+    image rank; a FlattenObs collapses it."""
     dummy = np.zeros((1,) + tuple(obs_shape), np.float32)
     if pipeline is not None:
         dummy = pipeline(dummy)
         pipeline.reset()
-    return int(np.prod(dummy.shape[1:]))
+    return tuple(dummy.shape[1:])
+
+
+def obs_dim_after(pipeline: Optional[Pipeline], obs_shape: tuple) -> int:
+    return int(np.prod(obs_shape_after(pipeline, obs_shape)))

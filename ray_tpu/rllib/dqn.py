@@ -17,7 +17,12 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ray_tpu.rllib import core
-from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, probe_env_spaces
+from ray_tpu.rllib.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    build_module_config,
+    probe_env_spaces,
+)
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
 from ray_tpu.rllib.learner_group import Learner, LearnerGroup
 
@@ -150,11 +155,7 @@ class DQNLearner(Learner):
 class DQN(Algorithm):
     def _setup(self, config: DQNConfig):
         spaces = probe_env_spaces(config.env, config.env_to_module)
-        self.module_config = core.MLPModuleConfig(
-            obs_dim=spaces["obs_dim"],
-            num_actions=spaces["num_actions"],
-            hidden=config.hidden,
-        )
+        self.module_config = build_module_config(config, spaces)
         cfg, mc = config, self.module_config
         self.learner_group = LearnerGroup(
             lambda: DQNLearner(cfg, mc), num_learners=config.num_learners

@@ -43,18 +43,26 @@ class EnvRunnerActor:
             env_to_module_fn() if env_to_module_fn is not None else None
         )
         self._obs, _ = self._envs.reset(seed=seed)
+        self._prev_done = np.zeros(num_envs, bool)
         self._proc = self._process(self._obs)
         self._sample_eps_fn = jax.jit(_sample_eps)
         # per-env running episode returns for metrics
         self._ep_return = np.zeros(num_envs, np.float64)
         self._completed: List[float] = []
 
-    def _process(self, obs, dones=None) -> np.ndarray:
+    def _process(self, obs) -> np.ndarray:
+        """Connector-transform a raw obs batch.
+
+        Per-env connector state resets ONE STEP AFTER done: gymnasium
+        >= 1.0 vector envs autoreset in NEXT_STEP mode, so the obs
+        returned on the done step is still the OLD episode's terminal
+        observation — the new episode's first obs arrives on the
+        following step, and that is the one that must re-seed stacks."""
         if self._env_to_module is None:
             return obs.astype(np.float32)
-        if dones is not None:
-            for i in np.nonzero(dones)[0]:
-                self._env_to_module.reset(int(i))
+        for i in np.nonzero(self._prev_done)[0]:
+            self._env_to_module.reset(int(i))
+        self._prev_done[:] = False
         return self._env_to_module(obs)
 
     @staticmethod
@@ -108,9 +116,11 @@ class EnvRunnerActor:
             val_buf[t] = np.asarray(value)
             self._obs, reward, term, trunc, _ = self._envs.step(action)
             done = np.logical_or(term, trunc)
-            # connector state for finished envs resets before the new
-            # episode's first (autoreset) obs is processed
-            self._proc = self._process(self._obs, dones=done)
+            self._proc = self._process(self._obs)
+            # flag AFTER processing: under NEXT_STEP autoreset this obs is
+            # the old episode's terminal one; the reset obs arrives next
+            # step and _process will re-seed connector state then
+            self._prev_done |= done
             rew_buf[t] = reward
             done_buf[t] = done
             self._ep_return += reward

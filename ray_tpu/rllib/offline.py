@@ -24,6 +24,7 @@ from ray_tpu.rllib import core
 from ray_tpu.rllib.algorithm import (
     Algorithm,
     AlgorithmConfig,
+    build_module_config,
     probe_env_spaces,
 )
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
@@ -170,14 +171,16 @@ class BC(Algorithm):
     def _setup(self, config: BCConfig):
         assert config.input_paths, "BCConfig.offline_data(paths) is required"
         spaces = probe_env_spaces(config.env, config.env_to_module)
-        self.module_config = core.MLPModuleConfig(
-            obs_dim=spaces["obs_dim"],
-            num_actions=spaces["num_actions"],
-            hidden=config.hidden,
-        )
+        self.module_config = build_module_config(config, spaces)
         self.reader = JsonEpisodeReader(
             config.input_paths, env_to_module_fn=config.env_to_module
         )
+        if len(self.reader) < config.train_batch_size:
+            raise ValueError(
+                f"offline dataset has {len(self.reader)} samples, fewer "
+                f"than train_batch_size={config.train_batch_size}; record "
+                "more episodes or lower train_batch_size"
+            )
         self.learner = BCLearner(config, self.module_config)
         self.env_runner_group = EnvRunnerGroup(
             config.env,

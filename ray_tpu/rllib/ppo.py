@@ -19,7 +19,12 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ray_tpu.rllib import core
-from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, probe_env_spaces
+from ray_tpu.rllib.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    build_module_config,
+    probe_env_spaces,
+)
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
 from ray_tpu.rllib.learner_group import Learner, LearnerGroup
 
@@ -164,11 +169,7 @@ class PPO(Algorithm):
 
     def _setup(self, config: PPOConfig):
         spaces = probe_env_spaces(config.env, config.env_to_module)
-        self.module_config = core.MLPModuleConfig(
-            obs_dim=spaces["obs_dim"],
-            num_actions=spaces["num_actions"],
-            hidden=config.hidden,
-        )
+        self.module_config = build_module_config(config, spaces)
         cfg, mc = config, self.module_config
         self.learner_group = LearnerGroup(
             lambda: PPOLearner(cfg, mc), num_learners=config.num_learners

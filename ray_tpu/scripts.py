@@ -152,6 +152,28 @@ def cmd_metrics(args) -> None:
     print(json.dumps(state.get_metrics(), indent=2))
 
 
+def cmd_events(args) -> None:
+    from ray_tpu.util import events
+
+    _connect(args)
+    print(json.dumps(
+        events.list_events(severity=args.severity), indent=2, default=str
+    ))
+
+
+def cmd_timeline(args) -> None:
+    import ray_tpu
+
+    _connect(args)
+    trace = ray_tpu.timeline()
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(trace, f)
+        print(f"wrote {len(trace)} events to {args.output}")
+    else:
+        print(json.dumps(trace, default=str))
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="ray_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -169,6 +191,16 @@ def main(argv=None) -> None:
     p = sub.add_parser("status", help="cluster summary")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("events", help="structured cluster events")
+    p.add_argument("--severity", default=None)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("timeline", help="chrome-trace timeline export")
+    p.add_argument("--output", "-o", default=None)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument(

@@ -24,12 +24,18 @@ PROXY_NAME = "SERVE_PROXY"
 _proxy_handle = None
 
 
-def start(http_port: Optional[int] = None):
-    """Start the serve control plane (controller (+ proxy if port given))."""
+def start(http_port: Optional[int] = None,
+          grpc_port: Optional[int] = None):
+    """Start the serve control plane (controller, plus HTTP/gRPC
+    ingresses for whichever ports are given)."""
     controller = get_or_create_controller()
     ray_tpu.get(controller.ping.remote(), timeout=60)
     if http_port is not None:
         _get_or_create_proxy(http_port)
+    if grpc_port is not None:
+        from ray_tpu.serve.grpc_proxy import start_grpc_proxy
+
+        start_grpc_proxy(grpc_port)
     return controller
 
 

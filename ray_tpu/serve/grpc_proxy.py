@@ -1,0 +1,151 @@
+"""gRPC ingress for Serve.
+
+Role-equivalent of ray: python/ray/serve/_private/proxy.py:534
+(gRPCProxy).  A generic aio gRPC server inside an actor: any unary
+method path ``/<anything>/<Method>`` routes to the application whose
+route prefix matches the ``application`` request metadata (or, absent
+that, ``/<Method>``).  Payloads are JSON bytes in/out — schema-free
+like the HTTP proxy (the reference requires user protos + serve build;
+this keeps the transport pluggable without a codegen step).  Dispatch
+rides DeploymentHandle, so gRPC callers get the same pow-2 routing and
+replica-death failover as HTTP and handle callers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+GRPC_PROXY_NAME = "_rt_serve_grpc_proxy"
+
+
+@ray_tpu.remote
+class GrpcProxyActor:
+    def __init__(self, port: int = 9000):
+        self._port = port
+        self._server = None
+        self._routes: Dict[str, Any] = {}
+        self._routes_version = -1
+        self._last_poll = 0.0
+        self._handles: Dict[str, Any] = {}
+        self._controller = None
+
+    async def start(self) -> int:
+        import grpc
+
+        if self._server is not None:
+            return self._port
+
+        outer = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                md = dict(handler_call_details.invocation_metadata or ())
+
+                async def unary(request_bytes, context):
+                    return await outer._dispatch(method, md, request_bytes)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=None,  # raw bytes through
+                    response_serializer=None,
+                )
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        bound = self._server.add_insecure_port(f"0.0.0.0:{self._port}")
+        await self._server.start()
+        self._port = bound
+        return bound
+
+    # route state is controller-owned, polled versioned — same protocol
+    # as the HTTP proxy (serve/proxy.py _poll_routes)
+    def _poll_routes(self, force: bool = False):
+        import time
+
+        now = time.monotonic()
+        if not force and now - self._last_poll < 1.0:
+            return
+        self._last_poll = now
+        if self._controller is None:
+            from ray_tpu.serve.controller import get_or_create_controller
+
+            self._controller = get_or_create_controller()
+        routes = ray_tpu.get(
+            self._controller.get_routes.remote(), timeout=30
+        )
+        if routes["version"] != self._routes_version:
+            self._routes_version = routes["version"]
+            self._routes = dict(routes.get("http_routes", {}))
+            self._handles = {}
+
+    def _handle_for(self, prefix: str):
+        h = self._handles.get(prefix)
+        if h is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            app, deployment = self._routes[prefix]
+            h = self._handles[prefix] = DeploymentHandle(
+                self._controller, app, deployment
+            )
+        return h
+
+    async def _dispatch(self, method: str, metadata: Dict[str, str],
+                        request_bytes: bytes) -> bytes:
+        import asyncio
+        import grpc  # noqa: F401
+
+        route = metadata.get("application") or (
+            "/" + method.rsplit("/", 1)[-1]
+        )
+        if not route.startswith("/"):
+            route = "/" + route
+        args: tuple = ()
+        kwargs: Dict[str, Any] = {}
+        if request_bytes:
+            try:
+                parsed = json.loads(request_bytes)
+                if isinstance(parsed, dict):
+                    kwargs = parsed
+                else:
+                    args = (parsed,)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                args = (request_bytes,)
+
+        def _route_and_dispatch():
+            self._poll_routes()
+            prefix = route if route in self._routes else None
+            if prefix is None:
+                self._poll_routes(force=True)
+                prefix = route if route in self._routes else None
+            if prefix is None:
+                return None
+            return self._handle_for(prefix).remote(*args, **kwargs)
+
+        resp = await asyncio.get_running_loop().run_in_executor(
+            None, _route_and_dispatch
+        )
+        if resp is None:
+            raise RuntimeError(f"no serve application at route {route!r}")
+        value = await resp.result_async()
+        if isinstance(value, bytes):
+            return value
+        return json.dumps(value, default=str).encode()
+
+    async def ping(self) -> bool:
+        return True
+
+
+def start_grpc_proxy(port: int = 0) -> int:
+    """Start (or reuse) the gRPC ingress; returns the bound port."""
+    proxy = GrpcProxyActor.options(
+        name=GRPC_PROXY_NAME, get_if_exists=True, lifetime="detached",
+        num_cpus=0.1,
+    ).remote(port)
+    return ray_tpu.get(proxy.start.remote(), timeout=120)

@@ -118,3 +118,8 @@ def summarize() -> Dict[str, Any]:
             len(b["bundles"]) for b in demand["pending_pg_bundles"]
         ),
     }
+
+
+def list_events(severity: Optional[str] = None, limit: int = 500):
+    """Structured cluster events (ray: list_cluster_events)."""
+    return _call("list_events", {"severity": severity, "limit": limit})

@@ -91,3 +91,37 @@ class TestReadSql:
         )
         assert ds.count() == 3
         assert sum(r["n"] for r in ds.take_all()) == 30
+
+
+class TestProjectionPushdown:
+    @pytest.fixture(scope="class")
+    def pq_dir(self, tmp_path_factory):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        d = tmp_path_factory.mktemp("pq")
+        for i in range(3):
+            t = pa.table({
+                "a": list(range(i * 5, i * 5 + 5)),
+                "b": [f"s{j}" for j in range(5)],
+                "c": [float(j) for j in range(5)],
+            })
+            pq.write_table(t, d / f"p{i}.parquet")
+        return str(d)
+
+    def test_select_pushes_into_read(self, cluster, pq_dir):
+        ds = data.read_parquet(pq_dir).select_columns(["a"])
+        # the rule rewrote the plan: no post-read ops remain
+        assert not ds._ops
+        rows = ds.take_all()
+        assert len(rows) == 15
+        assert set(rows[0].keys()) == {"a"}
+
+    def test_read_parquet_columns_kwarg(self, cluster, pq_dir):
+        ds = data.read_parquet(pq_dir, columns=["b", "c"])
+        assert set(ds.columns()) == {"b", "c"}
+
+    def test_select_after_op_stays_a_transform(self, cluster, pq_dir):
+        ds = data.read_parquet(pq_dir).map(lambda r: r).select_columns(["a"])
+        assert ds._ops  # no pushdown through user code
+        assert set(ds.take(1)[0].keys()) == {"a"}

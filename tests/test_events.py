@@ -1,0 +1,68 @@
+"""Structured cluster event log tests (ray: RAY_EVENT +
+dashboard/modules/event role)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import events
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestEvents:
+    def test_report_and_list(self, cluster):
+        events.report("INFO", "test", "hello", run=1)
+        events.report("ERROR", "test", "boom", code=7)
+        rows = events.list_events()
+        msgs = [r["message"] for r in rows]
+        assert "hello" in msgs and "boom" in msgs
+        err = [r for r in rows if r["message"] == "boom"][0]
+        assert err["severity"] == "ERROR" and err["code"] == 7
+        assert err["ts"] > 0
+
+    def test_severity_filter(self, cluster):
+        events.report("WARNING", "test", "warn-only-probe")
+        rows = events.list_events(severity="WARNING")
+        assert all(r["severity"] == "WARNING" for r in rows)
+        assert any(r["message"] == "warn-only-probe" for r in rows)
+
+    def test_invalid_severity_rejected(self, cluster):
+        with pytest.raises(ValueError):
+            events.report("LOUD", "test", "nope")
+
+    def test_actor_restart_records_event(self, cluster):
+        import os
+
+        @ray_tpu.remote(max_restarts=1)
+        class Crashy:
+            def ping(self):
+                return os.getpid()
+
+            def die(self):
+                os._exit(1)
+
+        a = Crashy.remote()
+        ray_tpu.get(a.ping.remote(), timeout=60)
+        try:
+            ray_tpu.get(a.die.remote(), timeout=30)
+        except Exception:
+            pass
+        # wait for the restart transition to record
+        import time
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rows = events.list_events(severity="WARNING")
+            if any("actor restarting" in r["message"] for r in rows):
+                break
+            time.sleep(0.5)
+        assert any(
+            "actor restarting" in r["message"]
+            for r in events.list_events(severity="WARNING")
+        )
+        ray_tpu.get(a.ping.remote(), timeout=60)

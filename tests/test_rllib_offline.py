@@ -141,6 +141,70 @@ def cluster():
     ray_tpu.shutdown()
 
 
+def make_image_env():
+    """Tiny synthetic image env: obs (8, 8, 1), reward for action 1."""
+    import gymnasium as gym
+
+    class ImgEnv(gym.Env):
+        observation_space = gym.spaces.Box(0, 1, (8, 8, 1), np.float32)
+        action_space = gym.spaces.Discrete(2)
+
+        def __init__(self):
+            self._t = 0
+
+        def reset(self, *, seed=None, options=None):
+            self._t = 0
+            return np.zeros((8, 8, 1), np.float32), {}
+
+        def step(self, action):
+            self._t += 1
+            obs = np.full((8, 8, 1), self._t / 10.0, np.float32)
+            return obs, float(action), self._t >= 10, False, {}
+
+    return ImgEnv()
+
+
+class TestCatalogInAlgorithms:
+    def test_ppo_builds_cnn_for_image_env(self, cluster):
+        from ray_tpu.rllib import PPOConfig
+
+        algo = (
+            PPOConfig()
+            .environment(make_image_env)
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                         rollout_fragment_length=10)
+            .training(model_config={"conv_filters": ((4, 3, 2),),
+                                    "hidden": (16,)},
+                      num_epochs=1, minibatch_size=10)
+            .build()
+        )
+        try:
+            assert isinstance(algo.module_config, CNNModuleConfig)
+            result = algo.train()
+            assert np.isfinite(result["policy_loss"])
+        finally:
+            algo.stop()
+
+    def test_flatten_connector_forces_mlp(self, cluster):
+        from ray_tpu.rllib import DQNConfig
+        from ray_tpu.rllib.connectors import FlattenObs
+
+        algo = (
+            DQNConfig()
+            .environment(make_image_env)
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=2)
+            .connectors(env_to_module=lambda: Pipeline([FlattenObs()]))
+            .training(hidden=(16,), learning_starts=10,
+                      train_batch_size=8)
+            .build()
+        )
+        try:
+            assert isinstance(algo.module_config, core.MLPModuleConfig)
+            assert algo.module_config.obs_dim == 64
+        finally:
+            algo.stop()
+
+
 class TestOfflineConnectors:
     def test_reader_applies_pipeline_per_episode(self, tmp_path):
         path = str(tmp_path / "eps.jsonl")

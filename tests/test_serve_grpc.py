@@ -1,0 +1,61 @@
+"""Serve gRPC ingress tests (ray: serve gRPCProxy test areas)."""
+
+import json
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+import ray_tpu  # noqa: E402
+from ray_tpu import serve  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def grpc_app():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, payload=None, **kwargs):
+            if kwargs:
+                return {"kwargs": kwargs}
+            return {"echo": payload}
+
+    serve.run(Echo.bind(), name="echo", route_prefix="/echo")
+    port = serve.start_grpc_proxy(0)
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield channel
+    channel.close()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _call(channel, method, payload: bytes, metadata=None):
+    rpc = channel.unary_unary(
+        method,
+        request_serializer=None,
+        response_deserializer=None,
+    )
+    return rpc(payload, metadata=metadata or (), timeout=60)
+
+
+class TestGrpcIngress:
+    def test_route_from_method_name(self, grpc_app):
+        out = _call(grpc_app, "/rt.serve/echo", json.dumps(42).encode())
+        assert json.loads(out) == {"echo": 42}
+
+    def test_route_from_metadata(self, grpc_app):
+        out = _call(
+            grpc_app, "/rt.serve/Anything",
+            json.dumps({"a": 1}).encode(),
+            metadata=(("application", "/echo"),),
+        )
+        assert json.loads(out) == {"kwargs": {"a": 1}}
+
+    def test_unknown_route_errors(self, grpc_app):
+        with pytest.raises(grpc.RpcError):
+            _call(grpc_app, "/rt.serve/nope", b"{}")
+
+    def test_raw_bytes_passthrough(self, grpc_app):
+        out = _call(grpc_app, "/rt.serve/echo", b"\x00\x01binary")
+        assert json.loads(out)["echo"] is not None

@@ -1,0 +1,133 @@
+"""Flax integration + client surface tests.
+
+Flax modules become sharded functional train states over the virtual
+8-device mesh (ray: train_loop_utils.prepare_model role), and the
+client context manager attaches a fresh driver process to a running
+cluster address (ray: ray.util.client role).
+"""
+
+import numpy as np
+import pytest
+
+flax = pytest.importorskip("flax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from flax import linen as nn  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from ray_tpu.parallel.mesh import FSDP_AXIS  # noqa: E402
+from ray_tpu.train.flax_utils import (  # noqa: E402
+    create_train_state,
+    fsdp_spec,
+    make_train_step,
+)
+
+
+class MLP(nn.Module):
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.tanh(nn.Dense(self.hidden)(x))
+        return nn.Dense(1)(x)
+
+
+def _mesh():
+    devs = np.array(jax.devices()[:8]).reshape(1, 8, 1, 1)
+    return Mesh(devs, ("dp", FSDP_AXIS, "sp", "tp"))
+
+
+class TestFlaxUtils:
+    def test_fsdp_spec_picks_divisible_largest_dim(self):
+        mesh = _mesh()
+        spec = fsdp_spec((64, 16), mesh)
+        assert tuple(spec) == (FSDP_AXIS, None)
+        # not divisible by 8 anywhere -> replicated
+        assert tuple(fsdp_spec((3, 5), mesh)) == ()
+        assert tuple(fsdp_spec((), mesh)) == ()
+
+    def test_state_is_sharded_and_trains(self):
+        mesh = _mesh()
+        x = jnp.ones((16, 8))
+        y = jnp.ones((16, 1)) * 2.0
+        state = create_train_state(
+            MLP(), optax.adam(1e-2), jax.random.key(0), x, mesh=mesh,
+        )
+        kernel = state["params"]["Dense_0"]["kernel"]
+        assert FSDP_AXIS in str(kernel.sharding.spec)
+
+        def loss_fn(params, apply_fn, batch):
+            pred = apply_fn({"params": params}, batch["x"])
+            return ((pred - batch["y"]) ** 2).mean()
+
+        step = make_train_step(loss_fn, state)
+        batch = {"x": x, "y": y}
+        losses = []
+        for _ in range(30):
+            state, metrics = step(state, batch)
+            losses.append(metrics["loss"])
+        assert state["step"] == 30
+        assert losses[-1] < losses[0] * 0.3, losses[::10]
+
+    def test_no_mesh_replicated(self):
+        state = create_train_state(
+            MLP(hidden=8), optax.sgd(0.1), jax.random.key(1),
+            jnp.ones((4, 3)),
+        )
+        assert state["step"] == 0
+
+
+class TestClientSurface:
+    def test_connect_and_disconnect(self):
+        import ray_tpu
+        from ray_tpu.util.client import connect
+
+        info = ray_tpu.init(num_cpus=2, num_tpus=0)
+        address = info["gcs_address"]
+        ray_tpu.shutdown()
+
+        # a fresh head for the client to dial
+        info = ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+            # already-attached process: connect() is exercised in its
+            # subprocess form below; here verify the context API shape
+            from ray_tpu.util.client import ClientContext
+
+            ctx = ClientContext(info, info["gcs_address"])
+            assert "ClientContext" in repr(ctx)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_remote_driver_subprocess(self, tmp_path):
+        """A second PROCESS attaches by address and runs work — the
+        actual ray-client scenario."""
+        import subprocess
+        import sys
+        import textwrap
+
+        import ray_tpu
+
+        info = ray_tpu.init(num_cpus=2, num_tpus=0)
+        addr = info["gcs_address"]
+        script = textwrap.dedent(f"""
+            import sys; sys.path.insert(0, {repr(str(__import__('os').getcwd()))})
+            import ray_tpu
+            from ray_tpu.util.client import connect
+            with connect({addr!r}) as ctx:
+                @ray_tpu.remote
+                def f(x):
+                    return x * 3
+                print("CLIENT-RESULT", ray_tpu.get(f.remote(14), timeout=60))
+        """)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", script], capture_output=True,
+                text=True, timeout=180,
+            )
+            assert "CLIENT-RESULT 42" in out.stdout, (
+                out.stdout, out.stderr[-2000:]
+            )
+        finally:
+            ray_tpu.shutdown()
