@@ -71,20 +71,32 @@ def emit(metric, value, unit, baseline=None, **extra):
 # ---------------------------------------------------------------------------
 
 
-def bench_gpt2(steps: int = 10):
+def bench_gpt2(steps: int = 10, scan_unroll: int = 12):
     import jax
     import jax.numpy as jnp
     import optax
 
     from ray_tpu.models import gpt2
 
+    # persistent compile cache: the fully-unrolled step takes minutes to
+    # compile through a tunneled (axon) backend; cache the executable so
+    # repeat bench runs skip straight to the timed loop
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", "/tmp/ray_tpu_xla_cache"
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
+
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if on_tpu:
-        # flash pallas attention + no remat: measured fastest single-chip
-        # combination (dense+remat 175 ms/step → flash 98 ms at B=8 S=1024)
+        # flash pallas attention + no remat + fully-unrolled layer scan:
+        # measured fastest single-chip combination (dense+remat 175
+        # ms/step → flash 98 ms → unrolled 80 ms at B=8 S=1024, v5e)
         config = gpt2.GPTConfig.gpt2_124m(
-            attention_impl="flash", remat=False
+            attention_impl="flash", remat=False, scan_unroll=scan_unroll
         )
         batch, seq = 8, 1024
         kind = dev.device_kind
@@ -287,11 +299,37 @@ def bench_pg_churn(ray_tpu, duration_s=3.0):
     return _timed_loop(one, duration_s, chunk=10)
 
 
+def _bench_gpt2_guarded(timeout_s: float = 1500.0):
+    """Unrolled-scan bench in a timeboxed subprocess (its compile can
+    take minutes through a tunneled backend and cannot be interrupted
+    in-process); falls back to the rolled scan — a known-fast compile at
+    ~10%-lower MFU — if the subprocess blows the budget."""
+    import subprocess
+    import sys
+
+    code = (
+        "import bench, json; "
+        "print('@@' + json.dumps(bench.bench_gpt2()))"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("@@"):
+                return json.loads(line[2:])
+    except subprocess.TimeoutExpired:
+        pass
+    return bench_gpt2(scan_unroll=1)
+
+
 def main():
     # 1) TPU compute first (pure jax; no cluster yet).
     gpt2_stats = None
     try:
-        gpt2_stats = bench_gpt2()
+        gpt2_stats = _bench_gpt2_guarded()
         emit(
             "gpt2_124m_train_tokens_per_sec_per_chip"
             if gpt2_stats["on_tpu"]

@@ -153,7 +153,16 @@ class PendingLease:
 
 
 class Scheduler:
-    """Global resource accounting + node selection."""
+    """Global resource accounting + node selection.
+
+    Scale envelope (documented, by design): node selection is O(nodes)
+    per lease and `_kick_pending` re-evaluates the pending queue after
+    each release/registration — linear scans sized for TPU clusters
+    (O(100s) of hosts; a v5e-256 pod is 64 hosts), not the reference's
+    2,000-node CPU fleets.  At that scale the constant factors here are
+    noise next to worker spawn and XLA compile; a feasibility-class
+    index is the upgrade path if host counts grow 10x.
+    """
 
     def __init__(self, gcs: "GcsServer"):
         self.gcs = gcs

@@ -44,6 +44,16 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     attention_impl: str = "dense"  # "dense" | "ring" (sp-sharded)
     remat: bool = True  # rematerialize each block in the backward pass
+    # chunked cross-entropy: apply the lm_head + logsumexp per sequence
+    # chunk of this many tokens (0 = dense).  Caps the largest activation
+    # at O(B·chunk·V) instead of O(B·S·V) — what lets B=16+ fit in HBM.
+    xent_chunk: int = 0
+    # layer-scan unroll factor (1 = rolled loop).  Fully unrolling (set
+    # to num_layers) removes the XLA while-loop overhead and lets the
+    # scheduler overlap across layer boundaries: measured 99.5 → 80.4
+    # ms/step (MFU 0.358 → 0.442) on v5e at B=8, S=1024.  Rolled stays
+    # the default for compile-time and for remat-heavy configs.
+    scan_unroll: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -196,8 +206,13 @@ def _block(x, p, config: GPTConfig):
     return constrain(x, ("batch", "seq", "embed"))
 
 
-def forward(params: Params, tokens, config: GPTConfig):
-    """tokens (B, S) int32 → logits (B, S, vocab) in f32."""
+def features(params: Params, tokens, config: GPTConfig):
+    """tokens (B, S) int32 → final-layernorm features (B, S, E).
+
+    The pre-head backbone, split out so the chunked cross-entropy can
+    apply the lm_head per sequence chunk instead of materializing the
+    full (B, S, vocab) f32 logits (the single largest activation — 3.3
+    GB at B=16, S=1024, V=50304)."""
     c = config
     B, S = tokens.shape
     # Explicitly all-gather the embedding table for the lookup: a gather
@@ -218,25 +233,84 @@ def forward(params: Params, tokens, config: GPTConfig):
             fn = jax.checkpoint(_block, static_argnums=(2,))
         return fn(carry, layer_params, c), None
 
-    x, _ = lax.scan(body, x, params["blocks"])
-    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+    x, _ = lax.scan(
+        body, x, params["blocks"], unroll=max(1, c.scan_unroll)
+    )
+    return _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+
+
+def forward(params: Params, tokens, config: GPTConfig):
+    """tokens (B, S) int32 → logits (B, S, vocab) in f32."""
+    x = features(params, tokens, config)
     logits = jnp.einsum(
         "bse,ve->bsv",
         x,
-        params["wte"].astype(c.dtype),
+        params["wte"].astype(config.dtype),
         preferred_element_type=jnp.float32,
     )
     return constrain(logits, ("batch", "seq", "vocab"))
 
 
+def _chunked_xent(params: Params, inputs, targets, mask, config: GPTConfig):
+    """Cross-entropy with the lm_head applied per sequence chunk under
+    jax.checkpoint: each chunk's (B, C, V) logits are recomputed in the
+    backward pass instead of living through the whole step.  Numerically
+    identical to the dense path (same lse − target_logit formulation)."""
+    c = config
+    B, S = inputs.shape
+    C = c.xent_chunk
+    nc = S // C
+    x = features(params, inputs, config)  # (B, S, E) — kept; it's small
+    wte = params["wte"].astype(c.dtype)
+    xs = x.reshape(B, nc, C, -1).transpose(1, 0, 2, 3)  # (nc, B, C, E)
+    ts = targets.reshape(B, nc, C).transpose(1, 0, 2)
+    ms = (
+        mask.reshape(B, nc, C).transpose(1, 0, 2).astype(jnp.float32)
+        if mask is not None
+        else None
+    )
+
+    @jax.checkpoint
+    def chunk_ll(xc, tc):
+        logits = jnp.einsum(
+            "bce,ve->bcv", xc, wte, preferred_element_type=jnp.float32
+        )
+        logits = constrain(logits, ("batch", None, "vocab"))
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return tl - lse  # (B, C)
+
+    def body(carry, xtm):
+        ll_sum, m_sum = carry
+        if ms is None:
+            xc, tc = xtm
+            ll = chunk_ll(xc, tc)
+            return (ll_sum + ll.sum(), m_sum + ll.size), None
+        xc, tc, mc = xtm
+        ll = chunk_ll(xc, tc)
+        return (ll_sum + (ll * mc).sum(), m_sum + mc.sum()), None
+
+    xtm = (xs, ts) if ms is None else (xs, ts, ms)
+    (ll_sum, m_sum), _ = lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), xtm
+    )
+    return -ll_sum / jnp.maximum(m_sum, 1.0)
+
+
 def loss_fn(params: Params, batch, config: GPTConfig):
     """Next-token cross-entropy.  batch: {"tokens": (B, S+1) int32} or
-    {"inputs", "targets"} each (B, S)."""
+    {"inputs", "targets"} each (B, S).  With config.xent_chunk set (and
+    S divisible by it) the lm_head+softmax runs per sequence chunk,
+    capping peak logits memory."""
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
+    if config.xent_chunk and inputs.shape[1] % config.xent_chunk == 0:
+        return _chunked_xent(
+            params, inputs, targets, batch.get("mask"), config
+        )
     logits = forward(params, inputs, config)
     # lse − target_logit instead of log_softmax + gather: avoids writing a
     # second full (B, S, V) f32 array (1.6 GB at B=8, S=1024, V=50k).

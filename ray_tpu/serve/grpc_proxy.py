@@ -60,6 +60,12 @@ class GrpcProxyActor:
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers((_Generic(),))
         bound = self._server.add_insecure_port(f"0.0.0.0:{self._port}")
+        if bound == 0:  # grpc signals bind failure by returning port 0
+            self._server = None
+            raise RuntimeError(
+                f"gRPC ingress could not bind port {self._port} "
+                "(already in use?)"
+            )
         await self._server.start()
         self._port = bound
         return bound
@@ -82,8 +88,14 @@ class GrpcProxyActor:
         )
         if routes["version"] != self._routes_version:
             self._routes_version = routes["version"]
-            self._routes = dict(routes.get("http_routes", {}))
-            self._handles = {}
+            new_routes = dict(routes.get("http_routes", {}))
+            # drop ONLY handles whose prefix changed target — an
+            # unrelated deploy must not discard warm replica routers
+            # (same policy as serve/proxy.py)
+            for p in list(self._handles):
+                if new_routes.get(p) != self._routes.get(p):
+                    self._handles.pop(p, None)
+            self._routes = new_routes
 
     def _handle_for(self, prefix: str):
         h = self._handles.get(prefix)

@@ -70,11 +70,10 @@ def create_train_state(
     variables = module.init(rng, sample_batch, **(apply_kwargs or {}))
     params = variables["params"] if "params" in variables else variables
     params = shard_params(params, mesh)
+    # optax moment tensors are created with zeros_like over the (already
+    # sharded) params, so they inherit each param's sharding; scalars
+    # replicate — no explicit placement needed
     opt_state = optimizer.init(params)
-    if mesh is not None:
-        # optimizer moments inherit each param's sharding automatically
-        # (optax states mirror the param pytree); scalars replicate
-        opt_state = jax.device_put(opt_state)
     return {
         "params": params,
         "opt_state": opt_state,
@@ -114,6 +113,9 @@ def make_train_step(
         new_state = dict(
             st, params=params, opt_state=opt_state, step=st["step"] + 1
         )
-        return new_state, {"loss": float(loss)}
+        # loss stays a device scalar: float()-ing here would block every
+        # step on a host round-trip and kill async dispatch pipelining
+        # (call float(metrics["loss"]) when you actually need the value)
+        return new_state, {"loss": loss}
 
     return run

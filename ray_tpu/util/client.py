@@ -20,8 +20,6 @@ For driving a cluster without a persistent connection at all, use
 
 from __future__ import annotations
 
-from typing import Optional
-
 
 class ClientContext:
     """Handle for a remote-driver connection (ray: ClientContext)."""
@@ -48,7 +46,7 @@ class ClientContext:
         return f"ClientContext(address={self.address!r})"
 
 
-def connect(address: str, *, namespace: Optional[str] = None) -> ClientContext:
+def connect(address: str) -> ClientContext:
     """Attach this process as a driver to a running cluster."""
     import ray_tpu
 

@@ -120,6 +120,6 @@ def summarize() -> Dict[str, Any]:
     }
 
 
-def list_events(severity: Optional[str] = None, limit: int = 500):
-    """Structured cluster events (ray: list_cluster_events)."""
-    return _call("list_events", {"severity": severity, "limit": limit})
+# single implementation lives in util.events; re-exported here so the
+# state API surface is complete (ray: list_cluster_events)
+from ray_tpu.util.events import list_events  # noqa: E402,F401

@@ -67,7 +67,7 @@ class TestFlaxUtils:
         losses = []
         for _ in range(30):
             state, metrics = step(state, batch)
-            losses.append(metrics["loss"])
+            losses.append(float(metrics["loss"]))
         assert state["step"] == 30
         assert losses[-1] < losses[0] * 0.3, losses[::10]
 
