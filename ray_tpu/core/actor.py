@@ -113,6 +113,7 @@ class ActorClass:
             detached=(o.get("lifetime") == "detached"),
             strategy=_strategy_dict(o.get("scheduling_strategy")),
             runtime_env=o.get("runtime_env"),
+            max_concurrency=o.get("max_concurrency"),
         )
         return ActorHandle(actor_id, max_task_retries)
 

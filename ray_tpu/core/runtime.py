@@ -1037,6 +1037,7 @@ class Runtime:
         detached=False,
         strategy=None,
         runtime_env=None,
+        max_concurrency=None,
     ) -> "ActorID":
         actor_id = ActorID.random()
         rtenv_desc = self._normalize_runtime_env(runtime_env)
@@ -1046,6 +1047,8 @@ class Runtime:
             "args": self._pack_args(args, kwargs),
             "max_task_retries": max_task_retries,
         }
+        if max_concurrency is not None:
+            creation_spec["max_concurrency"] = int(max_concurrency)
         resources = dict(resources if resources is not None else {"CPU": 1})
         reply = self._run(
             self.gcs.call(

@@ -43,6 +43,7 @@ class WorkerServer:
         self.actor_id: Optional[ActorID] = None
         self._actor_is_async = False
         self._actor_sem: Optional[asyncio.Semaphore] = None
+        self._actor_thread_pool = None  # set for threaded sync actors
         self._running_task_threads: Dict[bytes, int] = {}  # task_id -> thread id
         self._running_tasks: Dict[bytes, dict] = {}  # task_id -> descriptor
         self._cancelled: set = set()
@@ -207,6 +208,18 @@ class WorkerServer:
             for _, m in inspect.getmembers(cls, predicate=inspect.isfunction)
         )
         self._actor_sem = asyncio.Semaphore(spec.get("max_concurrency") or 1000)
+        # threaded sync actors (reference: threaded actors via
+        # max_concurrency on a non-async class): methods run on a pool of
+        # N threads instead of the single ordered executor thread.
+        # Admission stays per-caller-ordered (seq), but executions
+        # overlap — the same relaxation the reference documents.
+        mc = spec.get("max_concurrency") or 1
+        if not self._actor_is_async and mc > 1:
+            import concurrent.futures
+
+            self._actor_thread_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=mc, thread_name_prefix="actor-mc"
+            )
         loop = asyncio.get_running_loop()
         self.actor_instance = await loop.run_in_executor(
             self._exec, lambda: cls(*args, **kwargs)
@@ -218,10 +231,13 @@ class WorkerServer:
         """Per-caller submission ordering, enforced by sequence number.
 
         Calls are ADMITTED in `seq` order (buffered while earlier seqs are
-        in flight over a reconnecting transport), then sync methods enter a
-        single executor thread in admission order — which gives per-caller
-        execution order even when a retry races fresh calls on a new TCP
-        connection.  Retries of a task that already ran (or is running) are
+        in flight over a reconnecting transport).  Default sync actors
+        then enter a single executor thread in admission order — which
+        gives per-caller execution order even when a retry races fresh
+        calls on a new TCP connection.  Threaded sync actors
+        (max_concurrency > 1) keep only admission order: executions run
+        on a thread pool and may overlap/complete out of order, the same
+        relaxation the reference documents for threaded actors.  Retries of a task that already ran (or is running) are
         deduplicated by task_id and answered from the reply cache instead of
         re-executing — exactly-once against an alive actor (reference:
         ActorSchedulingQueue sequence numbers + duplicate suppression).
@@ -352,8 +368,9 @@ class WorkerServer:
                         finally:
                             self._running_tasks.pop(tid, None)
             else:
+                pool = self._actor_thread_pool or self._exec
                 reply = await asyncio.get_running_loop().run_in_executor(
-                    self._exec, self._execute_sync_method, method, spec
+                    pool, self._execute_sync_method, method, spec
                 )
         except BaseException as e:
             reply = self._error_reply(

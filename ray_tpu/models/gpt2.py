@@ -251,52 +251,6 @@ def forward(params: Params, tokens, config: GPTConfig):
     return constrain(logits, ("batch", "seq", "vocab"))
 
 
-def _chunked_xent(params: Params, inputs, targets, mask, config: GPTConfig):
-    """Cross-entropy with the lm_head applied per sequence chunk under
-    jax.checkpoint: each chunk's (B, C, V) logits are recomputed in the
-    backward pass instead of living through the whole step.  Numerically
-    identical to the dense path (same lse − target_logit formulation)."""
-    c = config
-    B, S = inputs.shape
-    C = c.xent_chunk
-    nc = S // C
-    x = features(params, inputs, config)  # (B, S, E) — kept; it's small
-    wte = params["wte"].astype(c.dtype)
-    xs = x.reshape(B, nc, C, -1).transpose(1, 0, 2, 3)  # (nc, B, C, E)
-    ts = targets.reshape(B, nc, C).transpose(1, 0, 2)
-    ms = (
-        mask.reshape(B, nc, C).transpose(1, 0, 2).astype(jnp.float32)
-        if mask is not None
-        else None
-    )
-
-    @jax.checkpoint
-    def chunk_ll(xc, tc):
-        logits = jnp.einsum(
-            "bce,ve->bcv", xc, wte, preferred_element_type=jnp.float32
-        )
-        logits = constrain(logits, ("batch", None, "vocab"))
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tl = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
-        return tl - lse  # (B, C)
-
-    def body(carry, xtm):
-        ll_sum, m_sum = carry
-        if ms is None:
-            xc, tc = xtm
-            ll = chunk_ll(xc, tc)
-            return (ll_sum + ll.sum(), m_sum + ll.size), None
-        xc, tc, mc = xtm
-        ll = chunk_ll(xc, tc)
-        return (ll_sum + (ll * mc).sum(), m_sum + mc.sum()), None
-
-    xtm = (xs, ts) if ms is None else (xs, ts, ms)
-    (ll_sum, m_sum), _ = lax.scan(
-        body, (jnp.float32(0.0), jnp.float32(0.0)), xtm
-    )
-    return -ll_sum / jnp.maximum(m_sum, 1.0)
-
-
 def loss_fn(params: Params, batch, config: GPTConfig):
     """Next-token cross-entropy.  batch: {"tokens": (B, S+1) int32} or
     {"inputs", "targets"} each (B, S).  With config.xent_chunk set (and
@@ -308,8 +262,12 @@ def loss_fn(params: Params, batch, config: GPTConfig):
     else:
         inputs, targets = batch["inputs"], batch["targets"]
     if config.xent_chunk and inputs.shape[1] % config.xent_chunk == 0:
-        return _chunked_xent(
-            params, inputs, targets, batch.get("mask"), config
+        from ray_tpu.models.xent import chunked_xent
+
+        x = features(params, inputs, config)
+        return chunked_xent(
+            x, params["wte"], targets, batch.get("mask"),
+            config.xent_chunk, config.dtype,
         )
     logits = forward(params, inputs, config)
     # lse − target_logit instead of log_softmax + gather: avoids writing a

@@ -111,3 +111,95 @@ def params_from_hf(model, *, pad_vocab_to: int = 128,
         "lnf_bias": j(sd["transformer.ln_f.bias"]),
     }
     return params, config
+
+
+# ---------------------------------------------------------------------------
+# Llama family (models/llama.py layout)
+# ---------------------------------------------------------------------------
+
+
+def llama_config_from_hf(hf_config, **overrides):
+    """Map a transformers LlamaConfig onto LlamaConfig."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig
+
+    kwargs: Dict[str, Any] = dict(
+        vocab_size=hf_config.vocab_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        embed_dim=hf_config.hidden_size,
+        mlp_dim=hf_config.intermediate_size,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rms_eps=hf_config.rms_norm_eps,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        dtype=jnp.bfloat16,
+    )
+    kwargs.update(overrides)
+    return LlamaConfig(**kwargs)
+
+
+def llama_params_from_hf(model, **config_overrides):
+    """(params, config) from a transformers LlamaForCausalLM instance.
+
+    HF Linear weights are (out, in); our einsum kernels are (in, ...) so
+    every projection transposes, and q/k/o reshape their flat head dim
+    into (heads, head_dim).  HF checkpoints already use the rotate-half
+    RoPE convention this model implements, so no head permutation is
+    needed.
+    """
+    import jax.numpy as jnp
+
+    config = llama_config_from_hf(model.config, **config_overrides)
+    sd = {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
+    L, E, H, KV, D = (
+        config.num_layers, config.embed_dim, config.num_heads,
+        config.num_kv_heads, config.head_dim,
+    )
+    dt = config.param_dtype
+
+    def stacked(fmt: str) -> np.ndarray:
+        return np.stack([sd[fmt.format(i=i)] for i in range(L)], axis=0)
+
+    j = lambda a: jnp.asarray(a, dt)  # noqa: E731
+    wq = stacked("model.layers.{i}.self_attn.q_proj.weight")  # (L, H*D, E)
+    wk = stacked("model.layers.{i}.self_attn.k_proj.weight")
+    wv = stacked("model.layers.{i}.self_attn.v_proj.weight")
+    wo = stacked("model.layers.{i}.self_attn.o_proj.weight")  # (L, E, H*D)
+    params = {
+        "tok_embed": j(sd["model.embed_tokens.weight"]),
+        "blocks": {
+            "attn_norm": j(
+                stacked("model.layers.{i}.input_layernorm.weight")
+            ),
+            "wq": j(wq.transpose(0, 2, 1).reshape(L, E, H, D)),
+            "wk": j(wk.transpose(0, 2, 1).reshape(L, E, KV, D)),
+            "wv": j(wv.transpose(0, 2, 1).reshape(L, E, KV, D)),
+            "wo": j(wo.transpose(0, 2, 1).reshape(L, H, D, E)),
+            "mlp_norm": j(
+                stacked("model.layers.{i}.post_attention_layernorm.weight")
+            ),
+            "w_gate": j(
+                stacked("model.layers.{i}.mlp.gate_proj.weight")
+                .transpose(0, 2, 1)
+            ),
+            "w_up": j(
+                stacked("model.layers.{i}.mlp.up_proj.weight")
+                .transpose(0, 2, 1)
+            ),
+            "w_down": j(
+                stacked("model.layers.{i}.mlp.down_proj.weight")
+                .transpose(0, 2, 1)
+            ),
+        },
+        "final_norm": j(sd["model.norm.weight"]),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = j(sd["lm_head.weight"])
+    return params, config

@@ -1,0 +1,315 @@
+"""Llama-family decoder: RMSNorm + RoPE + GQA + SwiGLU, TPU-first.
+
+Second LM family beside GPT-2 (models/gpt2.py), matching the serving
+workload the reference's release tests target (ray:
+release/serve_tests Llama configs; doc/source/serve LLM examples).
+Same design language as gpt2.py: stacked-layer params (one pytree leaf
+per parameter kind, lax.scan-friendly), logical-axis sharding
+annotations compiled by pjit (parallel/sharding.py rule table), bf16
+matmuls with f32 layernorms/softmax, optional ring attention for
+sequence parallelism, and a chunked cross-entropy for HBM-sized logits.
+
+Grouped-query attention: num_kv_heads < num_heads shares each KV head
+across num_heads // num_kv_heads query heads (Llama-2-70B/Llama-3
+layout; num_kv_heads == num_heads gives classic MHA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    embed_dim: int = 4096
+    mlp_dim: int = 11008
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "dense"  # "dense" | "ring"
+    remat: bool = True
+    xent_chunk: int = 0
+    scan_unroll: int = 1
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        defaults = dict(
+            vocab_size=256, max_seq_len=128, num_layers=2, num_heads=4,
+            num_kv_heads=2, embed_dim=64, mlp_dim=160,
+            dtype=jnp.float32, remat=False,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+
+def param_logical_axes(config: LlamaConfig) -> Dict[str, Any]:
+    """Per-parameter logical axis names (parallel/sharding.py specs)."""
+    blk = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", None),
+        "wk": ("layers", "embed", "kv", None),
+        "wv": ("layers", "embed", "kv", None),
+        "wo": ("layers", "heads", None, "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    out = {
+        "tok_embed": ("vocab", "embed"),
+        "blocks": blk,
+        "final_norm": ("embed",),
+    }
+    if not config.tie_embeddings:
+        out["lm_head"] = ("vocab", "embed")
+    return out
+
+
+def init(rng, config: LlamaConfig) -> Params:
+    c = config
+    dt = c.param_dtype
+    L, E, H, KV, D, M = (
+        c.num_layers, c.embed_dim, c.num_heads, c.num_kv_heads,
+        c.head_dim, c.mlp_dim,
+    )
+    k = jax.random.split(rng, 8)
+    std = 0.02
+    resid_std = std / math.sqrt(2 * L)
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dt)
+
+    params: Params = {
+        "tok_embed": norm(k[0], (c.vocab_size, E), std),
+        "blocks": {
+            "attn_norm": jnp.ones((L, E), dt),
+            "wq": norm(k[1], (L, E, H, D), std),
+            "wk": norm(k[2], (L, E, KV, D), std),
+            "wv": norm(k[3], (L, E, KV, D), std),
+            "wo": norm(k[4], (L, H, D, E), resid_std),
+            "mlp_norm": jnp.ones((L, E), dt),
+            "w_gate": norm(k[5], (L, E, M), std),
+            "w_up": norm(k[6], (L, E, M), std),
+            "w_down": norm(k[7], (L, M, E), resid_std),
+        },
+        "final_norm": jnp.ones((E,), dt),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = norm(
+            jax.random.fold_in(k[0], 1), (c.vocab_size, E), std
+        )
+    return params
+
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    y = x32 * lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding over the last dim.  x: (B, S, H, D)."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = jnp.exp(
+        -math.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]  # (B, S, 1, half)
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+def _attention(q, k, v, config: LlamaConfig):
+    if config.attention_impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v)
+    from ray_tpu.ops.attention import dense_attention
+
+    return dense_attention(q, k, v)
+
+
+def _block(x, p, positions, config: LlamaConfig):
+    c = config
+    h = _rmsnorm(x, p["attn_norm"], c.rms_eps)
+    q = jnp.einsum("bse,ehd->bshd", h, p["wq"].astype(c.dtype))
+    kk = jnp.einsum("bse,ekd->bskd", h, p["wk"].astype(c.dtype))
+    vv = jnp.einsum("bse,ekd->bskd", h, p["wv"].astype(c.dtype))
+    q = _rope(q, positions, c.rope_theta)
+    kk = _rope(kk, positions, c.rope_theta)
+    # GQA: repeat each KV head across its query group
+    if c.q_per_kv > 1:
+        kk = jnp.repeat(kk, c.q_per_kv, axis=2)
+        vv = jnp.repeat(vv, c.q_per_kv, axis=2)
+    q = constrain(q, ("batch", "seq", "heads", None))
+    kk = constrain(kk, ("batch", "seq", "heads", None))
+    vv = constrain(vv, ("batch", "seq", "heads", None))
+    attn = _attention(q, kk, vv, c)
+    x = x + jnp.einsum("bshd,hde->bse", attn, p["wo"].astype(c.dtype))
+    x = constrain(x, ("batch", "seq", "embed"))
+    h = _rmsnorm(x, p["mlp_norm"], c.rms_eps)
+    gate = jnp.einsum("bse,em->bsm", h, p["w_gate"].astype(c.dtype))
+    up = jnp.einsum("bse,em->bsm", h, p["w_up"].astype(c.dtype))
+    h = jax.nn.silu(gate) * up
+    h = constrain(h, ("batch", "seq", "mlp"))
+    x = x + jnp.einsum("bsm,me->bse", h, p["w_down"].astype(c.dtype))
+    return constrain(x, ("batch", "seq", "embed"))
+
+
+def features(params: Params, tokens, config: LlamaConfig):
+    """tokens (B, S) int32 → final-RMSNorm features (B, S, E)."""
+    c = config
+    B, S = tokens.shape
+    emb = constrain(params["tok_embed"], (None, None)).astype(c.dtype)
+    x = emb[tokens]
+    x = constrain(x, ("batch", "seq", "embed"))
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+
+    def body(carry, layer_params):
+        fn = _block
+        if c.remat:
+            fn = jax.checkpoint(_block, static_argnums=(3,))
+        return fn(carry, layer_params, positions, c), None
+
+    x, _ = lax.scan(
+        body, x, params["blocks"], unroll=max(1, c.scan_unroll)
+    )
+    return _rmsnorm(x, params["final_norm"], c.rms_eps)
+
+
+def _head_weight(params: Params, config: LlamaConfig):
+    return params["tok_embed"] if config.tie_embeddings else params["lm_head"]
+
+
+def forward(params: Params, tokens, config: LlamaConfig):
+    """tokens (B, S) int32 → logits (B, S, vocab) f32."""
+    x = features(params, tokens, config)
+    logits = jnp.einsum(
+        "bse,ve->bsv",
+        x,
+        _head_weight(params, config).astype(config.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def loss_fn(params: Params, batch, config: LlamaConfig):
+    """Next-token cross-entropy; same contract as gpt2.loss_fn
+    (tokens | inputs/targets, optional mask, optional chunked head)."""
+    if "tokens" in batch:
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    mask = batch.get("mask")
+    c = config
+    if c.xent_chunk and inputs.shape[1] % c.xent_chunk == 0:
+        from ray_tpu.models.xent import chunked_xent
+
+        x = features(params, inputs, config)
+        return chunked_xent(
+            x, _head_weight(params, c), targets, mask, c.xent_chunk,
+            c.dtype,
+        )
+    logits = forward(params, inputs, config)
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tl = jnp.take_along_axis(
+        logits.astype(jnp.float32), targets[..., None], axis=-1
+    )[..., 0]
+    ll = tl - lse
+    if mask is None:
+        return -ll.mean()
+    mask = mask.astype(jnp.float32)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def num_params(config: LlamaConfig) -> int:
+    shapes = jax.eval_shape(partial(init, config=config), jax.random.key(0))
+    return sum(math.prod(a.shape) for a in jax.tree.leaves(shapes))
+
+
+def flops_per_token(config: LlamaConfig, seq_len: Optional[int] = None) -> float:
+    """fwd+bwd FLOPs per token: 6N + attention quadratic term."""
+    c = config
+    S = seq_len or c.max_seq_len
+    n = num_params(c) - c.vocab_size * c.embed_dim * (
+        0 if c.tie_embeddings else 1
+    )
+    attn = 12 * c.num_layers * c.embed_dim * S  # 2*2*3 * L * E * S
+    return 6.0 * n + attn
+
+
+def generate(params: Params, prompt, config: LlamaConfig, *,
+             max_new_tokens: int = 32, temperature: float = 0.0,
+             rng=None):
+    """Greedy/sampled decode (B, S) → (B, S + max_new_tokens).
+
+    The context is padded once to the fixed bucket S + max_new_tokens
+    and the step function takes the current length as a traced index —
+    ONE compiled executable serves every decode step (no per-token
+    recompile).  Each step still recomputes the full context (O(S²)
+    total; the KV-cache incremental decode is the planned serving fast
+    path, see ops/attention.py dense_attention(start_pos=...)).
+    temperature 0 is argmax; otherwise categorical sampling."""
+    tokens = jnp.asarray(prompt, jnp.int32)
+    B, S0 = tokens.shape
+    total = S0 + max_new_tokens
+    padded = jnp.zeros((B, total), jnp.int32).at[:, :S0].set(tokens)
+    sample = bool(temperature and temperature > 0.0)
+
+    @partial(jax.jit, static_argnames=())
+    def step_fn(params, padded, length, key):
+        logits = forward(params, padded, config)  # (B, total, V)
+        # causal attention: position length-1 only sees real tokens, so
+        # the padding beyond it cannot leak into this readout
+        last = jnp.take_along_axis(
+            logits, (length - 1)[None, None, None].repeat(B, 0), axis=1
+        )[:, 0, :]
+        if sample:
+            nxt = jax.random.categorical(key, last / temperature)
+        else:
+            nxt = jnp.argmax(last, axis=-1)
+        return lax.dynamic_update_slice(
+            padded, nxt[:, None].astype(jnp.int32), (0, length)
+        )
+
+    key = rng if rng is not None else jax.random.key(0)
+    for i in range(max_new_tokens):
+        key, sub = jax.random.split(key)
+        padded = step_fn(params, padded, jnp.int32(S0 + i), sub)
+    return padded

@@ -1,0 +1,62 @@
+"""Shared chunked cross-entropy for LM heads.
+
+One implementation of the numerically-sensitive chunked head+softmax
+(used by models/gpt2.py and models/llama.py): the lm_head einsum and
+logsumexp run per sequence chunk under jax.checkpoint, so each chunk's
+(B, C, V) f32 logits are recomputed in the backward pass instead of
+living through the whole step — peak logits memory drops from
+O(B·S·V) to O(B·chunk·V).  Same lse − target_logit formulation as the
+dense paths; loss and grads agree to bf16 rounding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.sharding import constrain
+
+
+def chunked_xent(x, head_weight, targets, mask, chunk: int, dtype):
+    """Mean negative log-likelihood with a chunked head.
+
+    x: (B, S, E) features; head_weight: (V, E); targets: (B, S) int32;
+    mask: optional (B, S); chunk must divide S.
+    """
+    B, S = targets.shape
+    nc = S // chunk
+    w = head_weight.astype(dtype)
+    xs = x.reshape(B, nc, chunk, -1).transpose(1, 0, 2, 3)  # (nc,B,C,E)
+    ts = targets.reshape(B, nc, chunk).transpose(1, 0, 2)
+    ms = (
+        mask.reshape(B, nc, chunk).transpose(1, 0, 2).astype(jnp.float32)
+        if mask is not None
+        else None
+    )
+
+    @jax.checkpoint
+    def chunk_ll(xc, tc):
+        logits = jnp.einsum(
+            "bce,ve->bcv", xc, w, preferred_element_type=jnp.float32
+        )
+        logits = constrain(logits, ("batch", None, "vocab"))
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return tl - lse  # (B, C)
+
+    def body(carry, xtm):
+        ll_sum, m_sum = carry
+        if ms is None:
+            xc, tc = xtm
+            ll = chunk_ll(xc, tc)
+            return (ll_sum + ll.sum(), m_sum + ll.size), None
+        xc, tc, mc = xtm
+        ll = chunk_ll(xc, tc)
+        return (ll_sum + (ll * mc).sum(), m_sum + mc.sum()), None
+
+    xtm = (xs, ts) if ms is None else (xs, ts, ms)
+    (ll_sum, m_sum), _ = lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), xtm
+    )
+    return -ll_sum / jnp.maximum(m_sum, 1.0)

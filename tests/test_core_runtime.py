@@ -479,3 +479,40 @@ class TestActorOrderingExactlyOnce:
 
         asyncio.run_coroutine_threadsafe(push_reversed(), rt._loop).result(120)
         assert ray_tpu.get(a.read.remote(), timeout=60) == [0, 1, 2]
+
+
+class TestThreadedActors:
+    def test_sync_methods_overlap_with_max_concurrency(self, cluster):
+        """max_concurrency>1 on a sync actor runs methods on a thread
+        pool (reference: threaded actors) — N sleeps overlap instead of
+        serializing."""
+        import time as _time
+
+        @ray_tpu.remote
+        class Sleeper:
+            def nap(self, s):
+                _time.sleep(s)
+                return s
+
+        a = Sleeper.options(max_concurrency=4).remote()
+        ray_tpu.get(a.nap.remote(0), timeout=60)  # actor warm
+        t0 = _time.monotonic()
+        refs = [a.nap.remote(0.5) for _ in range(4)]
+        assert ray_tpu.get(refs, timeout=60) == [0.5] * 4
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 1.6, elapsed  # serialized would be >= 2.0
+
+    def test_default_stays_serialized(self, cluster):
+        import time as _time
+
+        @ray_tpu.remote
+        class Sleeper2:
+            def nap(self, s):
+                _time.sleep(s)
+                return s
+
+        a = Sleeper2.remote()
+        ray_tpu.get(a.nap.remote(0), timeout=60)
+        t0 = _time.monotonic()
+        ray_tpu.get([a.nap.remote(0.3) for _ in range(3)], timeout=60)
+        assert _time.monotonic() - t0 >= 0.85
