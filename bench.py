@@ -308,6 +308,39 @@ def _bench_gpt2_guarded(timeout_s: float = 1500.0):
     import subprocess
     import sys
 
+    # preflight: a degraded axon tunnel can HANG jax init for tens of
+    # minutes; probe device availability in a short-lived subprocess and
+    # drop to the CPU smoke path immediately if the backend is wedged
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM', jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=180,
+        )
+        backend_ok = "PLATFORM" in probe.stdout
+    except subprocess.TimeoutExpired:
+        backend_ok = False
+    if not backend_ok:
+        code = (
+            "import os; os.environ['JAX_PLATFORMS'] = 'cpu'; "
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import bench, json; "
+            "print('@@' + json.dumps(bench.bench_gpt2(scan_unroll=1)))"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=900, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("@@"):
+                r = json.loads(line[2:])
+                r["backend_unavailable"] = True
+                return r
+        raise RuntimeError(
+            f"TPU backend wedged and CPU fallback failed: "
+            f"{out.stderr[-500:]}"
+        )
+
     last_err = None
     # first attempt: bench_gpt2's own default (full unroll); fallback:
     # rolled scan on a fraction of the remaining budget

@@ -314,6 +314,7 @@ _READONLY_RPCS = frozenset({
     "get_autoscaler_state", "list_tasks", "list_objects",
     "metrics_push", "get_metrics", "get_job_info", "get_job_logs",
     "list_jobs", "list_events", "report_event", "get_worker_death_info",
+    "cluster_store_stats",
 })
 
 
@@ -1406,6 +1407,26 @@ class GcsServer:
         })
         while len(self._events) > 2000:
             self._events.pop(0)
+
+    async def rpc_cluster_store_stats(self, conn, p):
+        """Per-node shm store stats fanned out to live raylets (ray:
+        `ray memory` / memory_summary role)."""
+        alive = [
+            n for n in self.nodes.values()
+            if n.alive and n.conn is not None
+        ]
+
+        async def one(node):
+            try:
+                return node.node_id.hex(), await asyncio.wait_for(
+                    node.conn.call("store_stats", {}), timeout=10.0
+                )
+            except Exception as e:  # noqa: BLE001 — report per-node
+                return node.node_id.hex(), {"error": repr(e)}
+
+        # concurrent fan-out: one hung raylet costs 10s total, not 10s
+        # per node
+        return dict(await asyncio.gather(*(one(n) for n in alive)))
 
     async def rpc_report_event(self, conn, p):
         self.record_cluster_event(

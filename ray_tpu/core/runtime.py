@@ -101,6 +101,7 @@ class ActorClientState:
     conn: Any = None
     wake: Any = None  # asyncio.Event
     pump_running: bool = False
+    dead: bool = False  # actor creation failed / actor died — pump exits
 
 
 class SchedClassState:
@@ -136,6 +137,14 @@ class Runtime:
         self.actor_id: Optional[ActorID] = None  # set when this worker hosts one
 
         self._loop = asyncio.new_event_loop()
+        # eager tasks (3.12+): create_task runs the coroutine synchronously
+        # up to its first await, removing one loop wakeup from every
+        # dispatch hop (submit→push, reply fan-out) — worth ~10% on the
+        # actor-call round-trip
+        try:
+            self._loop.set_task_factory(asyncio.eager_task_factory)
+        except AttributeError:
+            pass
         self._thread = threading.Thread(
             target=self._loop.run_forever, name="rt-io", daemon=True
         )
@@ -331,6 +340,13 @@ class Runtime:
             t = getattr(self, "_metrics_task", None)
             if t is not None:
                 t.cancel()
+            # resident actor pumps park on their wake events; release
+            # them cleanly instead of tearing the loop down under them
+            for st in self._actor_states.values():
+                st.dead = True
+                if st.wake is not None:
+                    st.wake.set()
+            await asyncio.sleep(0)
             for c in list(self._worker_conns.values()):
                 await c.close()
             for c in list(self._actor_conns.values()):
@@ -670,6 +686,21 @@ class Runtime:
                     ("kwval", k, self._serialization.serialize(v).to_bytes())
                 )
         return packed
+
+    def unpack_args_sync(self, packed) -> Optional[Tuple[list, dict]]:
+        """Ref-free fast path: pure deserialization, no loop round-trip.
+        Returns None when any arg is an ObjectRef (caller must await
+        unpack_args on the io loop instead) — the hot actor-call path
+        has inline args and skips two thread handoffs per call."""
+        if any(item[0] in ("ref", "kwref") for item in packed):
+            return None
+        args, kwargs = [], {}
+        for item in packed:
+            if item[0] == "val":
+                args.append(self._serialization.deserialize(item[1]))
+            else:
+                kwargs[item[1]] = self._serialization.deserialize(item[2])
+        return args, kwargs
 
     async def unpack_args(self, packed) -> Tuple[list, dict]:
         args, kwargs = [], {}
@@ -1216,6 +1247,29 @@ class Runtime:
             st = self._actor_states[aid] = ActorClientState(
                 queue=deque(), wake=asyncio.Event()
             )
+        # Fast path (the hot loop for steady traffic): connection is
+        # live and nothing is queued ahead — assign the wire seq inline
+        # and push directly, skipping the pump wake hop.  Safe because
+        # this runs on the io loop (serial with the pump's drain, which
+        # never awaits mid-drain), so submission order == wire order is
+        # preserved; the task lands in st.inflight like any other, so
+        # the pump's reconnect replay still covers it.
+        if (
+            st.pump_running
+            and not st.dead
+            and st.conn is not None
+            and not st.conn.closed
+            and not st.queue
+        ):
+            if not self._consume_cancel_flag(task):
+                task.spec["seq"] = st.wire_seq
+                task.spec["seq_epoch"] = st.epoch
+                st.wire_seq += 1
+                st.inflight[task.sub_idx] = task
+                self._loop.create_task(
+                    self._push_actor_call(aid, st, st.conn, task)
+                )
+            return
         st.queue.append(task)
         st.wake.set()
         if not st.pump_running:
@@ -1256,6 +1310,7 @@ class Runtime:
                         for t in list(st.queue):
                             self._fail_task(t, e)
                         st.queue.clear()
+                        st.dead = True
                         break
                     st.epoch += 1
                     st.wire_seq = 0
@@ -1275,11 +1330,16 @@ class Runtime:
                     # woken by new submissions, a connection break, or the
                     # last in-flight reply landing (so the pump can exit)
                     await st.wake.wait()
-            # idle: exit unless a submission raced the loop exit (no await
-            # between the check and the flag flip — atomic on the io loop)
-            if not st.queue:
+            if st.dead:
                 st.pump_running = False
                 return
+            # idle: stay RESIDENT, parked on the wake event — one task
+            # per live actor.  Exiting here made every serial caller pay
+            # a pump restart per call; staying parked lets the enqueue
+            # fast path skip the pump entirely for steady traffic.
+            st.wake.clear()
+            if not st.queue:  # re-check: enqueue may have raced the clear
+                await st.wake.wait()
 
     async def _push_actor_call(
         self, aid: bytes, st: ActorClientState, conn, task: PendingTask

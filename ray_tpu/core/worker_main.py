@@ -396,7 +396,10 @@ class WorkerServer:
             "start_time": time.time(),
         }
         try:
-            args, kwargs = self.rt._run(self.rt.unpack_args(spec["args"]))
+            unpacked = self.rt.unpack_args_sync(spec["args"])
+            if unpacked is None:  # ObjectRef args: resolve on the io loop
+                unpacked = self.rt._run(self.rt.unpack_args(spec["args"]))
+            args, kwargs = unpacked
             result = method(*args, **kwargs)
             return self._exec_pack(spec, result)
         except TaskCancelledError as e:

@@ -418,6 +418,39 @@ class Dataset:
         if carry is not None and carry.num_rows and not drop_last:
             yield _from_block(carry, batch_format)
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        drop_last: bool = False,
+        dtypes: Optional[Dict[str, Any]] = None,
+        device: Optional[str] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (ray: Dataset.iter_torch_batches).
+
+        CPU-torch interop path (torch-TPU is not a thing here; jax owns
+        the accelerator — use iter_jax_batches for device ingest)."""
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            drop_last=drop_last,
+        ):
+            out = {}
+            for k, v in batch.items():
+                v = np.ascontiguousarray(v)
+                if not v.flags.writeable:
+                    # pyarrow's zero-copy to_numpy is read-only; torch
+                    # mutation of such memory is undefined behavior
+                    v = v.copy()
+                t = torch.from_numpy(v)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def iter_jax_batches(
         self,
         *,

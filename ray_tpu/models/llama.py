@@ -290,26 +290,180 @@ def generate(params: Params, prompt, config: LlamaConfig, *,
     B, S0 = tokens.shape
     total = S0 + max_new_tokens
     padded = jnp.zeros((B, total), jnp.int32).at[:, :S0].set(tokens)
-    sample = bool(temperature and temperature > 0.0)
-
-    @partial(jax.jit, static_argnames=())
-    def step_fn(params, padded, length, key):
-        logits = forward(params, padded, config)  # (B, total, V)
-        # causal attention: position length-1 only sees real tokens, so
-        # the padding beyond it cannot leak into this readout
-        last = jnp.take_along_axis(
-            logits, (length - 1)[None, None, None].repeat(B, 0), axis=1
-        )[:, 0, :]
-        if sample:
-            nxt = jax.random.categorical(key, last / temperature)
-        else:
-            nxt = jnp.argmax(last, axis=-1)
-        return lax.dynamic_update_slice(
-            padded, nxt[:, None].astype(jnp.int32), (0, length)
-        )
-
     key = rng if rng is not None else jax.random.key(0)
     for i in range(max_new_tokens):
         key, sub = jax.random.split(key)
-        padded = step_fn(params, padded, jnp.int32(S0 + i), sub)
+        padded = _gen_step(params, padded, jnp.int32(S0 + i), sub,
+                           config=config, temperature=float(temperature))
     return padded
+
+
+@partial(jax.jit, static_argnames=("config", "temperature"))
+def _gen_step(params, padded, length, key, *, config, temperature):
+    """One full-recompute decode step — MODULE-LEVEL jit, so its cache
+    is keyed by (config, shapes), not per-call closures: repeat
+    generate() calls reuse one executable."""
+    logits = forward(params, padded, config)  # (B, total, V)
+    B = padded.shape[0]
+    # causal attention: position length-1 only sees real tokens, so the
+    # padding beyond it cannot leak into this readout
+    last = jnp.take_along_axis(
+        logits, (length - 1)[None, None, None].repeat(B, 0), axis=1
+    )[:, 0, :]
+    if temperature > 0.0:
+        nxt = jax.random.categorical(key, last / temperature)
+    else:
+        nxt = jnp.argmax(last, axis=-1)
+    return lax.dynamic_update_slice(
+        padded, nxt[:, None].astype(jnp.int32), (0, length)
+    )
+
+
+# ---------------------------------------------------------------------------
+# KV-cache incremental decoding (the serving fast path)
+# ---------------------------------------------------------------------------
+
+
+def init_cache(config: LlamaConfig, batch_size: int, max_len: int) -> Params:
+    """Fixed-bucket KV cache: (L, B, max_len, KV, D) per tensor, bf16.
+    Static shapes — one compiled prefill + one compiled decode step
+    serve any request up to max_len."""
+    c = config
+    shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+    }
+
+
+def _cached_attention(q, k_cache, v_cache, pos, config: LlamaConfig):
+    """q: (B, Sq, H, D) attends over cache[:, :T]; positions > pos are
+    masked.  Works for prefill (Sq = prompt len, pos = len-1) and decode
+    (Sq = 1)."""
+    c = config
+    B, Sq, H, D = q.shape
+    T = k_cache.shape[1]
+    if c.q_per_kv > 1:
+        k_cache = jnp.repeat(k_cache, c.q_per_kv, axis=2)
+        v_cache = jnp.repeat(v_cache, c.q_per_kv, axis=2)
+    scores = jnp.einsum(
+        "bqhd,bthd->bhqt", q, k_cache, preferred_element_type=jnp.float32
+    ) / math.sqrt(D)
+    # causal within the query block + bounded by pos overall
+    q_pos = pos - (Sq - 1) + jnp.arange(Sq)  # absolute position per query
+    t_idx = jnp.arange(T)
+    mask = t_idx[None, :] <= q_pos[:, None]  # (Sq, T)
+    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+    return jnp.einsum("bhqt,bthd->bqhd", probs, v_cache)
+
+
+def _block_cached(x, p, cache_k, cache_v, start, config: LlamaConfig):
+    """One block over Sq new tokens starting at absolute `start`;
+    returns (x_out, new_cache_k, new_cache_v)."""
+    c = config
+    B, Sq, _ = x.shape
+    h = _rmsnorm(x, p["attn_norm"], c.rms_eps)
+    positions = (start + jnp.arange(Sq))[None, :].repeat(B, 0)
+    q = _rope(
+        jnp.einsum("bse,ehd->bshd", h, p["wq"].astype(c.dtype)),
+        positions, c.rope_theta,
+    )
+    kk = _rope(
+        jnp.einsum("bse,ekd->bskd", h, p["wk"].astype(c.dtype)),
+        positions, c.rope_theta,
+    )
+    vv = jnp.einsum("bse,ekd->bskd", h, p["wv"].astype(c.dtype))
+    cache_k = lax.dynamic_update_slice(
+        cache_k, kk.astype(c.dtype), (0, start, 0, 0)
+    )
+    cache_v = lax.dynamic_update_slice(
+        cache_v, vv.astype(c.dtype), (0, start, 0, 0)
+    )
+    attn = _cached_attention(q, cache_k, cache_v, start + Sq - 1, c)
+    x = x + jnp.einsum("bshd,hde->bse", attn, p["wo"].astype(c.dtype))
+    h = _rmsnorm(x, p["mlp_norm"], c.rms_eps)
+    gate = jnp.einsum("bse,em->bsm", h, p["w_gate"].astype(c.dtype))
+    up = jnp.einsum("bse,em->bsm", h, p["w_up"].astype(c.dtype))
+    x = x + jnp.einsum(
+        "bsm,me->bse", jax.nn.silu(gate) * up, p["w_down"].astype(c.dtype)
+    )
+    return x, cache_k, cache_v
+
+
+def forward_cached(params: Params, tokens, cache: Params, start,
+                   config: LlamaConfig):
+    """Run Sq new tokens through all layers, updating the cache.
+
+    Returns (last_logits (B, V), new_cache).  `start` is the absolute
+    position of tokens[:, 0] (0 for prefill; prompt_len + i in decode) —
+    a traced scalar, so one compile covers every step."""
+    c = config
+    x = params["tok_embed"].astype(c.dtype)[tokens]
+
+    def body(carry, layer):
+        xx, _ = carry
+        p, ck, cv = layer
+        xx, ck, cv = _block_cached(xx, p, ck, cv, start, c)
+        return (xx, None), (ck, cv)
+
+    (x, _), (new_k, new_v) = lax.scan(
+        body, (x, None), (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _rmsnorm(x, params["final_norm"], c.rms_eps)
+    logits = jnp.einsum(
+        "be,ve->bv",
+        x[:, -1, :],
+        _head_weight(params, c).astype(c.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate_kv(params: Params, prompt, config: LlamaConfig, *,
+                max_new_tokens: int = 32, temperature: float = 0.0,
+                rng=None):
+    """KV-cache decode: prefill once, then one O(1)-per-token compiled
+    step — the serving fast path (vs generate()'s full recompute)."""
+    tokens = jnp.asarray(prompt, jnp.int32)
+    B, S0 = tokens.shape
+    total = S0 + max_new_tokens
+    cache = init_cache(config, B, total)
+    temperature = float(temperature)
+
+    logits, cache = _prefill_jit(params, tokens, cache, jnp.int32(0),
+                                 config=config)
+    key = rng if rng is not None else jax.random.key(0)
+    key, sub = jax.random.split(key)
+    nxt = _pick_token(logits, sub, temperature=temperature, config=config)
+    out = [tokens, nxt[:, None]]
+    for i in range(1, max_new_tokens):
+        key, sub = jax.random.split(key)
+        nxt, cache = _decode_step(
+            params, nxt[:, None], cache, jnp.int32(S0 + i - 1), sub,
+            config=config, temperature=temperature,
+        )
+        out.append(nxt[:, None])
+    return jnp.concatenate(out, axis=1)
+
+
+# module-level jits: caches keyed by (config, shapes, temperature) so
+# repeated generate_kv calls — e.g. per serve request — reuse ONE
+# compiled prefill and ONE compiled decode step
+_prefill_jit = jax.jit(forward_cached, static_argnames="config")
+
+
+@partial(jax.jit, static_argnames=("config", "temperature"))
+def _pick_token(logits, key, *, config, temperature):
+    if temperature > 0.0:
+        return jax.random.categorical(key, logits / temperature).astype(
+            jnp.int32
+        )
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("config", "temperature"))
+def _decode_step(params, tok, cache, start, key, *, config, temperature):
+    logits, cache = forward_cached(params, tok, cache, start, config)
+    return _pick_token(logits, key, config=config,
+                       temperature=temperature), cache

@@ -152,6 +152,13 @@ def cmd_metrics(args) -> None:
     print(json.dumps(state.get_metrics(), indent=2))
 
 
+def cmd_memory(args) -> None:
+    from ray_tpu.util import state
+
+    _connect(args)
+    print(json.dumps(state.memory_summary(), indent=2, default=str))
+
+
 def cmd_events(args) -> None:
     from ray_tpu.util import events
 
@@ -191,6 +198,10 @@ def main(argv=None) -> None:
     p = sub.add_parser("status", help="cluster summary")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("memory", help="per-node object store usage")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("events", help="structured cluster events")
     p.add_argument("--severity", default=None)

@@ -120,6 +120,11 @@ def summarize() -> Dict[str, Any]:
     }
 
 
+def memory_summary() -> Dict[str, Any]:
+    """Per-node object-store usage (ray: `ray memory` / memory_summary)."""
+    return _call("cluster_store_stats")
+
+
 # single implementation lives in util.events; re-exported here so the
 # state API surface is complete (ray: list_cluster_events)
 from ray_tpu.util.events import list_events  # noqa: E402,F401

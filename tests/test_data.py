@@ -300,3 +300,18 @@ class TestDatasourcePlugin:
         )
         ds = rtd.read_datasource(src)
         assert ds.count() == 12
+
+
+def test_iter_torch_batches(cluster):
+    """torch-tensor batch iteration (ray: iter_torch_batches; CPU torch
+    interop — jax owns the accelerator)."""
+    import torch
+
+    ds = rd.range(100)
+    batches = list(ds.iter_torch_batches(batch_size=32))
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    total = sum(len(b["id"]) for b in batches)
+    assert total == 100
+    typed = next(iter(ds.iter_torch_batches(
+        batch_size=10, dtypes={"id": torch.float32})))
+    assert typed["id"].dtype == torch.float32

@@ -156,3 +156,18 @@ class TestCli:
         assert out.returncode == 0, out.stderr
         rows = json.loads(out.stdout)
         assert rows and rows[0]["alive"]
+
+
+class TestMemorySummary:
+    def test_memory_summary_reports_stores(self, cluster):
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.util import state
+
+        ref = ray_tpu.put(np.ones(512 * 1024, np.uint8))
+        summary = state.memory_summary()
+        assert summary, "no nodes reported"
+        for node_id, st in summary.items():
+            assert "error" not in st, st
+        del ref  # refcounting frees the shm allocation
