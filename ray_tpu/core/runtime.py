@@ -1333,13 +1333,19 @@ class Runtime:
             if st.dead:
                 st.pump_running = False
                 return
-            # idle: stay RESIDENT, parked on the wake event — one task
-            # per live actor.  Exiting here made every serial caller pay
-            # a pump restart per call; staying parked lets the enqueue
-            # fast path skip the pump entirely for steady traffic.
+            # idle: stay RESIDENT, parked on the wake event — exiting
+            # here made every serial caller pay a pump restart per call.
+            # Park with a timeout so pumps of killed/idle actors retire
+            # instead of leaking a task per dead actor forever (nothing
+            # wakes an idle pump when its actor is killed).
             st.wake.clear()
             if not st.queue:  # re-check: enqueue may have raced the clear
-                await st.wake.wait()
+                try:
+                    await asyncio.wait_for(st.wake.wait(), timeout=60.0)
+                except asyncio.TimeoutError:
+                    if not st.queue:
+                        st.pump_running = False
+                        return
 
     async def _push_actor_call(
         self, aid: bytes, st: ActorClientState, conn, task: PendingTask

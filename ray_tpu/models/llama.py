@@ -288,13 +288,16 @@ def generate(params: Params, prompt, config: LlamaConfig, *,
     temperature 0 is argmax; otherwise categorical sampling."""
     tokens = jnp.asarray(prompt, jnp.int32)
     B, S0 = tokens.shape
+    if max_new_tokens <= 0:
+        return tokens
     total = S0 + max_new_tokens
     padded = jnp.zeros((B, total), jnp.int32).at[:, :S0].set(tokens)
+    temperature = float(temperature or 0.0)  # None == greedy
     key = rng if rng is not None else jax.random.key(0)
     for i in range(max_new_tokens):
         key, sub = jax.random.split(key)
         padded = _gen_step(params, padded, jnp.int32(S0 + i), sub,
-                           config=config, temperature=float(temperature))
+                           config=config, temperature=temperature)
     return padded
 
 
@@ -310,10 +313,7 @@ def _gen_step(params, padded, length, key, *, config, temperature):
     last = jnp.take_along_axis(
         logits, (length - 1)[None, None, None].repeat(B, 0), axis=1
     )[:, 0, :]
-    if temperature > 0.0:
-        nxt = jax.random.categorical(key, last / temperature)
-    else:
-        nxt = jnp.argmax(last, axis=-1)
+    nxt = _pick_token(last, key, temperature=temperature)
     return lax.dynamic_update_slice(
         padded, nxt[:, None].astype(jnp.int32), (0, length)
     )
@@ -427,15 +427,17 @@ def generate_kv(params: Params, prompt, config: LlamaConfig, *,
     step — the serving fast path (vs generate()'s full recompute)."""
     tokens = jnp.asarray(prompt, jnp.int32)
     B, S0 = tokens.shape
+    if max_new_tokens <= 0:
+        return tokens
     total = S0 + max_new_tokens
     cache = init_cache(config, B, total)
-    temperature = float(temperature)
+    temperature = float(temperature or 0.0)  # None == greedy
 
     logits, cache = _prefill_jit(params, tokens, cache, jnp.int32(0),
                                  config=config)
     key = rng if rng is not None else jax.random.key(0)
     key, sub = jax.random.split(key)
-    nxt = _pick_token(logits, sub, temperature=temperature, config=config)
+    nxt = _pick_token(logits, sub, temperature=temperature)
     out = [tokens, nxt[:, None]]
     for i in range(1, max_new_tokens):
         key, sub = jax.random.split(key)
@@ -449,12 +451,17 @@ def generate_kv(params: Params, prompt, config: LlamaConfig, *,
 
 # module-level jits: caches keyed by (config, shapes, temperature) so
 # repeated generate_kv calls — e.g. per serve request — reuse ONE
-# compiled prefill and ONE compiled decode step
-_prefill_jit = jax.jit(forward_cached, static_argnames="config")
+# compiled prefill and ONE compiled decode step.  The cache buffers are
+# DONATED: the (L, B, max_len, KV, D) k/v arrays update in place instead
+# of being copied every token (the copy would dominate decode bandwidth
+# on a real config).
+_prefill_jit = jax.jit(
+    forward_cached, static_argnames="config", donate_argnames=("cache",)
+)
 
 
-@partial(jax.jit, static_argnames=("config", "temperature"))
-def _pick_token(logits, key, *, config, temperature):
+@partial(jax.jit, static_argnames=("temperature",))
+def _pick_token(logits, key, *, temperature):
     if temperature > 0.0:
         return jax.random.categorical(key, logits / temperature).astype(
             jnp.int32
@@ -462,8 +469,11 @@ def _pick_token(logits, key, *, config, temperature):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("config", "temperature"))
+@partial(
+    jax.jit,
+    static_argnames=("config", "temperature"),
+    donate_argnames=("cache",),
+)
 def _decode_step(params, tok, cache, start, key, *, config, temperature):
     logits, cache = forward_cached(params, tok, cache, start, config)
-    return _pick_token(logits, key, config=config,
-                       temperature=temperature), cache
+    return _pick_token(logits, key, temperature=temperature), cache

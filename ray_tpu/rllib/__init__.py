@@ -11,6 +11,12 @@ from ray_tpu.rllib.core import MLPModuleConfig  # noqa: F401
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, ReplayBuffer  # noqa: F401
 from ray_tpu.rllib.env_runner import EnvRunnerGroup  # noqa: F401
 from ray_tpu.rllib.learner_group import Learner, LearnerGroup  # noqa: F401
+from ray_tpu.rllib.impala import (  # noqa: F401
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearner,
+    vtrace,
+)
 from ray_tpu.rllib.offline import (  # noqa: F401
     BC,
     BCConfig,

@@ -1,0 +1,226 @@
+"""IMPALA: asynchronous actor-learner with V-trace off-policy correction.
+
+Role-equivalent of ray: rllib/algorithms/impala/ (IMPALAConfig, IMPALA,
+vtrace) on this stack's shapes: EnvRunner actors sample continuously
+and NEVER gang-block the learner — the algorithm keeps one in-flight
+sample per runner, updates on whichever fragment lands first (V-trace
+correcting for the policy lag), syncs fresh weights to that runner
+only, and immediately relaunches it.  The update is one jit'd function
+(V-trace targets + policy gradient + value + entropy loss), so on a
+mesh the gradient reduction compiles to ICI collectives like PPO's.
+
+V-trace (Espeholt et al. 2018, arXiv:1802.01561): with behavior logp μ
+(recorded by the runner at sample time) and target logp π (current
+learner policy), truncated importance weights ρ=min(ρ̄, π/μ),
+c=min(c̄, π/μ) give corrected value targets
+
+    v_s = V_s + δ_s + γ c_s (v_{s+1} − V_{s+1}),
+    δ_s = ρ_s (r_s + γ V_{s+1} − V_s)
+
+computed as a reverse lax.scan over the fragment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib import core
+from ray_tpu.rllib.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    build_module_config,
+    probe_env_spaces,
+)
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner_group import Learner
+
+
+@dataclasses.dataclass
+class IMPALAConfig(AlgorithmConfig):
+    lr: float = 5e-4
+    gamma: float = 0.99
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 40.0
+    hidden: tuple = (64, 64)
+    # how many fragments to consume per training_step call
+    updates_per_iteration: int = 4
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, dones, last_values,
+           gamma: float, rho_clip: float, c_clip: float):
+    """V-trace targets + pg advantages over a (T, B) fragment (jax).
+
+    Returns (vs (T, B), pg_adv (T, B)) — both stop-gradient-safe (pure
+    functions of inputs; callers stop-grad as needed)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_clip)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_clip)
+    nonterminal = 1.0 - dones
+    # V_{s+1}: shift values down; bootstrap last_values at the fragment end
+    values_next = jnp.concatenate(
+        [values[1:], last_values[None, :]], axis=0
+    )
+    delta = rho * (rewards + gamma * values_next * nonterminal - values)
+
+    def backward(carry, xs):
+        acc = carry  # v_{s+1} − V_{s+1}
+        d, cs, nt = xs
+        acc = d + gamma * cs * nt * acc
+        return acc, acc
+
+    _, vs_minus_v = lax.scan(
+        backward,
+        jnp.zeros_like(last_values),
+        (delta, c, nonterminal),
+        reverse=True,
+    )
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate([vs[1:], last_values[None, :]], axis=0)
+    pg_adv = rho * (rewards + gamma * vs_next * nonterminal - values)
+    return vs, pg_adv
+
+
+class IMPALALearner(Learner):
+    def __init__(self, config: IMPALAConfig, module_config):
+        import jax
+        import optax
+
+        self.config = config
+        self.module_config = module_config
+        self._fwd = core.get_forward(module_config)
+        self.params = core.module_init(
+            jax.random.key(config.seed), module_config
+        )
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self._init_jit()
+
+    def _loss(self, params, batch):
+        """batch: obs (T,B,F), actions (T,B), logp (T,B, behavior),
+        rewards, dones (T,B), last_obs (B,F)."""
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        T, B = batch["actions"].shape
+        obs_flat = batch["obs"].reshape(T * B, -1)
+        logits, values = self._fwd(params, obs_flat)
+        logits = logits.reshape(T, B, -1)
+        values = values.reshape(T, B)
+        _, last_values = self._fwd(params, batch["last_obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        tgt_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        vs, pg_adv = vtrace(
+            batch["logp"], jax.lax.stop_gradient(tgt_logp),
+            batch["rewards"], jax.lax.stop_gradient(values),
+            batch["dones"], jax.lax.stop_gradient(last_values),
+            c.gamma, c.vtrace_rho_clip, c.vtrace_c_clip,
+        )
+        pg = -(tgt_logp * jax.lax.stop_gradient(pg_adv)).mean()
+        vf = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg + c.vf_coeff * vf - c.entropy_coeff * entropy
+        return total, {
+            "policy_loss": pg,
+            "vf_loss": vf,
+            "entropy": entropy,
+            "mean_rho": jnp.exp(
+                jax.lax.stop_gradient(tgt_logp) - batch["logp"]
+            ).mean(),
+        }
+
+
+class IMPALA(Algorithm):
+    """Async decoupled actor-learner (ray: impala.py training_step's
+    aggregated async sampling, minus the GPU aggregation actors the
+    single-learner case doesn't need)."""
+
+    def _setup(self, config: IMPALAConfig):
+        import ray_tpu
+
+        spaces = probe_env_spaces(config.env, config.env_to_module)
+        self.module_config = build_module_config(config, spaces)
+        self.learner = IMPALALearner(config, self.module_config)
+        self.env_runner_group = EnvRunnerGroup(
+            config.env,
+            self.module_config,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed,
+            env_to_module_fn=config.env_to_module,
+        )
+        self.env_runner_group.sync_weights(self.learner.params)
+        # one standing sample per runner — the async pipeline
+        self._inflight = {
+            r.sample.remote(config.rollout_fragment_length): r
+            for r in self.env_runner_group.runners
+        }
+        self._ray = ray_tpu
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        stats_acc: Dict[str, float] = {}
+        t0 = time.monotonic()
+        consumed = 0
+        while consumed < c.updates_per_iteration:
+            ready, _ = self._ray.wait(
+                list(self._inflight), num_returns=1, timeout=300.0
+            )
+            if not ready:
+                raise TimeoutError("no IMPALA fragment arrived in 300s")
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            frag = self._ray.get(ref)
+            self._record_returns(frag["episode_returns"])
+            T, B = frag["actions"].shape
+            batch = {
+                "obs": frag["obs"].astype(np.float32),
+                "actions": frag["actions"],
+                "logp": frag["logp"],
+                "rewards": frag["rewards"],
+                "dones": frag["dones"],
+                "last_obs": frag["final_obs"].reshape(B, -1),
+            }
+            stats = self.learner.update(batch)
+            for k, v in stats.items():
+                stats_acc[k] = float(v)
+            consumed += 1
+            self._total_steps += T * B
+            # fresh weights to THIS runner only; relaunch immediately —
+            # other runners keep sampling under their slightly-stale
+            # policies (that lag is exactly what V-trace corrects)
+            runner.set_weights.remote(self._ray.put(self.learner.params))
+            self._inflight[
+                runner.sample.remote(c.rollout_fragment_length)
+            ] = runner
+        stats_acc["fragments_consumed"] = consumed
+        stats_acc["iter_time_s"] = time.monotonic() - t0
+        return stats_acc
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.learner.params}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.learner.params = state["params"]
+        self.env_runner_group.sync_weights(self.learner.params)
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        self.env_runner_group.stop()
+
+
+IMPALAConfig.algo_class = IMPALA
