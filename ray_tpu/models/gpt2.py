@@ -54,6 +54,14 @@ class GPTConfig:
     # ms/step (MFU 0.358 → 0.442) on v5e at B=8, S=1024.  Rolled stays
     # the default for compile-time and for remat-heavy configs.
     scan_unroll: int = 1
+    # Mixture-of-Experts: num_experts > 0 replaces every block's dense
+    # MLP with a top-1 (switch) MoE — experts shard over the mesh's ep
+    # axis ("expert" logical axis), token dispatch/combine compile to
+    # all_to_all over ICI.  GShard-style dense one-hot dispatch with a
+    # per-expert capacity; overflow tokens pass through the residual.
+    num_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01  # Switch load-balancing aux loss weight
 
     @property
     def head_dim(self) -> int:
@@ -91,11 +99,20 @@ def param_logical_axes(config: GPTConfig) -> Params:
         "proj_bias": ("layers", "embed"),
         "ln2_scale": ("layers", "embed"),
         "ln2_bias": ("layers", "embed"),
-        "fc_kernel": ("layers", "embed", "mlp"),
-        "fc_bias": ("layers", "mlp"),
-        "out_kernel": ("layers", "mlp", "embed"),
-        "out_bias": ("layers", "embed"),
     }
+    if config.num_experts > 0:
+        blk.update({
+            "router": ("layers", "embed", "expert"),
+            "moe_in": ("layers", "expert", "embed", "mlp"),
+            "moe_out": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        blk.update({
+            "fc_kernel": ("layers", "embed", "mlp"),
+            "fc_bias": ("layers", "mlp"),
+            "out_kernel": ("layers", "mlp", "embed"),
+            "out_bias": ("layers", "embed"),
+        })
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
@@ -127,11 +144,21 @@ def init(rng, config: GPTConfig) -> Params:
         "proj_bias": jnp.zeros((L, E), dt),
         "ln2_scale": jnp.ones((L, E), dt),
         "ln2_bias": jnp.zeros((L, E), dt),
-        "fc_kernel": norm(k[2], (L, E, M), std),
-        "fc_bias": jnp.zeros((L, M), dt),
-        "out_kernel": norm(k[3], (L, M, E), resid_std),
-        "out_bias": jnp.zeros((L, E), dt),
     }
+    if c.num_experts > 0:
+        X = c.num_experts
+        blocks.update({
+            "router": norm(k[6], (L, E, X), std),
+            "moe_in": norm(k[2], (L, X, E, M), std),
+            "moe_out": norm(k[3], (L, X, M, E), resid_std),
+        })
+    else:
+        blocks.update({
+            "fc_kernel": norm(k[2], (L, E, M), std),
+            "fc_bias": jnp.zeros((L, M), dt),
+            "out_kernel": norm(k[3], (L, M, E), resid_std),
+            "out_bias": jnp.zeros((L, E), dt),
+        })
     return {
         "wte": norm(k[4], (c.vocab_size, E), std),
         "wpe": norm(k[5], (c.max_seq_len, E), 0.01),
@@ -159,8 +186,71 @@ def _attention(q, k, v, config: GPTConfig):
     return _dense_attention(q, k, v)
 
 
-def _block(x, p, config: GPTConfig):
-    """One transformer block. x: (B, S, E); p: per-layer param slice."""
+def _moe_mlp(h, p, config: GPTConfig, mask=None):
+    """Top-1 (switch) MoE MLP.  h (B, S, E) post-norm → (delta, aux).
+
+    GShard-style dense dispatch: tokens route to their argmax expert via
+    a one-hot (N, X, C) tensor; the expert FFN runs with expert-sharded
+    weights (ep axis), so under pjit the dispatch/combine einsums
+    compile to all_to_all over ICI.  Tokens past an expert's capacity
+    C = ceil(cap_factor · N / X) are dropped (pass through the
+    residual), the standard switch behavior.  aux is the Switch
+    load-balancing loss X·Σ f_i·P_i (1.0 at perfect balance).
+
+    `mask` (B, S) zeroes padding tokens out of routing entirely: they
+    consume no expert capacity and the aux statistics count only real
+    tokens."""
+    c = config
+    B, S, E = h.shape
+    X = c.num_experts
+    N = B * S
+    C = max(1, math.ceil(c.moe_capacity_factor * N / X))
+    ht = h.reshape(N, E)
+    router_logits = jnp.einsum(
+        "ne,ex->nx", ht.astype(jnp.float32),
+        p["router"].astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # (N, X) f32
+    gate = probs.max(axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert, X, dtype=jnp.float32)
+    if mask is not None:
+        onehot = onehot * mask.reshape(N, 1).astype(jnp.float32)
+    # position of each token within its expert's capacity buffer
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    disp = jnp.where((pos >= 0) & (pos < C), onehot, 0.0)
+    pos_idx = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
+    disp_nxc = disp[..., None] * jax.nn.one_hot(pos_idx, C,
+                                                dtype=jnp.float32)
+    expert_in = jnp.einsum(
+        "nxc,ne->xce", disp_nxc, ht.astype(jnp.float32)
+    ).astype(c.dtype)
+    expert_in = constrain(expert_in, ("expert", None, "embed"))
+    hmid = jax.nn.gelu(jnp.einsum(
+        "xce,xem->xcm", expert_in, p["moe_in"].astype(c.dtype)
+    ))
+    hmid = constrain(hmid, ("expert", None, "mlp"))
+    expert_out = jnp.einsum(
+        "xcm,xme->xce", hmid, p["moe_out"].astype(c.dtype)
+    )
+    expert_out = constrain(expert_out, ("expert", None, "embed"))
+    combine = (disp_nxc * gate[:, None, None]).astype(c.dtype)
+    out = jnp.einsum("nxc,xce->ne", combine, expert_out)
+    if mask is None:
+        f = onehot.mean(axis=0)
+        P = probs.mean(axis=0)
+    else:
+        m = mask.reshape(N, 1).astype(jnp.float32)
+        denom = jnp.maximum(m.sum(), 1.0)
+        f = onehot.sum(axis=0) / denom
+        P = (probs * m).sum(axis=0) / denom
+    aux = (X * jnp.sum(f * P)).astype(jnp.float32)
+    return out.reshape(B, S, E), aux
+
+
+def _block(x, p, config: GPTConfig, mask=None):
+    """One transformer block. x: (B, S, E); p: per-layer param slice.
+    Returns (x, moe_aux) — aux is 0.0 for dense-MLP blocks."""
     c = config
     S = x.shape[1]
     h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
@@ -197,17 +287,23 @@ def _block(x, p, config: GPTConfig):
         ) + p["proj_bias"].astype(c.dtype)
     x = constrain(x, ("batch", "seq", "embed"))
     h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
-    h = jnp.einsum("bse,em->bsm", h, p["fc_kernel"].astype(c.dtype))
-    h = jax.nn.gelu(h + p["fc_bias"].astype(c.dtype))
-    h = constrain(h, ("batch", "seq", "mlp"))
-    x = x + jnp.einsum(
-        "bsm,me->bse", h, p["out_kernel"].astype(c.dtype)
-    ) + p["out_bias"].astype(c.dtype)
-    return constrain(x, ("batch", "seq", "embed"))
+    if "moe_in" in p:
+        delta, aux = _moe_mlp(h, p, c, mask)
+        x = x + delta
+    else:
+        h = jnp.einsum("bse,em->bsm", h, p["fc_kernel"].astype(c.dtype))
+        h = jax.nn.gelu(h + p["fc_bias"].astype(c.dtype))
+        h = constrain(h, ("batch", "seq", "mlp"))
+        x = x + jnp.einsum(
+            "bsm,me->bse", h, p["out_kernel"].astype(c.dtype)
+        ) + p["out_bias"].astype(c.dtype)
+        aux = jnp.float32(0.0)
+    return constrain(x, ("batch", "seq", "embed")), aux
 
 
-def features(params: Params, tokens, config: GPTConfig):
-    """tokens (B, S) int32 → final-layernorm features (B, S, E).
+def _features_aux(params: Params, tokens, config: GPTConfig, mask=None):
+    """tokens (B, S) int32 → (final-layernorm features (B, S, E),
+    summed MoE aux loss).
 
     The pre-head backbone, split out so the chunked cross-entropy can
     apply the lm_head per sequence chunk instead of materializing the
@@ -228,20 +324,27 @@ def features(params: Params, tokens, config: GPTConfig):
     x = constrain(x, ("batch", "seq", "embed"))
 
     def body(carry, layer_params):
+        xx, aux_sum = carry
         fn = _block
         if c.remat:
             fn = jax.checkpoint(_block, static_argnums=(2,))
-        return fn(carry, layer_params, c), None
+        xx, aux = fn(xx, layer_params, c, mask)
+        return (xx, aux_sum + aux), None
 
-    x, _ = lax.scan(
-        body, x, params["blocks"], unroll=max(1, c.scan_unroll)
+    (x, aux), _ = lax.scan(
+        body, (x, jnp.float32(0.0)), params["blocks"],
+        unroll=max(1, c.scan_unroll),
     )
-    return _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+    return _layernorm(x, params["lnf_scale"], params["lnf_bias"]), aux
 
 
-def forward(params: Params, tokens, config: GPTConfig):
-    """tokens (B, S) int32 → logits (B, S, vocab) in f32."""
-    x = features(params, tokens, config)
+def features(params: Params, tokens, config: GPTConfig):
+    """tokens (B, S) int32 → final-layernorm features (B, S, E)."""
+    return _features_aux(params, tokens, config)[0]
+
+
+def _head(params: Params, x, config: GPTConfig):
+    """Tied lm_head: features (B, S, E) → logits (B, S, V) f32."""
     logits = jnp.einsum(
         "bse,ve->bsv",
         x,
@@ -249,6 +352,11 @@ def forward(params: Params, tokens, config: GPTConfig):
         preferred_element_type=jnp.float32,
     )
     return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def forward(params: Params, tokens, config: GPTConfig):
+    """tokens (B, S) int32 → logits (B, S, vocab) in f32."""
+    return _head(params, features(params, tokens, config), config)
 
 
 def loss_fn(params: Params, batch, config: GPTConfig):
@@ -261,15 +369,18 @@ def loss_fn(params: Params, batch, config: GPTConfig):
         targets = batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
+    x, aux = _features_aux(params, inputs, config, batch.get("mask"))
+    aux_term = (
+        config.moe_aux_coeff * aux if config.num_experts > 0 else 0.0
+    )
     if config.xent_chunk and inputs.shape[1] % config.xent_chunk == 0:
         from ray_tpu.models.xent import chunked_xent
 
-        x = features(params, inputs, config)
         return chunked_xent(
             x, params["wte"], targets, batch.get("mask"),
             config.xent_chunk, config.dtype,
-        )
-    logits = forward(params, inputs, config)
+        ) + aux_term
+    logits = _head(params, x, config)
     # lse − target_logit instead of log_softmax + gather: avoids writing a
     # second full (B, S, V) f32 array (1.6 GB at B=8, S=1024, V=50k).
     lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
@@ -279,9 +390,9 @@ def loss_fn(params: Params, batch, config: GPTConfig):
     ll = tl - lse
     mask = batch.get("mask")
     if mask is None:
-        return -ll.mean()
+        return -ll.mean() + aux_term
     mask = mask.astype(jnp.float32)
-    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0) + aux_term
 
 
 def num_params(config: GPTConfig) -> int:

@@ -12,11 +12,13 @@ Axis convention (outer → inner, matching physical locality on a pod):
   dp    data parallelism (pure replication of params, gradient psum)
   fsdp  fully-sharded data parallelism (params sharded, all-gathered
         per layer; gradients reduce-scattered)
+  ep    expert parallelism (MoE experts sharded; token dispatch is an
+        all_to_all over this axis)
   sp    sequence/context parallelism (ring attention neighbors — must
         map to an ICI ring)
   tp    tensor/model parallelism (innermost: highest-bandwidth axis)
 
-Any axis may have size 1; the mesh is always constructed with all four
+Any axis may have size 1; the mesh is always constructed with all five
 named axes so sharding rules never need to special-case missing axes.
 """
 
@@ -32,13 +34,14 @@ from jax.sharding import Mesh
 
 DP_AXIS = "dp"
 FSDP_AXIS = "fsdp"
+EP_AXIS = "ep"
 SP_AXIS = "sp"
 TP_AXIS = "tp"
 
 #: Mesh axes ordered outer→inner. dp/fsdp vary slowest (their collectives
 #: tolerate the most latency: once-per-step gradient reductions), tp varies
 #: fastest (per-layer all-gathers/reduce-scatters want nearest neighbors).
-AXIS_ORDER = (DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS)
+AXIS_ORDER = (DP_AXIS, FSDP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS)
 
 #: Axes over which a gradient psum runs for data parallelism.
 DATA_AXES = (DP_AXIS, FSDP_AXIS)
@@ -55,11 +58,13 @@ class MeshConfig:
 
     dp: int = -1
     fsdp: int = 1
+    ep: int = 1
     sp: int = 1
     tp: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "ep": self.ep,
+                 "sp": self.sp, "tp": self.tp}
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one mesh axis may be -1, got {wild}")
@@ -78,7 +83,7 @@ class MeshConfig:
 
     @property
     def shape(self) -> tuple:
-        return (self.dp, self.fsdp, self.sp, self.tp)
+        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
     def describe(self) -> str:
         return "x".join(
@@ -111,7 +116,7 @@ def make_mesh(
     *,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build the 4-axis mesh over ``devices`` (default: all local devices).
+    """Build the 5-axis mesh over ``devices`` (default: all local devices).
 
     Uses `jax.experimental.mesh_utils` device ordering when available so
     the innermost axes land on physically adjacent chips (ICI neighbors);

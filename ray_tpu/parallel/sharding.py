@@ -23,7 +23,13 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ray_tpu.parallel.mesh import DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS
+from ray_tpu.parallel.mesh import (
+    DP_AXIS,
+    EP_AXIS,
+    FSDP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+)
 
 LogicalSpec = Tuple[Optional[str], ...]
 Rules = Tuple[Tuple[str, Union[str, Tuple[str, ...], None]], ...]
@@ -42,7 +48,7 @@ DEFAULT_RULES: Rules = (
     ("mlp", TP_AXIS),
     ("vocab", TP_AXIS),
     ("layers", None),
-    ("expert", None),
+    ("expert", EP_AXIS),
 )
 
 

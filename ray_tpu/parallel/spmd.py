@@ -18,7 +18,12 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ray_tpu.parallel.mesh import DATA_AXES, SP_AXIS
-from ray_tpu.parallel.sharding import DEFAULT_RULES, Rules, tree_shardings
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    Rules,
+    logical_to_spec,
+    tree_shardings,
+)
 
 
 class TrainState(NamedTuple):
@@ -135,6 +140,31 @@ def sharded_init(
     exists unsharded anywhere.
     """
     optimizer = optimizer or optax.identity()
+    # pre-check divisibility so a mismatch (e.g. num_experts=6 on ep=4)
+    # surfaces as a clear error naming the param and axis, not a GSPMD
+    # partitioning failure deep inside jit
+    shapes = jax.eval_shape(init_fn, rng)
+    flat_shapes, _ = jax.tree_util.tree_flatten_with_path(shapes)
+    flat_logical = jax.tree.leaves(
+        param_logical, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    for (path, leaf), logical in zip(flat_shapes, flat_logical):
+        spec = logical_to_spec(logical, rules)
+        for dim, axis in zip(leaf.shape, spec):
+            if axis is None:
+                continue
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            n = 1
+            for a in axes:
+                n *= mesh.shape[a]
+            if n > 1 and dim % n:
+                name = jax.tree_util.keystr(path)
+                raise ValueError(
+                    f"param {name} dim of size {dim} (logical axes "
+                    f"{logical}) is not divisible by mesh axis "
+                    f"{axis} of size {n}; adjust the model config or "
+                    "the mesh shape"
+                )
     out_shardings = state_shardings(
         mesh, init_fn, rng, param_logical, optimizer, rules
     )

@@ -29,9 +29,12 @@ def _clear_mesh():
 
 
 def test_mesh_config_resolve():
-    assert MeshConfig(dp=-1).resolve(8).shape == (8, 1, 1, 1)
-    assert MeshConfig(dp=-1, tp=2).resolve(8).shape == (4, 1, 1, 2)
-    assert MeshConfig(dp=2, fsdp=2, sp=1, tp=2).resolve(8).shape == (2, 2, 1, 2)
+    assert MeshConfig(dp=-1).resolve(8).shape == (8, 1, 1, 1, 1)
+    assert MeshConfig(dp=-1, tp=2).resolve(8).shape == (4, 1, 1, 1, 2)
+    assert MeshConfig(dp=2, fsdp=2, sp=1, tp=2).resolve(8).shape == (
+        2, 2, 1, 1, 2
+    )
+    assert MeshConfig(dp=2, ep=2, tp=2).resolve(8).shape == (2, 1, 2, 1, 2)
     with pytest.raises(ValueError):
         MeshConfig(dp=3).resolve(8)
     with pytest.raises(ValueError):
@@ -40,7 +43,7 @@ def test_mesh_config_resolve():
 
 def test_make_mesh_axes():
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-    assert mesh.axis_names == ("dp", "fsdp", "sp", "tp")
+    assert mesh.axis_names == ("dp", "fsdp", "ep", "sp", "tp")
     assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
 
 
@@ -257,3 +260,74 @@ def test_scan_unroll_matches_rolled():
     a = gpt2.forward(params, toks, cfg)
     b = gpt2.forward(params, toks, cfg_unroll)
     assert float(jnp.abs(a - b).max()) < 1e-5
+
+
+def test_moe_expert_parallel_train_step():
+    """MoE GPT-2 over a mesh with a real ep axis: experts shard over ep
+    ("expert" logical axis), dispatch/combine compile to collectives,
+    and the sharded loss decreases."""
+    mesh = make_mesh(MeshConfig(dp=2, ep=2, tp=2))
+    cfg = gpt2.GPTConfig.tiny(num_experts=4)
+    opt = optax.adamw(1e-2)
+    state = spmd.sharded_init(
+        mesh,
+        lambda r: gpt2.init(r, cfg),
+        jax.random.key(0),
+        gpt2.param_logical_axes(cfg),
+        opt,
+    )
+    # experts sharded over ep, embed over fsdp(=1 here), mlp over tp
+    assert state.params["blocks"]["moe_in"].sharding.spec == P(
+        None, "ep", "fsdp", "tp"
+    )
+    step = spmd.compile_train_step(
+        lambda p, b: gpt2.loss_fn(p, b, cfg), opt
+    )
+    tokens = jax.random.randint(jax.random.key(1), (8, 33), 0, cfg.vocab_size)
+    batch = spmd.shard_batch(mesh, {"tokens": tokens})
+    with jax.set_mesh(mesh):
+        losses = []
+        for _ in range(10):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_moe_matches_token_choice_reference():
+    """Dense-dispatch MoE must equal a per-token loop over expert FFNs
+    when capacity is unbounded (no drops)."""
+    cfg = gpt2.GPTConfig.tiny(num_experts=4, moe_capacity_factor=100.0)
+    params = gpt2.init(jax.random.key(0), cfg)
+    h = jax.random.normal(jax.random.key(2), (1, 8, cfg.embed_dim))
+    p0 = jax.tree.map(lambda a: a[0], params["blocks"])  # layer 0 slice
+    out, aux = gpt2._moe_mlp(h, p0, cfg)
+    # reference: route each token independently
+    ht = h.reshape(-1, cfg.embed_dim)
+    logits = ht @ np.asarray(p0["router"], np.float32)
+    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    expected = np.zeros_like(np.asarray(ht))
+    for n in range(ht.shape[0]):
+        e = int(jnp.argmax(probs[n]))
+        gate = float(probs[n, e])
+        mid = jax.nn.gelu(ht[n] @ p0["moe_in"][e])
+        expected[n] = gate * np.asarray(mid @ p0["moe_out"][e])
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(-1, cfg.embed_dim), expected,
+        atol=2e-3, rtol=2e-3,
+    )
+    assert 0.9 < float(aux) < 4.0  # X * sum(f*P) near 1 when balanced
+
+
+def test_sharded_init_divisibility_error_names_param():
+    """num_experts not divisible by ep must fail with a clear message,
+    not a GSPMD internal error."""
+    mesh = make_mesh(MeshConfig(dp=2, ep=4))
+    cfg = gpt2.GPTConfig.tiny(num_experts=6)
+    with pytest.raises(ValueError, match="not divisible by mesh axis"):
+        spmd.sharded_init(
+            mesh,
+            lambda r: gpt2.init(r, cfg),
+            jax.random.key(0),
+            gpt2.param_logical_axes(cfg),
+            optax.adamw(1e-3),
+        )
