@@ -189,13 +189,17 @@ def _attention(q, k, v, config: GPTConfig):
 def _moe_mlp(h, p, config: GPTConfig, mask=None):
     """Top-1 (switch) MoE MLP.  h (B, S, E) post-norm → (delta, aux).
 
-    GShard-style dense dispatch: tokens route to their argmax expert via
-    a one-hot (N, X, C) tensor; the expert FFN runs with expert-sharded
-    weights (ep axis), so under pjit the dispatch/combine einsums
-    compile to all_to_all over ICI.  Tokens past an expert's capacity
-    C = ceil(cap_factor · N / X) are dropped (pass through the
-    residual), the standard switch behavior.  aux is the Switch
-    load-balancing loss X·Σ f_i·P_i (1.0 at perfect balance).
+    GShard-style dense dispatch, GROUPED BY BATCH ROW: each row routes
+    its S tokens independently with per-row expert capacity
+    C = ceil(cap_factor · S / X), so the one-hot dispatch tensor is
+    (B, S, X, C) — O(B·S²·cap/X·X) = O(B·S²·cap) memory instead of the
+    O((B·S)²) a globally-flattened dispatch costs, and the routing
+    cumsum runs along S (no serialization across the dp-sharded batch
+    axis).  Expert FFN weights shard over ep ("expert" logical axis);
+    under pjit the dispatch/combine einsums compile to all_to_all over
+    ICI.  Tokens past capacity pass through the residual (standard
+    switch behavior).  aux is the Switch load-balancing loss
+    X·Σ f_i·P_i (1.0 at perfect balance).
 
     `mask` (B, S) zeroes padding tokens out of routing entirely: they
     consume no expert capacity and the aux statistics count only real
@@ -203,49 +207,47 @@ def _moe_mlp(h, p, config: GPTConfig, mask=None):
     c = config
     B, S, E = h.shape
     X = c.num_experts
-    N = B * S
-    C = max(1, math.ceil(c.moe_capacity_factor * N / X))
-    ht = h.reshape(N, E)
+    C = max(1, math.ceil(c.moe_capacity_factor * S / X))
     router_logits = jnp.einsum(
-        "ne,ex->nx", ht.astype(jnp.float32),
+        "bse,ex->bsx", h.astype(jnp.float32),
         p["router"].astype(jnp.float32),
     )
-    probs = jax.nn.softmax(router_logits, axis=-1)  # (N, X) f32
+    probs = jax.nn.softmax(router_logits, axis=-1)  # (B, S, X) f32
     gate = probs.max(axis=-1)
     expert = jnp.argmax(probs, axis=-1)
-    onehot = jax.nn.one_hot(expert, X, dtype=jnp.float32)
+    onehot = jax.nn.one_hot(expert, X, dtype=jnp.float32)  # (B, S, X)
     if mask is not None:
-        onehot = onehot * mask.reshape(N, 1).astype(jnp.float32)
-    # position of each token within its expert's capacity buffer
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+        onehot = onehot * mask[..., None].astype(jnp.float32)
+    # position of each token within its row's expert capacity buffer
+    pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0
     disp = jnp.where((pos >= 0) & (pos < C), onehot, 0.0)
     pos_idx = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
-    disp_nxc = disp[..., None] * jax.nn.one_hot(pos_idx, C,
-                                                dtype=jnp.float32)
+    disp_bsxc = disp[..., None] * jax.nn.one_hot(pos_idx, C,
+                                                 dtype=jnp.float32)
     expert_in = jnp.einsum(
-        "nxc,ne->xce", disp_nxc, ht.astype(jnp.float32)
+        "bsxc,bse->bxce", disp_bsxc, h.astype(jnp.float32)
     ).astype(c.dtype)
-    expert_in = constrain(expert_in, ("expert", None, "embed"))
+    expert_in = constrain(expert_in, ("batch", "expert", None, "embed"))
     hmid = jax.nn.gelu(jnp.einsum(
-        "xce,xem->xcm", expert_in, p["moe_in"].astype(c.dtype)
+        "bxce,xem->bxcm", expert_in, p["moe_in"].astype(c.dtype)
     ))
-    hmid = constrain(hmid, ("expert", None, "mlp"))
+    hmid = constrain(hmid, ("batch", "expert", None, "mlp"))
     expert_out = jnp.einsum(
-        "xcm,xme->xce", hmid, p["moe_out"].astype(c.dtype)
+        "bxcm,xme->bxce", hmid, p["moe_out"].astype(c.dtype)
     )
-    expert_out = constrain(expert_out, ("expert", None, "embed"))
-    combine = (disp_nxc * gate[:, None, None]).astype(c.dtype)
-    out = jnp.einsum("nxc,xce->ne", combine, expert_out)
+    expert_out = constrain(expert_out, ("batch", "expert", None, "embed"))
+    combine = (disp_bsxc * gate[..., None, None]).astype(c.dtype)
+    out = jnp.einsum("bsxc,bxce->bse", combine, expert_out)
     if mask is None:
-        f = onehot.mean(axis=0)
-        P = probs.mean(axis=0)
+        f = onehot.mean(axis=(0, 1))
+        P = probs.mean(axis=(0, 1))
     else:
-        m = mask.reshape(N, 1).astype(jnp.float32)
+        m = mask[..., None].astype(jnp.float32)
         denom = jnp.maximum(m.sum(), 1.0)
-        f = onehot.sum(axis=0) / denom
-        P = (probs * m).sum(axis=0) / denom
+        f = onehot.sum(axis=(0, 1)) / denom
+        P = (probs * m).sum(axis=(0, 1)) / denom
     aux = (X * jnp.sum(f * P)).astype(jnp.float32)
-    return out.reshape(B, S, E), aux
+    return out, aux
 
 
 def _block(x, p, config: GPTConfig, mask=None):
@@ -412,4 +414,10 @@ def flops_per_token(config: GPTConfig, seq_len: Optional[int] = None) -> float:
     c = config
     s = seq_len or c.max_seq_len
     n = num_params(c) - c.max_seq_len * c.embed_dim  # minus wpe only
+    if c.num_experts > 1:
+        # top-1 routing executes ONE expert FFN per token: count 1/X of
+        # the expert-FFN params as active compute (else MoE MFU would be
+        # overstated ~X-fold)
+        moe_ffn = 2 * c.num_layers * c.num_experts * c.embed_dim * c.mlp_dim
+        n = n - moe_ffn + moe_ffn // c.num_experts
     return 6 * n + 12 * c.num_layers * c.embed_dim * s

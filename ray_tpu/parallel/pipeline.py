@@ -1,0 +1,166 @@
+"""Pipeline parallelism: GPipe-style microbatching inside one XLA program.
+
+The TPU-native form of pipeline execution (SURVEY §2.4 item 8's
+cross-host half): stages live on devices along a dedicated ``pp`` mesh
+axis, activations move stage-to-stage with `lax.ppermute` over ICI, and
+the whole schedule — including the backward pass, which jax derives
+through the ppermute — is ONE compiled program.  No per-stage actors,
+no host round-trips, no NCCL send/recv loops: the compiler overlaps the
+permute with compute where the schedule allows.
+
+Intra-host/actor pipelining over channels is the other half
+(ray_tpu/dag compiled DAGs); this module is the in-program path that
+scales across a slice.
+
+Model contract: a STAGE function `stage_fn(stage_params, x) -> x` where
+`stage_params` is one pytree slice of per-stage-stacked params
+(leading dim = n_stages, like the models' scan-stacked layers).  The
+classic GPipe loop runs n_micro + n_stages - 1 ticks; each device
+computes its stage when a microbatch is resident and forwards the
+activation to its pp-neighbor.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PP_AXIS = "pp"
+
+
+def make_pp_mesh(n_stages: int, devices=None) -> Mesh:
+    """A 1-axis pipeline mesh over `n_stages` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n_stages:
+        raise ValueError(
+            f"pipeline needs {n_stages} devices, have {len(devices)}"
+        )
+    return Mesh(np.array(devices[:n_stages]), (PP_AXIS,))
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    n_micro: int,
+):
+    """Run the pipelined forward inside shard_map over the pp axis.
+
+    stage_params: per-stage-stacked pytree with LOCAL slice (1, ...)
+    per device (shard_map has already split the leading dim).
+    x: (n_micro, mb, ...) microbatched input, resident on every stage
+    (only stage 0 reads it).  Returns (n_micro, mb, ...) outputs valid
+    on the LAST stage.
+    """
+    idx = lax.axis_index(PP_AXIS)
+    n_stages = lax.axis_size(PP_AXIS)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stage_params)[0]:
+        if leaf.shape[0] != 1:
+            raise ValueError(
+                f"stage param {jax.tree_util.keystr(path)} has "
+                f"{leaf.shape[0]} stages on one device — the stacked "
+                "leading dim must equal the pp mesh size (got a local "
+                f"slice of {leaf.shape[0]}; stages would be silently "
+                "dropped)"
+            )
+    local = jax.tree.map(lambda a: a[0], stage_params)
+    mb_shape = x.shape[1:]
+    n_ticks = n_micro + n_stages - 1
+
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        buf, outputs = carry  # buf: activation resident on this stage
+        # stage 0 ingests microbatch t (if one remains); others use buf
+        feed = jnp.where(
+            t < n_micro,
+            lax.dynamic_index_in_dim(x, jnp.minimum(t, n_micro - 1), 0,
+                                     keepdims=False),
+            jnp.zeros(mb_shape, x.dtype),
+        )
+        inp = jnp.where(idx == 0, feed, buf)
+        out = stage_fn(local, inp)
+        # last stage records its finished microbatch (micro index
+        # t - (n_stages - 1)); branchless select keeps SPMD happy
+        out_slot = t - (n_stages - 1)
+        do_write = (idx == n_stages - 1) & (out_slot >= 0)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, out, jnp.clip(out_slot, 0, n_micro - 1), 0
+        )
+        outputs = jnp.where(do_write, updated, outputs)
+        buf = lax.ppermute(out, PP_AXIS, fwd_perm)
+        return (buf, outputs), None
+
+    # the carry becomes device-varying over pp after the first tick;
+    # mark the (replicated) zeros as varying up front so scan's carry
+    # types line up under shard_map's vma typing
+    buf0 = lax.pcast(
+        jnp.zeros(mb_shape, x.dtype), (PP_AXIS,), to="varying"
+    )
+    outputs0 = lax.pcast(
+        jnp.zeros((n_micro,) + mb_shape, x.dtype), (PP_AXIS,), to="varying"
+    )
+    (_, outputs), _ = lax.scan(
+        tick, (buf0, outputs0), jnp.arange(n_ticks)
+    )
+    return outputs
+
+
+def pipeline_train_step(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_tail: Callable[[jax.Array, Any], jax.Array],
+    optimizer,
+    mesh: Mesh,
+    *,
+    n_micro: int,
+):
+    """Build `step(params, opt_state, x, y) -> (params, opt_state, loss)`
+    with the whole pipelined fwd+bwd+update as one jitted program.
+
+    params: per-stage-stacked pytree (n_stages, ...), sharded over pp on
+    the leading dim.  loss_tail(last_stage_outputs (n_micro, mb, ...),
+    y (n_micro, mb, ...)) -> scalar — evaluated on the last stage's
+    results (replicated by the psum below).
+    """
+    n_stages = mesh.shape[PP_AXIS]
+
+    def sharded_loss(params, x, y):
+        def inner(p, xx, yy):
+            outs = pipeline_apply(stage_fn, p, xx, n_micro=n_micro)
+            idx = lax.axis_index(PP_AXIS)
+            loss = loss_tail(outs, yy)
+            # only the last stage holds real outputs; psum broadcasts its
+            # loss (others contribute 0) so the value is well-defined
+            # everywhere and grads flow backward through the ppermutes
+            loss = jnp.where(idx == n_stages - 1, loss, 0.0)
+            return lax.psum(loss, PP_AXIS)
+
+        return jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(PP_AXIS), P(), P()),
+            out_specs=P(),
+        )(params, x, y)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(sharded_loss)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def stage_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-stage-stacked params (leading dim over pp)."""
+    return NamedSharding(mesh, P(PP_AXIS))

@@ -144,11 +144,11 @@ def sharded_init(
     # surfaces as a clear error naming the param and axis, not a GSPMD
     # partitioning failure deep inside jit
     shapes = jax.eval_shape(init_fn, rng)
-    flat_shapes, _ = jax.tree_util.tree_flatten_with_path(shapes)
-    flat_logical = jax.tree.leaves(
-        param_logical, is_leaf=lambda x: isinstance(x, tuple)
-    )
-    for (path, leaf), logical in zip(flat_shapes, flat_logical):
+
+    def check(path, leaf, logical):
+        # tree_map_with_path walks BOTH trees together, so a structure
+        # mismatch between init_fn's output and param_logical raises a
+        # tree error naming the spot instead of silently mispairing
         spec = logical_to_spec(logical, rules)
         for dim, axis in zip(leaf.shape, spec):
             if axis is None:
@@ -165,6 +165,9 @@ def sharded_init(
                     f"{axis} of size {n}; adjust the model config or "
                     "the mesh shape"
                 )
+        return leaf
+
+    jax.tree_util.tree_map_with_path(check, shapes, param_logical)
     out_shardings = state_shardings(
         mesh, init_fn, rng, param_logical, optimizer, rules
     )
