@@ -1,14 +1,16 @@
-"""SPMD parallelism over JAX device meshes (dp / fsdp / sp / tp).
+"""SPMD parallelism over JAX device meshes (dp/fsdp/ep/sp/tp + pp).
 
 See SURVEY.md §2.4: the reference delegates model sharding to external
 libraries; here it is native.  Mesh construction (`mesh`), logical-axis
-sharding rules (`sharding`), and ICI collective wrappers (`collectives`).
+sharding rules (`sharding`), ICI collective wrappers (`collectives`),
+and in-program GPipe pipeline parallelism (`pipeline`).
 """
 
 from ray_tpu.parallel.mesh import (  # noqa: F401
     AXIS_ORDER,
     DATA_AXES,
     DP_AXIS,
+    EP_AXIS,
     FSDP_AXIS,
     SP_AXIS,
     TP_AXIS,
@@ -23,3 +25,4 @@ from ray_tpu.parallel.sharding import (  # noqa: F401
     tree_shardings,
 )
 from ray_tpu.parallel import collectives  # noqa: F401
+from ray_tpu.parallel import pipeline  # noqa: F401

@@ -28,6 +28,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -154,8 +155,6 @@ def pipeline_train_step(
     def step(params, opt_state, x, y):
         loss, grads = jax.value_and_grad(sharded_loss)(params, x, y)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        import optax
-
         return optax.apply_updates(params, updates), opt_state, loss
 
     return step

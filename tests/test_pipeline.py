@@ -76,7 +76,7 @@ class TestPipeline:
 
         # grads flow through every stage: training reduces the loss
         losses = [float(loss0)]
-        params, opt_state = params2, opt_state
+        params = params2
         for _ in range(30):
             params, opt_state, loss = step(params, opt_state, x, y)
             losses.append(float(loss))
