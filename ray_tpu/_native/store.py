@@ -204,6 +204,13 @@ class ShmStore:
 
         self._live_pins = weakref.WeakSet()
         self._created_views: dict = {}  # object_id -> writable view until seal
+        # First-touch page faults dominate large writes into fresh arena
+        # regions (~0.7 GB/s trap-per-page vs ~6 GB/s on resident pages).
+        # MADV_POPULATE_WRITE batch-faults a fresh range in-kernel; the
+        # high-water mark keeps the steady state (recycled offsets, pages
+        # already resident) at zero madvise overhead.
+        self._populate_hw = 0
+        self._can_populate = True
 
     # -- write path ------------------------------------------------------
     def create(self, object_id: bytes, size: int) -> memoryview:
@@ -221,6 +228,16 @@ class ShmStore:
             )
         if rc != RT_OK:
             raise StoreError(f"create failed: {_rc_name(rc)}")
+        end = off.value + size
+        if self._can_populate and end > self._populate_hw:
+            start = max(off.value, self._populate_hw) & ~0xFFF
+            try:
+                # MADV_POPULATE_WRITE == 23 (Linux 5.14+); mmap.py lacks
+                # the constant on this Python build
+                self._mm.madvise(23, start, min(len(self._mm), end) - start)
+            except (OSError, ValueError):
+                self._can_populate = False  # older kernel: fall back to traps
+            self._populate_hw = end
         view = self._mv[off.value : off.value + size]
         self._created_views[bytes(object_id)] = view
         return view

@@ -102,6 +102,7 @@ class ActorClientState:
     wake: Any = None  # asyncio.Event
     pump_running: bool = False
     dead: bool = False  # actor creation failed / actor died — pump exits
+    draining: bool = False  # pump is parked mid-drain waiting for inflight
 
 
 class SchedClassState:
@@ -157,6 +158,13 @@ class Runtime:
         # local object state
         self.memory_store: Dict[bytes, Any] = {}
         self.result_futures: Dict[bytes, asyncio.Future] = {}
+        # caller threads parked in get()'s fast path, keyed by oid; the
+        # reply applier signals them directly, skipping the
+        # run_coroutine_threadsafe round trip (see _try_sync_get).  The
+        # lock serializes caller-thread register/drop (the io-loop signal
+        # path pops atomically and never takes it).
+        self._sync_waiters: Dict[bytes, list] = {}
+        self._sync_reg_lock = threading.Lock()
         self._shared: set = set()  # oids known to be in shm + registered
         self._escaped: set = set()  # refs passed on before their task finished
 
@@ -481,11 +489,82 @@ class Runtime:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"ray_tpu.get expects ObjectRef(s), got {type(r)}")
         deadline = None if timeout is None else time.monotonic() + timeout
-        out = self._run(
-            self._get_async([r.object_id.binary() for r in refs], deadline),
-            timeout=None,
-        )
+        # Fast path: locally-produced inline task results resolve on the
+        # caller thread with a direct wakeup from the reply applier — no
+        # coroutine scheduling, no extra io-loop iterations.  Any ref the
+        # fast path can't serve (shm-stored, remote, reconstruction) drops
+        # the remainder onto the full async path.
+        out = []
+        for r in refs:
+            v = self._try_sync_get(r.object_id.binary(), deadline)
+            if v is _SYNC_MISS:
+                break
+            out.append(v)
+        if len(out) < len(refs):
+            out.extend(self._run(
+                self._get_async(
+                    [r.object_id.binary() for r in refs[len(out):]], deadline
+                ),
+                timeout=None,
+            ))
         return out[0] if single else out
+
+    def _try_sync_get(self, oid: bytes, deadline):
+        """Resolve a locally-produced inline task result without touching
+        the io loop.  Lock-free: correctness rides on the reply applier's
+        write order (value into memory_store BEFORE the result future is
+        popped and waiters are signalled) plus a re-check after waiter
+        registration, so a completion racing the registration can never
+        strand the caller.  Returns _SYNC_MISS for anything that needs the
+        shm store or a remote pull."""
+        while True:
+            if oid in self.memory_store:
+                value = self.memory_store[oid]
+                if isinstance(value, _RaiseOnGet):
+                    raise value.exc
+                return value
+            if oid not in self.result_futures:
+                return _SYNC_MISS
+            ev = threading.Event()
+            with self._sync_reg_lock:
+                self._sync_waiters.setdefault(oid, []).append(ev)
+            # re-check: the reply may have been applied between the checks
+            # above and the registration, in which case its signal pass
+            # could have missed our event
+            if oid in self.memory_store or oid not in self.result_futures:
+                self._drop_sync_waiter(oid, ev)
+                continue
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                ok = False
+            else:
+                ok = ev.wait(remaining)
+            self._drop_sync_waiter(oid, ev)
+            if not ok:
+                raise GetTimeoutError(
+                    f"timed out waiting for {oid.hex()[:16]}"
+                )
+
+    def _drop_sync_waiter(self, oid: bytes, ev):
+        with self._sync_reg_lock:
+            ws = self._sync_waiters.get(oid)
+            if ws is not None:
+                try:
+                    ws.remove(ev)
+                except ValueError:
+                    pass
+                if not ws:
+                    # drop the empty entry (it would otherwise leak: the
+                    # one-shot signal for this oid may already have fired)
+                    self._sync_waiters.pop(oid, None)
+
+    def _signal_sync_waiters(self, oid: bytes):
+        ws = self._sync_waiters.pop(oid, None)
+        if ws:
+            # snapshot: a timed-out caller may remove() concurrently, and
+            # iterating the live list under a remove can skip a waiter
+            for ev in list(ws):
+                ev.set()
 
     async def await_ref(self, ref: ObjectRef):
         (value,) = await self._get_async([ref.object_id.binary()], None)
@@ -1039,6 +1118,7 @@ class Runtime:
             fut = self.result_futures.pop(oid, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
+            self._signal_sync_waiters(oid)
             self._maybe_release_after_reply(oid)
 
     def _fail_task(self, task: PendingTask, exc: Exception):
@@ -1049,6 +1129,7 @@ class Runtime:
             fut = self.result_futures.pop(oid, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
+            self._signal_sync_waiters(oid)
             self._maybe_release_after_reply(oid)
 
     # ---- actors (client side) ------------------------------------------
@@ -1329,7 +1410,11 @@ class Runtime:
                 if st.inflight:
                     # woken by new submissions, a connection break, or the
                     # last in-flight reply landing (so the pump can exit)
-                    await st.wake.wait()
+                    st.draining = True
+                    try:
+                        await st.wake.wait()
+                    finally:
+                        st.draining = False
             if st.dead:
                 st.pump_running = False
                 return
@@ -1339,11 +1424,15 @@ class Runtime:
             # instead of leaking a task per dead actor forever (nothing
             # wakes an idle pump when its actor is killed).
             st.wake.clear()
-            if not st.queue:  # re-check: enqueue may have raced the clear
+            # re-check BOTH queue and inflight: an eager fast-path submit
+            # places the task straight into st.inflight, so a pump that
+            # retires on an empty queue alone would orphan it — a later
+            # connection loss then has no pump to re-push it.
+            if not st.queue and not st.inflight:
                 try:
                     await asyncio.wait_for(st.wake.wait(), timeout=60.0)
                 except asyncio.TimeoutError:
-                    if not st.queue:
+                    if not st.queue and not st.inflight:
                         st.pump_running = False
                         return
 
@@ -1356,8 +1445,11 @@ class Runtime:
         try:
             reply = await conn.call("push_actor_task", task.spec, timeout=-1)
             st.inflight.pop(task.sub_idx, None)
-            if not st.inflight:
-                st.wake.set()  # let an idle pump exit
+            if not st.inflight and st.draining:
+                # wake ONLY a pump parked mid-drain on this event; waking
+                # the idle 60s park costs a task resume + fresh timer per
+                # call, which dominated the serial sync-call path
+                st.wake.set()
             self._apply_task_reply(task, reply)
         except (rpc.ConnectionLost, OSError):
             # Leave the task in st.inflight; the pump reconnects and
@@ -1369,7 +1461,7 @@ class Runtime:
                 st.wake.set()
         except rpc.RpcError as e:
             st.inflight.pop(task.sub_idx, None)
-            if not st.inflight:
+            if not st.inflight and st.draining:
                 st.wake.set()
             self._fail_task(task, TaskError(
                 "ActorCallError", str(e), "", task.spec["method"]
@@ -1624,6 +1716,10 @@ class Runtime:
 
     def nodes(self) -> list:
         return self._run(self.gcs.call("get_nodes", {}))
+
+
+# get()-fast-path sentinel: "this ref needs the full async resolve path"
+_SYNC_MISS = object()
 
 
 class _RaiseOnGet:

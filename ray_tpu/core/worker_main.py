@@ -54,7 +54,18 @@ class WorkerServer:
         #               "inflight": {task_id: asyncio.Future(reply)},
         #               "replies": OrderedDict task_id -> reply (retry dedupe)}
         self._callers: Dict[bytes, dict] = {}
+        # Adaptive inline execution of sync actor methods (serial actors
+        # only).  The executor hop costs two context switches per call —
+        # the dominant term for sub-millisecond methods — so a method
+        # that has proven consistently fast runs directly on the io loop.
+        # method name -> [fast_streak, demoted]
+        self._method_stats: Dict[str, list] = {}
+        self._sync_exec_inflight = 0  # sync methods currently on the pool
+
     _REPLY_CACHE_PER_CALLER = 256
+    _INLINE_AFTER = 10       # consecutive sub-threshold runs to promote
+    _INLINE_FAST_S = 0.002   # "fast" means under 2 ms
+    _INLINE_DEMOTE_S = 0.05  # one run this long bans inline for good
 
     async def start(self):
         await self.server.start()
@@ -368,10 +379,22 @@ class WorkerServer:
                         finally:
                             self._running_tasks.pop(tid, None)
             else:
-                pool = self._actor_thread_pool or self._exec
-                reply = await asyncio.get_running_loop().run_in_executor(
-                    pool, self._execute_sync_method, method, spec
-                )
+                reply = self._maybe_execute_inline(method, spec)
+                if reply is None:
+                    pool = self._actor_thread_pool or self._exec
+                    mname = spec["method"]
+                    self._sync_exec_inflight += 1
+                    t0 = time.perf_counter()
+                    try:
+                        reply = await asyncio.get_running_loop().run_in_executor(
+                            pool, self._execute_sync_method, method, spec
+                        )
+                    finally:
+                        self._sync_exec_inflight -= 1
+                    # executor timing includes queue wait: under
+                    # contention the streak resets, which is exactly when
+                    # we want to stay on the pool (overlap > latency)
+                    self._note_method_time(mname, time.perf_counter() - t0)
         except BaseException as e:
             reply = self._error_reply(
                 e if isinstance(e, Exception) else RuntimeError(repr(e)), spec
@@ -383,6 +406,58 @@ class WorkerServer:
         if not reply_fut.done():
             reply_fut.set_result(reply)
         return reply
+
+    def _maybe_execute_inline(self, method, spec) -> Optional[dict]:
+        """Run a proven-fast sync method directly on the io loop, skipping
+        the executor's two context switches.  Inline is taken only when it
+        cannot be observed: the actor is serial (no thread pool), nothing
+        is running on the executor (so executions can't overlap), the args
+        are ref-free (resolving a ref needs the loop), and the method has
+        a streak of sub-2ms runs behind it.  First calls always go through
+        the pool, so a blocking method never runs inline.  The tail risk —
+        a promoted method whose NEXT run turns slow blocks the loop for
+        that one run, and cancellation cannot interrupt it — is bounded by
+        demotion: any run past _INLINE_DEMOTE_S (50 ms) bans the method
+        from inline permanently, and a merely-slow run resets the streak.
+        Returns None when the pool must be used."""
+        if self._actor_thread_pool is not None or self._sync_exec_inflight:
+            return None
+        mname = spec["method"]
+        st = self._method_stats.get(mname)
+        if st is None or st[1] or st[0] < self._INLINE_AFTER:
+            return None
+        unpacked = self.rt.unpack_args_sync(spec["args"])
+        if unpacked is None:
+            return None
+        tid = spec["task_id"]
+        if tid in self._cancelled:
+            self._cancelled.discard(tid)
+            return self._error_reply(TaskCancelledError("cancelled"), spec)
+        t0 = time.perf_counter()
+        try:
+            args, kwargs = unpacked
+            reply = self._exec_pack(spec, method(*args, **kwargs))
+        except TaskCancelledError as e:
+            reply = self._error_reply(e, spec)
+        except BaseException as e:
+            reply = self._error_reply(
+                e if isinstance(e, Exception) else RuntimeError(repr(e)), spec
+            )
+        finally:
+            self._cancelled.discard(tid)
+        self._note_method_time(mname, time.perf_counter() - t0)
+        return reply
+
+    def _note_method_time(self, mname: str, dt: float):
+        st = self._method_stats.get(mname)
+        if st is None:
+            st = self._method_stats[mname] = [0, False]
+        if dt < self._INLINE_FAST_S:
+            st[0] += 1
+        else:
+            st[0] = 0
+            if dt > self._INLINE_DEMOTE_S:
+                st[1] = True
 
     def _execute_sync_method(self, method, spec) -> dict:
         tid = spec["task_id"]

@@ -181,6 +181,11 @@ class CompiledDAG:
         self._next_seq = 0
         self._results: Dict[int, Any] = {}
         self._next_read_seq = 0
+        # outputs already drained for the iteration currently being read;
+        # survives a ChannelTimeoutError so a retried get() resumes at the
+        # first unread channel instead of re-reading channel 0 (which would
+        # pair outputs from different iterations)
+        self._partial_reads: List[Any] = []
         self._torn_down = False
         self._loop_refs: list = []
         self._compile(root)
@@ -353,16 +358,18 @@ class CompiledDAG:
                     raise ValueError(
                         f"result for execution #{seq} was already consumed"
                     )
-                vals = []
-                err = None
-                for c in self._output_channels:
-                    k, obj = _unpack(
+                vals = self._partial_reads
+                for c in self._output_channels[len(vals):]:
+                    vals.append(_unpack(
                         c.read(timeout=timeout,
                                liveness=self._check_loops_alive)
-                    )
+                    ))
+                self._partial_reads = []
+                err = None
+                for k, obj in vals:
                     if k == _ERR and err is None:
                         err = obj
-                    vals.append(obj)
+                vals = [obj for _, obj in vals]
                 if err is not None:
                     self._results[self._next_read_seq] = ("err", err)
                 else:

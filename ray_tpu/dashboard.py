@@ -79,8 +79,12 @@ refresh(); setInterval(refresh, 5000);
 class DashboardActor:
     """Serves the dashboard; runs as a detached actor on the cluster."""
 
-    def __init__(self, port: int = 8265):
+    def __init__(self, port: int = 8265, host: str = "127.0.0.1"):
+        # localhost by default: the dashboard serves cluster state and log
+        # file contents with no auth, so a network bind must be explicit
+        # (matches the reference dashboard's default).
         self._port = port
+        self._host = host
         self._runner = None
 
     async def start(self) -> int:
@@ -103,7 +107,7 @@ class DashboardActor:
         app.router.add_get("/api/logs/{name}", self._logs_tail)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "0.0.0.0", self._port)
+        site = web.TCPSite(self._runner, self._host, self._port)
         await site.start()
         if self._port == 0:  # ephemeral: report the bound port
             for server in self._runner.sites:
@@ -215,14 +219,21 @@ class DashboardActor:
         return web.Response(text="\n".join(tail), content_type="text/plain")
 
 
-def start_dashboard(port: int = 8265) -> str:
-    """Start (or reuse) the cluster dashboard; returns its URL."""
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> str:
+    """Start (or reuse) the cluster dashboard; returns its URL.
+
+    ``host`` is the bind address on whichever node hosts the dashboard
+    actor.  The localhost default is safe (no auth on the endpoints);
+    multi-node operators who need remote access pass ``host="0.0.0.0"``
+    explicitly and front it themselves.
+    """
     actor = DashboardActor.options(
         name=DASHBOARD_NAME, get_if_exists=True, lifetime="detached",
         num_cpus=0.1,
-    ).remote(port)
+    ).remote(port, host)
     bound = ray_tpu.get(actor.start.remote(), timeout=120)
-    return f"http://127.0.0.1:{bound}"
+    display = "127.0.0.1" if host in ("0.0.0.0", "127.0.0.1") else host
+    return f"http://{display}:{bound}"
 
 
 def stop_dashboard() -> None:

@@ -128,10 +128,18 @@ class TuneController:
         outstanding: Dict[Any, Trial] = {}  # next_report ref -> trial
 
         def top_up():
-            """Pull new trials from the searcher up to free capacity."""
+            """Pull new trials from the searcher up to free capacity.
+
+            Never pulls while running == max_concurrent: sequential
+            searchers (TPE) condition each suggestion on completed
+            results, so consuming suggestions early skews the search and
+            holds a pending trial beyond the concurrency cap.  With
+            unlimited concurrency we feed one trial per loop pass.
+            """
             if self._search_exhausted:
                 return
-            while len(pending) < max(1, capacity()):
+            while (len(pending) < capacity()
+                   if self.max_concurrent > 0 else not pending):
                 tid = f"{self.experiment_name}_{len(self.trials):05d}"
                 cfg = self.searcher.suggest(tid)
                 if cfg is None:
