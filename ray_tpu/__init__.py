@@ -33,6 +33,7 @@ def __getattr__(name):
         "nodes",
         "method",
         "ObjectRef",
+        "ObjectRefGenerator",
         "ActorHandle",
         "timeline",
     ):

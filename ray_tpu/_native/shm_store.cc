@@ -44,7 +44,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5254504c41534d41ULL;  // "RTPLASMA"
+constexpr uint64_t kMagic = 0x5254504c41534d42ULL;  // "RTPLASMB" (v2: Entry.flags)
 constexpr uint64_t kAlign = 64;
 constexpr uint32_t kIdLen = 16;
 constexpr uint32_t kMaxClients = 128;
@@ -71,6 +71,9 @@ enum : int {
   RT_NO_CLIENT_SLOT = -8,
 };
 
+// Entry flag bits.
+constexpr uint32_t kFlagProtected = 1u;  // primary copy: LRU must not evict
+
 struct Entry {
   uint8_t id[kIdLen];
   uint64_t offset;       // data offset from arena base
@@ -78,6 +81,8 @@ struct Entry {
   uint64_t last_access;  // logical clock for LRU eviction
   uint32_t state;
   uint32_t refcnt;       // pin count; pinned objects are never evicted
+  uint32_t flags;        // kFlag* bits; protected entries spill before evict
+  uint32_t pad;
 };
 
 struct PinRec {
@@ -345,7 +350,8 @@ uint64_t evict_lru(Store* s, uint64_t needed_bytes, uint64_t needed_entries = 0)
   uint64_t n = 0;
   for (uint64_t i = 0; i < h->table_cap; i++) {
     Entry* e = &s->table()[i];
-    if (e->state == kSealed && e->refcnt == 0) {
+    if (e->state == kSealed && e->refcnt == 0 &&
+        !(e->flags & kFlagProtected)) {
       cands[n].access = e->last_access;
       cands[n].idx = i;
       n++;
@@ -618,6 +624,7 @@ int rt_store_create_object(void* handle, const uint8_t* id, uint64_t size,
   e->size = size;
   e->state = kCreated;
   e->refcnt = 1;  // creator holds a pin until seal/abort
+  e->flags = 0;   // a reused tombstone may carry stale flag bits
   e->last_access = ++h->access_clock;
   h->live_objects++;
   *out_offset = off;
@@ -712,6 +719,60 @@ void rt_store_stats(void* handle, uint64_t* capacity, uint64_t* used,
   *used = h->used_bytes;
   *objects = h->live_objects;
   *evictions = h->num_evictions;
+}
+
+// Set / clear the protected (primary-copy) bit.  Protected entries are
+// skipped by LRU eviction; the node's spill manager writes them to disk
+// and clears the bit (or deletes them) when the arena fills.
+int rt_store_protect(void* handle, const uint8_t* id, int on) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) return RT_NOT_FOUND;
+  if (on)
+    e->flags |= kFlagProtected;
+  else
+    e->flags &= ~kFlagProtected;
+  return RT_OK;
+}
+
+// List spill candidates: sealed, unpinned, protected entries in LRU order
+// (least recently used first).  Writes up to `max_n` ids (16 bytes each)
+// into out_ids and their payload sizes into out_sizes; returns the count.
+uint64_t rt_store_list_spillable(void* handle, uint8_t* out_ids,
+                                 uint64_t* out_sizes, uint64_t max_n) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  Locker lock(s);
+  Header* h = s->hdr();
+  struct Cand {
+    uint64_t access;
+    uint64_t idx;
+  };
+  Cand* cands = static_cast<Cand*>(malloc(h->table_cap * sizeof(Cand)));
+  if (!cands) return 0;
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < h->table_cap; i++) {
+    Entry* e = &s->table()[i];
+    if (e->state == kSealed && e->refcnt == 0 &&
+        (e->flags & kFlagProtected)) {
+      cands[n].access = e->last_access;
+      cands[n].idx = i;
+      n++;
+    }
+  }
+  qsort(cands, n, sizeof(Cand), [](const void* a, const void* b) {
+    uint64_t aa = static_cast<const Cand*>(a)->access;
+    uint64_t bb = static_cast<const Cand*>(b)->access;
+    return (aa < bb) ? -1 : (aa > bb) ? 1 : 0;
+  });
+  uint64_t count = n < max_n ? n : max_n;
+  for (uint64_t i = 0; i < count; i++) {
+    Entry* e = &s->table()[cands[i].idx];
+    memcpy(out_ids + i * kIdLen, e->id, kIdLen);
+    out_sizes[i] = e->size;
+  }
+  free(cands);
+  return count;
 }
 
 // Base address of the mapping in this process (for zero-copy memoryviews).

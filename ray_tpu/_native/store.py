@@ -116,6 +116,14 @@ def _get_lib():
                 lib.rt_store_stats.argtypes = [ctypes.c_void_p] + [
                     ctypes.POINTER(ctypes.c_uint64)
                 ] * 4
+                lib.rt_store_protect.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                ]
+                lib.rt_store_list_spillable.restype = ctypes.c_uint64
+                lib.rt_store_list_spillable.argtypes = [
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+                ]
                 lib.rt_store_base.restype = ctypes.c_void_p
                 lib.rt_store_base.argtypes = [ctypes.c_void_p]
                 lib.rt_store_map_size.restype = ctypes.c_uint64
@@ -317,6 +325,26 @@ class ShmStore:
     def reap(self) -> int:
         """Release pins held by dead client processes; returns clients reaped."""
         return self._lib.rt_store_reap(self._h)
+
+    def protect(self, object_id: bytes, on: bool = True) -> None:
+        """Mark/unmark an object as a primary copy: LRU eviction skips
+        protected entries, so the only copy of a value can never vanish
+        silently — the raylet's spill manager writes protected entries to
+        disk under memory pressure instead (reference role:
+        local_object_manager.h pinned-primary + spill)."""
+        object_id = _check_id(object_id)
+        self._lib.rt_store_protect(self._h, object_id, 1 if on else 0)
+
+    def list_spillable(self, max_n: int = 4096) -> list:
+        """(object_id, size) of sealed, unpinned, protected entries in
+        LRU order — the spill manager's victim candidates."""
+        ids = (ctypes.c_uint8 * (16 * max_n))()
+        sizes = (ctypes.c_uint64 * max_n)()
+        n = self._lib.rt_store_list_spillable(
+            self._h, ids, sizes, ctypes.c_uint64(max_n)
+        )
+        raw = bytes(ids)
+        return [(raw[i * 16:(i + 1) * 16], sizes[i]) for i in range(n)]
 
     def close(self) -> None:
         if self._closed:

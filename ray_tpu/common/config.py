@@ -83,6 +83,16 @@ D("object_store_auto_cap_bytes", int, 8 * 1024 * 1024 * 1024)
 D("inline_object_max_bytes", int, 100 * 1024)  # small results ride the RPC reply
 D("object_chunk_bytes", int, 16 * 1024 * 1024)  # node-to-node transfer chunk
 
+# --- streaming generator returns (reference: num_returns="streaming")
+D("streaming_backpressure_items", int, 64)  # unacked items before the
+#   producing worker pauses the generator
+
+# --- object spilling (reference role: local_object_manager + external_storage)
+D("object_spill_enabled", int, 1)
+D("object_spill_high_frac", float, 0.8)  # arena fill ratio that triggers spill
+D("object_spill_low_frac", float, 0.6)   # spill until back under this ratio
+D("object_spill_max_restore_bytes", int, 0)  # 0 = no cap on restore size
+
 # --- scheduler ---
 D("sched_spread_threshold", float, 0.5)
 D("sched_max_pending_lease_s", float, 60.0)

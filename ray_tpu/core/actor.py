@@ -42,6 +42,8 @@ class ActorMethod:
             num_returns=self._num_returns,
             retries=self._handle._max_task_retries,
         )
+        if self._num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
 

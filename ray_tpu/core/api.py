@@ -15,6 +15,7 @@ from ray_tpu.core import node as node_mod
 from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
 from ray_tpu.core.errors import RayTpuError
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.runtime import ObjectRefGenerator  # noqa: F401
 from ray_tpu.core.remote_function import RemoteFunction
 from ray_tpu.core.runtime import Runtime, get_runtime, set_runtime
 
@@ -189,10 +190,14 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     get_runtime().kill_actor(actor._actor_id, no_restart=no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+def cancel(ref, *, force: bool = False) -> bool:
     """Cancel the task producing ``ref``: queued tasks are dropped before
     dispatch; running tasks are interrupted on their worker (ray:
-    worker.py cancel → CoreWorker::CancelTask)."""
+    worker.py cancel → CoreWorker::CancelTask).  An ObjectRefGenerator
+    cancels its producing generator; the consumer's next() then yields a
+    ref raising TaskCancelledError."""
+    if isinstance(ref, ObjectRefGenerator):
+        return get_runtime().stream_cancel(ref.task_id)
     return get_runtime().cancel(ref)
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -339,7 +340,7 @@ class CheckpointStore:
 #: flushes stay O(control-plane state)
 _OBJECT_RPCS = frozenset({
     "add_object_location", "remove_object_location", "free_objects",
-    "ref_edge", "ref_update",
+    "ref_edge", "ref_update", "add_spilled_location",
 })
 
 #: rpc methods whose effects must survive an immediate crash: flushed
@@ -406,6 +407,15 @@ class GcsServer:
         # object directory: object_id bytes -> {node_id}
         self.object_locations: Dict[bytes, Set[NodeID]] = {}
         self.object_sizes: Dict[bytes, int] = {}
+        # objects spilled to a node's disk (the file outlives the arena
+        # copy; reference role: object directory's spilled-URL field,
+        # gcs_object_manager + local_object_manager.h:110)
+        self.spilled_objects: Dict[bytes, NodeID] = {}
+        # recently freed oids: a location announce racing the free (a
+        # restore or pull finishing after delete_objects went out) must
+        # not resurrect the object's directory entry.  Object ids are
+        # never reused, so a bounded FIFO window is sufficient.
+        self._freed_tombstones: "OrderedDict[bytes, None]" = OrderedDict()
         self._location_waiters: Dict[bytes, List[asyncio.Future]] = {}
         # distributed refcounting: object_id -> holder tokens (worker_id
         # bytes for processes, b"actor:<id>" for actor creation specs).
@@ -486,6 +496,7 @@ class GcsServer:
                 k: set(v) for k, v in self.object_holders.items()
             },
             "object_edges": {k: list(v) for k, v in self.object_edges.items()},
+            "spilled_objects": dict(self.spilled_objects),
         }
 
     def _restore_object_state(self, st: dict):
@@ -493,6 +504,7 @@ class GcsServer:
         self.object_sizes.update(st["object_sizes"])
         self.object_holders.update(st["object_holders"])
         self.object_edges.update(st["object_edges"])
+        self.spilled_objects.update(st.get("spilled_objects", {}))
 
     def _restore_state(self, st: dict):
         """Rebuild tables from a snapshot; connections re-attach lazily.
@@ -1005,6 +1017,8 @@ class GcsServer:
     # ---- object directory ---------------------------------------------
     async def rpc_add_object_location(self, conn, p):
         oid = p["object_id"]
+        if oid in self._freed_tombstones:
+            return False  # announce raced the free; do not resurrect
         self.object_locations.setdefault(oid, set()).add(NodeID(p["node_id"]))
         if "size" in p:
             self.object_sizes[oid] = p["size"]
@@ -1012,6 +1026,25 @@ class GcsServer:
             if not fut.done():
                 fut.set_result(True)
         return True
+
+    async def rpc_add_spilled_location(self, conn, p):
+        oid = p["object_id"]
+        # A spill can race the object's free: the raylet picked the victim
+        # before delete_objects arrived.  Registering a spilled location
+        # for a freed object would orphan the file forever — refuse, and
+        # the raylet keeps its arena copy (the pending delete reclaims it).
+        if oid in self._freed_tombstones or (
+            not self.object_holders.get(oid)
+            and oid not in self.object_locations
+        ):
+            return {"ok": False}
+        self.spilled_objects[oid] = NodeID(p["node_id"])
+        if "size" in p:
+            self.object_sizes[oid] = p["size"]
+        for fut in self._location_waiters.pop(oid, ()):
+            if not fut.done():
+                fut.set_result(True)
+        return {"ok": True}
 
     async def rpc_remove_object_location(self, conn, p):
         oid = p["object_id"]
@@ -1026,7 +1059,7 @@ class GcsServer:
         oid = p["object_id"]
         timeout = p.get("timeout", 0)
         locs = self.object_locations.get(oid)
-        if not locs and timeout:
+        if not locs and oid not in self.spilled_objects and timeout:
             fut = asyncio.get_running_loop().create_future()
             self._location_waiters.setdefault(oid, []).append(fut)
             try:
@@ -1039,7 +1072,17 @@ class GcsServer:
             node = self.nodes.get(nid)
             if node and node.alive:
                 out.append({"node_id": nid.hex(), "address": node.address})
-        return {"locations": out, "size": self.object_sizes.get(oid)}
+        spilled = None
+        snid = self.spilled_objects.get(oid)
+        if snid is not None:
+            node = self.nodes.get(snid)
+            if node and node.alive:
+                spilled = {"node_id": snid.hex(), "address": node.address}
+        return {
+            "locations": out,
+            "size": self.object_sizes.get(oid),
+            "spilled": spilled,
+        }
 
     async def rpc_free_objects(self, conn, p):
         for oid in p["object_ids"]:
@@ -1048,9 +1091,16 @@ class GcsServer:
 
     async def _free_object(self, oid: bytes):
         self._mark_objects_dirty()
+        self._freed_tombstones[oid] = None
+        while len(self._freed_tombstones) > 10_000:
+            self._freed_tombstones.popitem(last=False)
         locs = self.object_locations.pop(oid, set())
         self.object_sizes.pop(oid, None)
         self.object_holders.pop(oid, None)
+        spilled_nid = self.spilled_objects.pop(oid, None)
+        if spilled_nid is not None:
+            locs = set(locs)
+            locs.add(spilled_nid)  # its raylet also removes the spill file
         for nid in locs:
             node = self.nodes.get(nid)
             if node and node.alive:

@@ -87,6 +87,20 @@ class Raylet:
         self._inflight_pulls: Dict[bytes, asyncio.Future] = {}
         self._tasks: List[asyncio.Task] = []
         self._closing = False
+        # Object spilling (reference role: raylet/local_object_manager.h:41
+        # SpillObjects + python/ray/_private/external_storage.py).  Primary
+        # copies are `protect`ed in the arena (LRU cannot evict them);
+        # when the arena passes the high-water mark the spill loop writes
+        # the least-recently-used ones to files here, registers the
+        # spilled location with the GCS, and drops the arena copy.
+        self.spill_dir = os.path.join(
+            session_dir, "spill", self.node_id.hex()[:12]
+        )
+        self._spilled: Dict[bytes, int] = {}  # oid -> size
+        self._spilled_bytes = 0
+        self._spill_count = 0
+        self._restore_count = 0
+        self._spill_lock = asyncio.Lock()
 
     # ---- lifecycle -----------------------------------------------------
     async def start(self):
@@ -197,6 +211,155 @@ class Raylet:
                 self.store.reap()
             except Exception:
                 pass
+            try:
+                await self._maybe_spill()
+            except Exception:
+                logger.exception("spill pass failed")
+
+    # ---- object spilling ------------------------------------------------
+
+    def _spill_path(self, oid: bytes) -> str:
+        return os.path.join(self.spill_dir, oid.hex() + ".obj")
+
+    async def _maybe_spill(self, needed_bytes: int = 0) -> int:
+        """Spill LRU primaries until the arena is under the low-water mark
+        (or `needed_bytes` have been freed).  Returns bytes freed."""
+        if not cfg.object_spill_enabled:
+            return 0
+        async with self._spill_lock:
+            st = self.store.stats()
+            cap = st["capacity"] or 1
+            if needed_bytes:
+                if needed_bytes > cap:
+                    return 0  # can never fit: don't strip the whole arena
+                headroom = cap - st["used"]
+                shortfall = needed_bytes - headroom
+                if shortfall <= 0:
+                    # the caller's create failed despite apparent headroom:
+                    # fragmentation — spill ~needed_bytes of LRU primaries
+                    # so arena_free can merge a contiguous run
+                    shortfall = needed_bytes
+                target = st["used"] - shortfall
+            elif st["used"] > cfg.object_spill_high_frac * cap:
+                target = int(cfg.object_spill_low_frac * cap)
+            else:
+                return 0
+            freed = 0
+            for oid, size in self.store.list_spillable():
+                if st["used"] - freed <= target:
+                    break
+                if await self._spill_one(oid, size):
+                    freed += size
+            return freed
+
+    async def _spill_one(self, oid: bytes, size: int) -> bool:
+        pin = self.store.get(oid)
+        if pin is None:
+            return False
+        path = self._spill_path(oid)
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            # write straight from the pinned arena view on a worker thread
+            # (copying multi-GB objects on the event loop stalls all RPCs)
+            await asyncio.to_thread(self._write_file, tmp, pin.view)
+            os.replace(tmp, path)
+        except OSError:
+            logger.exception("spill write failed for %s", oid.hex()[:12])
+            return False
+        finally:
+            pin.release()
+        self._spilled[oid] = size
+        self._spilled_bytes += size
+        self._spill_count += 1
+        try:
+            reply = await self.gcs.call("add_spilled_location", {
+                "object_id": oid,
+                "node_id": self.node_id.binary(),
+                "size": size,
+            })
+        except Exception:
+            # GCS unreachable: keep the arena copy authoritative
+            self._drop_spill_file(oid)
+            return False
+        if not (isinstance(reply, dict) and reply.get("ok")):
+            # the object was freed while we were writing the file: keep
+            # the arena copy (its pending delete reclaims it), drop ours
+            self._drop_spill_file(oid)
+            return False
+        # The file is now the durable primary; the arena copy is cache.
+        self.store.protect(oid, False)
+        if self.store.delete(oid):
+            # arena copy gone: retract the directory entry so pullers
+            # don't see this node listed twice (location + spilled)
+            try:
+                await self.gcs.notify("remove_object_location", {
+                    "object_id": oid,
+                    "node_id": self.node_id.binary(),
+                })
+            except Exception:
+                pass
+        # (delete refuses while a reader holds a pin — fine: the entry is
+        # unprotected now, so LRU reclaims it and the location goes stale
+        # only until the object is freed)
+        return True
+
+    @staticmethod
+    def _write_file(path: str, data) -> None:
+        with open(path, "wb") as f:
+            f.write(data)  # bytes or a pinned memoryview — no extra copy
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _drop_spill_file(self, oid: bytes) -> None:
+        size = self._spilled.pop(oid, None)
+        if size is not None:
+            self._spilled_bytes -= size
+        try:
+            os.unlink(self._spill_path(oid))
+        except OSError:
+            pass
+
+    async def _restore_from_spill(self, oid: bytes) -> bool:
+        """Read a spilled object back into the arena (stays spilled on
+        disk; the arena copy is a cache until the object is freed)."""
+        if oid not in self._spilled:
+            return False
+        try:
+            data = await asyncio.to_thread(
+                lambda: open(self._spill_path(oid), "rb").read()
+            )
+        except OSError:
+            logger.exception("spill restore failed for %s", oid.hex()[:12])
+            return False
+        try:
+            self._store_put_new(oid, data)
+        except Exception:
+            # arena full: make room for the restore and retry once
+            await self._maybe_spill(needed_bytes=len(data))
+            try:
+                self._store_put_new(oid, data)
+            except Exception:
+                return False
+        self._restore_count += 1
+        await self._announce(oid, len(data))
+        return True
+
+    def _read_spilled(self, oid: bytes, offset: int = 0,
+                      length: Optional[int] = None) -> Optional[bytes]:
+        if oid not in self._spilled:
+            return None
+        try:
+            with open(self._spill_path(oid), "rb") as f:
+                if offset:
+                    f.seek(offset)
+                return f.read(length if length is not None else -1)
+        except OSError:
+            return None
+
+    async def rpc_spill_now(self, conn, p):
+        """Synchronous pressure relief: a client's create just failed."""
+        return await self._maybe_spill(needed_bytes=p.get("needed_bytes", 0))
 
     # ---- dispatch ------------------------------------------------------
     async def _handle(self, conn: rpc.Connection, method: str, p: Any):
@@ -495,6 +658,16 @@ class Raylet:
             {"object_id": oid, "timeout": p.get("timeout", 30.0)},
         )
         locations = reply["locations"]
+        spilled = reply.get("spilled")
+        if spilled is not None and spilled["node_id"] == self.node_id.hex():
+            # our own disk holds it: restore locally, no network
+            if await self._restore_from_spill(oid):
+                return True
+        elif spilled is not None and spilled["node_id"] not in {
+            loc["node_id"] for loc in locations
+        }:
+            # the spilling node serves fetches straight from its file
+            locations = locations + [spilled]
         if not locations:
             return False
         # Shuffle: under a broadcast (N nodes pulling one seeder's object)
@@ -629,29 +802,35 @@ class Raylet:
 
     async def rpc_fetch_object(self, conn: rpc.Connection, p):
         """A remote raylet asks for an object's bytes (small objects)."""
-        pin = self.store.get(p["object_id"])
+        oid = p["object_id"]
+        pin = self.store.get(oid)
         if pin is None:
-            return None
+            return await asyncio.to_thread(self._read_spilled, oid)
         try:
             return bytes(pin.view)
         finally:
             pin.release()
 
     async def rpc_fetch_object_meta(self, conn: rpc.Connection, p):
-        pin = self.store.get(p["object_id"])
+        oid = p["object_id"]
+        pin = self.store.get(oid)
         if pin is None:
-            return None
+            size = self._spilled.get(oid)
+            return None if size is None else {"size": size}
         try:
             return {"size": pin.view.nbytes}
         finally:
             pin.release()
 
     async def rpc_fetch_object_chunk(self, conn: rpc.Connection, p):
-        pin = self.store.get(p["object_id"])
+        oid = p["object_id"]
+        off, ln = p["offset"], p["length"]
+        pin = self.store.get(oid)
         if pin is None:
-            return None
+            # spilled: serve the byte range straight from the file — no
+            # arena restore on the serving node
+            return await asyncio.to_thread(self._read_spilled, oid, off, ln)
         try:
-            off, ln = p["offset"], p["length"]
             return bytes(pin.view[off:off + ln])
         finally:
             pin.release()
@@ -659,10 +838,16 @@ class Raylet:
     async def rpc_delete_objects(self, conn: rpc.Connection, p):
         for oid in p["object_ids"]:
             self.store.delete(oid)
+            self._drop_spill_file(oid)
         return True
 
     async def rpc_store_stats(self, conn: rpc.Connection, p):
-        return self.store.stats()
+        st = self.store.stats()
+        st["spilled_bytes"] = self._spilled_bytes
+        st["spilled_objects"] = len(self._spilled)
+        st["spill_count"] = self._spill_count
+        st["restore_count"] = self._restore_count
+        return st
 
     async def _peer(self, address: str) -> rpc.Connection:
         c = self._peer_conns.get(address)

@@ -49,6 +49,8 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
+        import inspect
+
         from ray_tpu.core.runtime import get_runtime
 
         o = self._opts
@@ -57,6 +59,13 @@ class RemoteFunction:
             o.get("memory"), o.get("resources"),
         )
         num_returns = o.get("num_returns", 1)
+        # generator functions stream by default (reference: generators
+        # return ObjectRefGenerator, remote_function.py:343-349)
+        if num_returns == 1 and (
+            inspect.isgeneratorfunction(self._fn)
+            or inspect.isasyncgenfunction(self._fn)
+        ):
+            num_returns = "streaming"
         strategy = _strategy_dict(o.get("scheduling_strategy"))
         refs = get_runtime().submit_task(
             self._fn,
@@ -71,6 +80,8 @@ class RemoteFunction:
             strategy=strategy,
             runtime_env=o.get("runtime_env"),
         )
+        if num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

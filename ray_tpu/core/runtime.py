@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from ray_tpu._native.store import ObjectExistsError, ShmStore
+from ray_tpu._native.store import ObjectExistsError, ShmStore, StoreFullError
 from ray_tpu.common.config import cfg
 from ray_tpu.common.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.common import serialization as ser
@@ -167,6 +167,15 @@ class Runtime:
         self._sync_reg_lock = threading.Lock()
         self._shared: set = set()  # oids known to be in shm + registered
         self._escaped: set = set()  # refs passed on before their task finished
+
+        # streaming generator tasks by task_id (reference:
+        # ObjectRefGenerator, python/ray/_raylet.pyx:273): items arrive as
+        # stream_item notifies on the worker connection and are buffered
+        # here until the consumer's next()
+        self._streams: Dict[bytes, "_StreamBuf"] = {}
+        # abandoned stream -> consumed-upto index; the closing reply frees
+        # the producer-stored items the consumer never took
+        self._abandoned_streams: Dict[bytes, int] = {}
 
         # scheduling
         self._classes: Dict[tuple, SchedClassState] = {}
@@ -452,11 +461,22 @@ class Runtime:
         except ObjectExistsError:
             self._shared.add(oid)
             return size
+        except StoreFullError:
+            # the arena is packed with protected primaries: ask the raylet
+            # to spill LRU primaries to disk, then retry once
+            if not self._request_spill(size):
+                raise
+            buf = self.store.create(oid, size)
         try:
             s.write_into(buf)
         except BaseException:
             self.store.abort(oid)
             raise
+        # protect BEFORE seal: this is the primary copy, and a concurrent
+        # eviction pass must never reclaim it between seal (refcnt drops
+        # to 0) and the flag landing — spilling is the only sanctioned way
+        # out of the arena for a primary
+        self.store.protect(oid)
         self.store.seal(oid)
         self._shared.add(oid)
         self._spawn(
@@ -470,6 +490,26 @@ class Runtime:
             )
         )
         return size
+
+    def _request_spill(self, needed_bytes: int) -> bool:
+        """Ask our raylet to spill primaries so a create can proceed.
+        Only usable off the io loop (the call must block); the io-loop
+        contexts that write to the store tolerate failure and retry via
+        the raylet's periodic pressure pass instead."""
+        if self.raylet is None or getattr(self.raylet, "closed", True):
+            return False
+        if threading.current_thread() is self._thread:
+            return False
+        try:
+            freed = self._run(
+                self.raylet.call(
+                    "spill_now", {"needed_bytes": needed_bytes}
+                ),
+                timeout=120,
+            )
+            return bool(freed)
+        except Exception:
+            return False
 
     # ---- puts / gets ---------------------------------------------------
     def put(self, value) -> ObjectRef:
@@ -565,6 +605,131 @@ class Runtime:
             # iterating the live list under a remove can skip a waiter
             for ev in list(ws):
                 ev.set()
+
+    # ---- streaming generator returns -----------------------------------
+    # Reference: num_returns="streaming" + ObjectRefGenerator
+    # (python/ray/_raylet.pyx:273, remote_function.py:343-349).  The
+    # producing worker ships each yielded item as a `stream_item` notify
+    # over the same duplex connection that carried the push; the final RPC
+    # reply closes the stream with the item count.  Consumption acks feed
+    # credit-based backpressure on the producer.
+
+    async def _worker_inbound(self, conn, method: str, p: Any):
+        """Inbound messages on caller->worker connections."""
+        if method == "stream_item":
+            self._deliver_stream_item(conn, p)
+            return True
+        raise rpc.RpcError(f"unexpected inbound {method!r} on worker conn")
+
+    def _deliver_stream_item(self, conn, p: dict):
+        tid = p["task_id"]
+        buf = self._streams.get(tid)
+        if buf is None:
+            return  # stream abandoned/cancelled: drop silently
+        idx = p["index"]
+        kind, payload = p["item"]
+        oid = ObjectID.for_task_return(TaskID(tid), idx).binary()
+        if kind == "inline":
+            self.memory_store[oid] = self._serialization.deserialize(payload)
+        elif kind == "err":
+            self.memory_store[oid] = _RaiseOnGet(
+                self._serialization.deserialize(payload)
+            )
+        # kind == "stored": resolvable via the shm/pull path
+        buf.deliver(idx, conn)
+        if buf.cancel_state == 1:
+            # cancel arrived before we knew the producing connection
+            buf.cancel_state = 2
+            self._spawn(conn.notify("cancel_task", {"task_id": tid}))
+
+    def stream_next(self, tid: bytes, timeout: Optional[float] = None):
+        """Block until the next stream item is available; returns its
+        ObjectRef (which may raise on get for an error item).  Raises
+        StopIteration when the stream is exhausted."""
+        buf = self._streams.get(tid)
+        if buf is None:
+            raise StopIteration
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with buf.cond:
+            while True:
+                idx = buf.next_idx
+                if idx in buf.items:
+                    buf.items.discard(idx)
+                    buf.next_idx = idx + 1
+                    conn = buf.conn
+                    break
+                if buf.count is not None and idx >= buf.count:
+                    if not buf.items:
+                        self._streams.pop(tid, None)
+                    raise StopIteration
+                if buf.failed is not None:
+                    raise buf.failed
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"timed out waiting for stream item {idx}"
+                    )
+                buf.cond.wait(remaining)
+        oid = ObjectID.for_task_return(TaskID(tid), idx)
+        if conn is not None and not conn.closed:
+            self._spawn(conn.notify("stream_ack", {"task_id": tid, "upto": idx}))
+        return ObjectRef(oid)
+
+    async def stream_next_async(self, tid: bytes):
+        """Async variant of stream_next (for async actors / drivers)."""
+        return await asyncio.to_thread(self.stream_next, tid)
+
+    def stream_cancel(self, tid: bytes) -> bool:
+        """Stop a streaming producer; the consumer's next() receives a
+        TaskCancelledError ref once the worker acknowledges (or drains)."""
+        buf = self._streams.get(tid)
+        if buf is None:
+            return False
+        conn = buf.conn
+        if conn is not None and not conn.closed:
+            buf.cancel_state = 2
+            self._spawn(conn.notify("cancel_task", {"task_id": tid}))
+        else:
+            # Either not dispatched yet (the pre-push flag catches it) or
+            # pushed but no item delivered yet — mark the buf so the first
+            # delivery forwards the cancel to the producing worker.
+            buf.cancel_state = 1
+            self._cancel_requested.add(
+                ObjectID.for_task_return(TaskID(tid), 0).binary()
+            )
+        return True
+
+    def stream_abandon(self, tid: bytes):
+        """Consumer dropped the generator: cancel production, release any
+        undelivered buffered items."""
+        buf = self._streams.pop(tid, None)
+        if buf is None:
+            return
+        with buf.cond:
+            pending = list(buf.items)
+            conn = buf.conn
+            consumed_upto = buf.next_idx
+        for idx in pending:
+            oid = ObjectID.for_task_return(TaskID(tid), idx).binary()
+            self.memory_store.pop(oid, None)
+        if buf.count is None and buf.failed is None:
+            # still producing: the closing reply frees the stored tail
+            # (see _apply_task_reply) and the worker gets a cancel
+            self._abandoned_streams[tid] = consumed_upto
+            if conn is not None and not conn.closed:
+                self._spawn(conn.notify("cancel_task", {"task_id": tid}))
+        elif buf.count is not None and buf.count > consumed_upto:
+            # producer already finished: free the stored tail now
+            oids = [
+                ObjectID.for_task_return(TaskID(tid), i).binary()
+                for i in range(consumed_upto, buf.count)
+            ]
+            if self.gcs and not self.gcs.closed:
+                self._spawn(
+                    self.gcs.notify("free_objects", {"object_ids": oids})
+                )
 
     async def await_ref(self, ref: ObjectRef):
         (value,) = await self._get_async([ref.object_id.binary()], None)
@@ -815,6 +980,10 @@ class Runtime:
         fn_hash = self.fn_hash_and_register(fn)
         # {} is a valid demand (zero-resource tasks, e.g. PG probes)
         resources = dict(resources) if resources is not None else {"CPU": 1}
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 1
+            max_retries = 0  # re-running a generator would double-send items
         spec = {
             "task_id": task_id.binary(),
             "name": name,
@@ -824,6 +993,8 @@ class Runtime:
             "resources": resources,
             "caller_id": self.worker_id.binary(),
         }
+        if streaming:
+            spec["streaming"] = True
         return_ids = [
             ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
         ]
@@ -858,6 +1029,16 @@ class Runtime:
         # caller drops its own refs (reference: task-argument references,
         # reference_count.h)
         self._hold_for_task(dep_oids)
+        if streaming:
+            # stream buffer must exist before any item can arrive; no
+            # result futures (items resolve via the memory store / shm),
+            # no lineage (generators are not reconstructible)
+            self._streams[task_id.binary()] = _StreamBuf()
+            self._call_on_loop(
+                self._enqueue_after_deps, class_key, pending,
+                dict(resources), strategy or {}, dep_oids,
+            )
+            return ObjectRefGenerator(task_id.binary())
         self._record_lineage(
             pending, class_key, dict(resources), strategy or {}, dep_oids
         )
@@ -1015,7 +1196,9 @@ class Runtime:
     async def _connect_worker(self, addr: str) -> rpc.Connection:
         conn = self._worker_conns.get(addr)
         if conn is None or conn.closed:
-            conn = await rpc.connect(addr, name=f"->worker@{addr}")
+            conn = await rpc.connect(
+                addr, self._worker_inbound, name=f"->worker@{addr}"
+            )
             self._worker_conns[addr] = conn
         return conn
 
@@ -1088,6 +1271,25 @@ class Runtime:
         if reply["status"] == "error":
             self._fail_task(task, self._serialization.deserialize(reply["error"]))
             return
+        if task.spec.get("streaming"):
+            self._unhold_for_task(task.dep_oids)
+            tid = task.spec["task_id"]
+            n = reply.get("streaming", 0)
+            buf = self._streams.get(tid)
+            consumed_upto = self._abandoned_streams.pop(tid, None)
+            if buf is not None:
+                buf.complete(n)
+            elif consumed_upto is not None and n > consumed_upto:
+                # consumer abandoned mid-stream: free the producer-stored
+                # items it never took
+                oids = [
+                    ObjectID.for_task_return(TaskID(tid), i).binary()
+                    for i in range(consumed_upto, n)
+                ]
+                self._spawn(
+                    self.gcs.notify("free_objects", {"object_ids": oids})
+                )
+            return
         self._unhold_for_task(task.dep_oids)
         for oid, ret in zip(task.return_ids, reply["returns"]):
             kind = ret[0]
@@ -1123,6 +1325,16 @@ class Runtime:
 
     def _fail_task(self, task: PendingTask, exc: Exception):
         self._unhold_for_task(task.dep_oids)
+        if task.spec.get("streaming"):
+            # already-delivered items stay readable; the consumer's next()
+            # raises.  Never write _RaiseOnGet into return oids here — item
+            # 0 shares its oid with return id 0 and may hold a real value.
+            tid = task.spec["task_id"]
+            self._abandoned_streams.pop(tid, None)
+            buf = self._streams.get(tid)
+            if buf is not None:
+                buf.fail(exc)
+            return
         for oid in task.return_ids:
             self._cancel_requested.discard(oid)
             self.memory_store[oid] = _RaiseOnGet(exc)
@@ -1266,7 +1478,8 @@ class Runtime:
             if info["state"] == "ALIVE" and info["worker_addr"]:
                 try:
                     conn = await rpc.connect(
-                        info["worker_addr"], name="->actor"
+                        info["worker_addr"], self._worker_inbound,
+                        name="->actor",
                     )
                     self._actor_conns[actor_id] = conn
                     self._actor_addrs[actor_id] = info["worker_addr"]
@@ -1292,6 +1505,10 @@ class Runtime:
         aid = actor_id.binary()
         sub_idx = self._actor_seq.get(aid, 0)
         self._actor_seq[aid] = sub_idx + 1
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 1
+            retries = 0  # re-running a generator would double-send items
         spec = {
             "task_id": task_id.binary(),
             "actor_id": aid,
@@ -1301,6 +1518,8 @@ class Runtime:
             "caller_id": self.worker_id.binary(),
             # seq/seq_epoch are assigned at push time by the actor pump
         }
+        if streaming:
+            spec["streaming"] = True
         return_ids = [
             ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
         ]
@@ -1313,6 +1532,10 @@ class Runtime:
             spec, return_ids, retries, sub_idx=sub_idx, dep_oids=dep_oids
         )
         self._hold_for_task(dep_oids)
+        if streaming:
+            self._streams[task_id.binary()] = _StreamBuf()
+            self._call_on_loop(self._enqueue_actor_task, task)
+            return ObjectRefGenerator(task_id.binary())
         for oid in return_ids:
             self.result_futures[oid] = asyncio.Future(loop=self._loop)
         refs = [ObjectRef(ObjectID(oid)) for oid in return_ids]
@@ -1716,6 +1939,98 @@ class Runtime:
 
     def nodes(self) -> list:
         return self._run(self.gcs.call("get_nodes", {}))
+
+
+class _StreamBuf:
+    """Caller-side buffer of one streaming task's delivered item indexes.
+
+    The io loop delivers (`deliver`, `complete`, `fail`); the consumer
+    thread waits in `Runtime.stream_next` on `cond`.  Item values live in
+    the runtime memory store / shm keyed by for_task_return(tid, idx) —
+    this tracks only arrival and ordering."""
+
+    __slots__ = (
+        "cond", "items", "next_idx", "count", "failed", "conn",
+        "cancel_state",
+    )
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.items: set = set()   # delivered, not yet consumed indexes
+        self.next_idx = 0
+        self.count: Optional[int] = None  # total items once producer done
+        self.failed: Optional[Exception] = None
+        self.conn = None  # connection items arrived on (for acks/cancel)
+        self.cancel_state = 0  # 0 none, 1 requested (conn unknown), 2 sent
+
+    def deliver(self, idx: int, conn):
+        with self.cond:
+            self.items.add(idx)
+            self.conn = conn
+            self.cond.notify_all()
+
+    def complete(self, count: int):
+        with self.cond:
+            self.count = count
+            self.cond.notify_all()
+
+    def fail(self, exc: Exception):
+        with self.cond:
+            self.failed = exc
+            self.cond.notify_all()
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's return refs (reference:
+    ObjectRefGenerator, python/ray/_raylet.pyx:273).  Each next() blocks
+    until the producer has yielded the next item and returns an ObjectRef
+    resolvable with ray_tpu.get; a mid-stream producer error arrives as a
+    ref whose get raises, after which the stream ends."""
+
+    def __init__(self, task_id: bytes):
+        self._task_id = task_id
+        self._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        if self._exhausted:
+            raise StopIteration
+        try:
+            return get_runtime().stream_next(self._task_id)
+        except StopIteration:
+            self._exhausted = True
+            raise
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        if self._exhausted:
+            raise StopAsyncIteration
+        try:
+            return await get_runtime().stream_next_async(self._task_id)
+        except StopIteration:
+            self._exhausted = True
+            raise StopAsyncIteration
+
+    def next_with_timeout(self, timeout: float) -> "ObjectRef":
+        return get_runtime().stream_next(self._task_id, timeout=timeout)
+
+    @property
+    def task_id(self) -> bytes:
+        return self._task_id
+
+    def __del__(self):
+        if not self._exhausted:
+            try:
+                get_runtime().stream_abandon(self._task_id)
+            except Exception:
+                pass
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()[:16]})"
 
 
 # get()-fast-path sentinel: "this ref needs the full async resolve path"

@@ -61,6 +61,8 @@ class WorkerServer:
         # method name -> [fast_streak, demoted]
         self._method_stats: Dict[str, list] = {}
         self._sync_exec_inflight = 0  # sync methods currently on the pool
+        # in-flight streaming generator tasks: task_id -> credit state
+        self._out_streams: Dict[bytes, dict] = {}
 
     _REPLY_CACHE_PER_CALLER = 256
     _INLINE_AFTER = 10       # consecutive sub-threshold runs to promote
@@ -75,9 +77,15 @@ class WorkerServer:
 
     async def _handle(self, conn: rpc.Connection, method: str, p: Any):
         if method == "push_task":
-            return await self.handle_push_task(p)
+            return await self.handle_push_task(p, conn)
         if method == "push_actor_task":
-            return await self.handle_push_actor_task(p)
+            return await self.handle_push_actor_task(p, conn)
+        if method == "stream_ack":
+            st = self._out_streams.get(p["task_id"])
+            if st is not None:
+                st["acked"] = max(st["acked"], p["upto"])
+                st["credit"].set()
+            return True
         if method == "create_actor":
             return await self.handle_create_actor(p)
         if method == "bind_env":
@@ -111,12 +119,15 @@ class WorkerServer:
         raise rpc.RpcError(f"worker: unknown method {method!r}")
 
     # ---- normal tasks --------------------------------------------------
-    async def handle_push_task(self, spec) -> dict:
+    async def handle_push_task(self, spec, conn=None) -> dict:
         try:
             fn = await self.rt.resolve_fn(spec["fn_hash"])
             args, kwargs = await self.rt.unpack_args(spec["args"])
         except Exception as e:
             return self._error_reply(e, spec)
+        if spec.get("streaming"):
+            return await self._run_streaming(conn, spec, fn, args, kwargs,
+                                             self._exec)
         if inspect.iscoroutinefunction(fn):
             try:
                 result = await fn(*args, **kwargs)
@@ -154,6 +165,106 @@ class WorkerServer:
             self._running_task_threads.pop(tid, None)
             self._running_tasks.pop(tid, None)
             self._cancelled.discard(tid)
+
+    # ---- streaming generator tasks --------------------------------------
+    # Reference: streaming generators (_raylet.pyx:273 ObjectRefGenerator,
+    # core_worker task output streaming).  Items ship as stream_item
+    # notifies over the duplex connection that carried the push; the RPC
+    # reply closes the stream with the total item count.  `stream_ack`
+    # notifies from the consumer advance the credit window.
+
+    async def _run_streaming(self, conn, spec, fn, args, kwargs, pool) -> dict:
+        tid = spec["task_id"]
+        state = {"acked": -1, "sent": 0, "credit": asyncio.Event()}
+        self._out_streams[tid] = state
+        loop = asyncio.get_running_loop()
+        err: Optional[BaseException] = None
+        try:
+            if tid in self._cancelled:
+                self._cancelled.discard(tid)
+                raise TaskCancelledError("cancelled before start")
+            if inspect.isasyncgenfunction(fn):
+                async for item in fn(*args, **kwargs):
+                    await self._stream_send(conn, spec, state, item)
+            else:
+                def pump():
+                    # sync generator on the executor thread; each item ships
+                    # through the loop synchronously, so backpressure stalls
+                    # the generator itself
+                    self._running_task_threads[tid] = threading.get_ident()
+                    self._running_tasks[tid] = {
+                        "task_id": tid.hex(),
+                        "name": spec.get("name") or spec.get("method")
+                        or "<generator>",
+                        "start_time": time.time(),
+                    }
+                    try:
+                        for item in fn(*args, **kwargs):
+                            if tid in self._cancelled:
+                                raise TaskCancelledError("cancelled")
+                            asyncio.run_coroutine_threadsafe(
+                                self._stream_send(conn, spec, state, item),
+                                loop,
+                            ).result()
+                    finally:
+                        self._running_task_threads.pop(tid, None)
+                        self._running_tasks.pop(tid, None)
+
+                await loop.run_in_executor(pool, pump)
+        except BaseException as e:
+            err = e if isinstance(e, Exception) else RuntimeError(repr(e))
+        if err is not None:
+            # deliver the error as the stream's final item (the consumer's
+            # next() hands back a ref that raises), then close normally.
+            # Must run BEFORE the state pop: error sends skip backpressure,
+            # but the state must stay reachable for stream_ack handlers.
+            try:
+                await self._stream_send(conn, spec, state, None, error=err)
+            except Exception:
+                pass  # conn gone: the caller already failed the stream
+        self._out_streams.pop(tid, None)
+        self._cancelled.discard(tid)
+        return {"status": "ok", "streaming": state["sent"]}
+
+    async def _stream_send(self, conn, spec, state, item, error=None):
+        idx = state["sent"]
+        if error is None:
+            # error items skip backpressure: a consumer that stopped
+            # acking (cancel/abandon) must not deadlock the closing send
+            if spec["task_id"] in self._cancelled:
+                raise TaskCancelledError("cancelled")
+            while idx - state["acked"] > cfg.streaming_backpressure_items:
+                state["credit"].clear()
+                await state["credit"].wait()
+                if spec["task_id"] in self._cancelled:
+                    raise TaskCancelledError("cancelled")
+        from ray_tpu.common.ids import ObjectID, TaskID
+
+        if error is not None:
+            terr = error if isinstance(error, TaskError) else (
+                TaskError.from_exception(
+                    error,
+                    task_desc=spec.get("name") or spec.get("method", "task"),
+                )
+            )
+            payload = ("err", self.rt.serialize(terr).to_bytes())
+        else:
+            s, nested = self.rt._serialize_tracked(item)
+            if s.total_bytes <= cfg.inline_object_max_bytes:
+                payload = ("inline", s.to_bytes())
+            else:
+                oid = ObjectID.for_task_return(
+                    TaskID(spec["task_id"]), idx
+                ).binary()
+                self.rt._write_to_store(oid, s)
+                self.rt._register_edges(oid, nested)
+                payload = ("stored", s.total_bytes)
+        await conn.notify("stream_item", {
+            "task_id": spec["task_id"],
+            "index": idx,
+            "item": payload,
+        })
+        state["sent"] = idx + 1
 
     def _exec_pack(self, spec, result) -> dict:
         n = spec["num_returns"]
@@ -194,6 +305,12 @@ class WorkerServer:
     def _cancel(self, task_id: bytes) -> bool:
         thread_id = self._running_task_threads.get(task_id)
         self._cancelled.add(task_id)
+        st = self._out_streams.get(task_id)
+        if st is not None:
+            # wake a producer parked in the backpressure credit wait — the
+            # async-exc below cannot land while its pump thread is blocked
+            # inside run_coroutine_threadsafe(...).result()
+            st["credit"].set()
         if thread_id is not None:
             import ctypes
 
@@ -238,7 +355,7 @@ class WorkerServer:
         logger.info("actor %s created (%s)", self.actor_id, cls.__name__)
         return True
 
-    async def handle_push_actor_task(self, spec) -> dict:
+    async def handle_push_actor_task(self, spec, conn=None) -> dict:
         """Per-caller submission ordering, enforced by sequence number.
 
         Calls are ADMITTED in `seq` order (buffered while earlier seqs are
@@ -357,7 +474,17 @@ class WorkerServer:
         reply_fut: asyncio.Future = asyncio.get_running_loop().create_future()
         cs["inflight"][tid] = reply_fut
         try:
-            if inspect.iscoroutinefunction(method):
+            if spec.get("streaming"):
+                try:
+                    args, kwargs = await self.rt.unpack_args(spec["args"])
+                except Exception as e:
+                    reply = self._error_reply(e, spec)
+                else:
+                    reply = await self._run_streaming(
+                        conn, spec, method, args, kwargs,
+                        self._actor_thread_pool or self._exec,
+                    )
+            elif inspect.iscoroutinefunction(method):
                 try:
                     args, kwargs = await self.rt.unpack_args(spec["args"])
                 except Exception as e:

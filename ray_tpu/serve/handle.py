@@ -176,19 +176,16 @@ class DeploymentResponse:
 
 
 class DeploymentResponseGenerator:
-    """Streaming handle result: pull chunks from the pinned replica.
+    """Streaming handle result, backed by the core streaming-generator
+    transport (ObjectRefGenerator), matching ray: serve's
+    DeploymentResponseGenerator.  Iteration yields VALUES; replica death
+    mid-stream raises (generator state is not reconstructible on another
+    replica)."""
 
-    Role-equivalent of ray: serve's streaming DeploymentResponseGenerator
-    (ObjectRefGenerator-backed) — here a replica-pinned pull loop over
-    the actor transport.  Replica death mid-stream raises (generator
-    state is not reconstructible on another replica)."""
-
-    def __init__(self, router: Router, replica, sid: int, batch: int = 8):
+    def __init__(self, router: Router, replica, gen):
         self._router = router
         self._replica = replica
-        self._sid = sid
-        self._batch = batch
-        self._buf: List[Any] = []
+        self._gen = gen
         self._done = False
         self._settled = False
 
@@ -196,31 +193,25 @@ class DeploymentResponseGenerator:
         return self
 
     def __next__(self):
-        while not self._buf:
-            if self._done:
-                self._settle()
-                raise StopIteration
-            try:
-                # no deadline: stream_next returns promptly (replica-side
-                # time budget), and arbitrarily slow generators are legal;
-                # replica death still raises via the actor error path
-                out = ray_tpu.get(
-                    self._replica.stream_next.remote(self._sid, self._batch),
-                    timeout=None,
-                )
-            except BaseException:
-                self._settle()
-                raise
-            self._buf.extend(out["items"])
-            self._done = out["done"]
-        return self._buf.pop(0)
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._done = True
+            self._settle()
+            raise
+        except BaseException:
+            self._settle()
+            raise
+        try:
+            return ray_tpu.get(ref)
+        except BaseException:
+            self._settle()
+            raise
 
     def cancel(self):
         if not self._done:
             try:
-                ray_tpu.get(
-                    self._replica.stream_cancel.remote(self._sid), timeout=30
-                )
+                ray_tpu.cancel(self._gen)
             except Exception:
                 pass
         self._done = True
@@ -277,16 +268,13 @@ class DeploymentHandle:
         if self._stream:
             replica = self._router.pick()
             try:
-                sid = ray_tpu.get(
-                    replica.handle_request_stream_start.remote(
-                        self._method, args, kwargs
-                    ),
-                    timeout=60,
-                )
+                gen = replica.handle_request_stream.options(
+                    num_returns="streaming"
+                ).remote(self._method, args, kwargs)
             except BaseException:
-                self._router.done(replica)
+                self._router.done(replica)  # keep in-flight accounting sane
                 raise
-            return DeploymentResponseGenerator(self._router, replica, sid)
+            return DeploymentResponseGenerator(self._router, replica, gen)
 
         def dispatch():
             replica = self._router.pick()

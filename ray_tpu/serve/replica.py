@@ -28,8 +28,6 @@ class ReplicaActor:
         self._method_default = method_default
         self._ongoing = 0
         self._total = 0
-        self._streams = {}
-        self._stream_seq = 0
 
     async def handle_request(self, method: str, args, kwargs) -> Any:
         self._ongoing += 1
@@ -46,11 +44,11 @@ class ReplicaActor:
         finally:
             self._ongoing -= 1
 
-    async def handle_request_stream_start(self, method: str, args, kwargs):
-        """Start a streaming call: the target must return a (async)
-        generator/iterable; chunks are pulled with stream_next (ray:
-        serve streaming responses via ObjectRefGenerator — here a
-        replica-pinned pull protocol over the actor transport)."""
+    async def handle_request_stream(self, method: str, args, kwargs):
+        """Streaming call: the target must return a (async) generator or
+        iterable; items ride the core streaming-generator transport
+        (num_returns="streaming" → ObjectRefGenerator), matching ray:
+        serve's ObjectRefGenerator-backed streaming responses."""
         import inspect as _inspect
 
         self._ongoing += 1
@@ -64,73 +62,20 @@ class ReplicaActor:
             if _inspect.iscoroutine(result):
                 result = await result
             if _inspect.isasyncgen(result):
-                it = result
+                async for item in result:
+                    yield item
             elif hasattr(result, "__iter__") and not isinstance(
                 result, (str, bytes, dict)
             ):
-                it = iter(result)
+                for item in result:
+                    yield item
             else:
                 raise TypeError(
                     f"streaming call to {method!r} returned "
                     f"{type(result).__name__}, expected a generator/iterable"
                 )
-        except BaseException:
+        finally:
             self._ongoing -= 1
-            raise
-        self._stream_seq += 1
-        sid = self._stream_seq
-        self._streams[sid] = it
-        return sid
-
-    async def stream_next(
-        self, sid: int, max_items: int = 8, budget_s: float = 0.5
-    ) -> dict:
-        """Pull up to max_items, returning EARLY once budget_s elapses
-        after the first item — a slow generator yields partial batches
-        promptly instead of blocking a full batch past the client's pull
-        timeout."""
-        import inspect as _inspect
-        import time as _time
-
-        it = self._streams.get(sid)
-        if it is None:
-            return {"items": [], "done": True}
-        items = []
-        done = False
-        t0 = _time.monotonic()
-        try:
-            if _inspect.isasyncgen(it):
-                for _ in range(max_items):
-                    try:
-                        items.append(await it.__anext__())
-                    except StopAsyncIteration:
-                        done = True
-                        break
-                    if _time.monotonic() - t0 > budget_s:
-                        break
-            else:
-                for _ in range(max_items):
-                    try:
-                        items.append(next(it))
-                    except StopIteration:
-                        done = True
-                        break
-                    if _time.monotonic() - t0 > budget_s:
-                        break
-        except BaseException:
-            self._streams.pop(sid, None)
-            self._ongoing -= 1
-            raise
-        if done:
-            self._streams.pop(sid, None)
-            self._ongoing -= 1
-        return {"items": items, "done": done}
-
-    async def stream_cancel(self, sid: int) -> bool:
-        if self._streams.pop(sid, None) is not None:
-            self._ongoing -= 1
-            return True
-        return False
 
     async def queue_len(self) -> int:
         return self._ongoing

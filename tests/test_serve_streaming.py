@@ -64,8 +64,11 @@ class TestStreaming:
         h = serve.run(Plain.bind(), name="plain_app", route_prefix=None)
         from ray_tpu.core.errors import TaskError
 
+        # the dispatch is lazy (streaming actor call): the type error
+        # surfaces on first iteration, not at call time
+        gen = h.options(stream=True).remote()
         with pytest.raises(Exception, match="expected a generator"):
-            h.options(stream=True).remote()
+            next(gen)
         serve.delete("plain_app")
 
 
