@@ -217,22 +217,48 @@ class Autoscaler:
             if nid not in idle_ids:
                 self._idle_since.pop(nid)
         counts = self._current_counts(state)
+        dead_ids = {
+            n["node_id"] for n in state["nodes"] if not n["alive"]
+        }
         for pn in self.provider.non_terminated_nodes():
-            nid = pn.node_id_hex
-            since = self._idle_since.get(nid)
-            if since is None or now - since < self.config.idle_timeout_s:
+            nids = pn.meta.get("node_ids") or [pn.node_id_hex]
+            if len(nids) > 1 and any(nid in dead_ids for nid in nids):
+                # a partially-dead slice can never serve its gang
+                # resource again: replace it instead of holding it
+                # (billed, counted, unschedulable) forever
+                logger.warning(
+                    "terminating broken slice %s: host(s) dead",
+                    pn.provider_id,
+                )
+                self.provider.terminate_node(pn)
+                counts[pn.node_type] = counts.get(pn.node_type, 1) - 1
+                for nid in nids:
+                    self._idle_since.pop(nid, None)
+                continue
+            # a multi-host provider node (TPU slice) drains only when
+            # EVERY host has been idle past the timeout — a gang resource
+            # with one busy host is a busy slice
+            sinces = [self._idle_since.get(x) for x in nids]
+            if any(
+                s is None or now - s < self.config.idle_timeout_s
+                for s in sinces
+            ):
                 continue
             tc = self._type(pn.node_type)
             if counts.get(pn.node_type, 0) <= tc.min_workers:
                 continue
-            logger.info("draining idle node %s (%s)", nid, pn.node_type)
-            try:
-                await self.gcs.call("drain_node", {"node_id": nid})
-            except Exception:
-                logger.exception("drain_node rpc failed")
+            logger.info(
+                "draining idle node %s (%s)", pn.provider_id, pn.node_type
+            )
+            for nid in nids:
+                try:
+                    await self.gcs.call("drain_node", {"node_id": nid})
+                except Exception:
+                    logger.exception("drain_node rpc failed")
             self.provider.terminate_node(pn)
             counts[pn.node_type] -= 1
-            self._idle_since.pop(nid, None)
+            for nid in nids:
+                self._idle_since.pop(nid, None)
 
 
 def main():

@@ -94,6 +94,7 @@ def start_raylet(
     host: str = "127.0.0.1",
     store_capacity: int = 0,
     node_id: Optional[str] = None,
+    extra_env: Optional[Dict[str, str]] = None,
 ) -> tuple:
     os.makedirs(session_dir, exist_ok=True)
     log = open(os.path.join(session_dir, "raylet.log"), "ab")
@@ -110,8 +111,13 @@ def start_raylet(
     ]
     if node_id:
         cmd += ["--node-id", node_id]
+    env = _control_plane_env()
+    if extra_env:
+        # slice identity for the raylet and its workers (TPU_NAME etc. —
+        # what accelerators/tpu.py turns into slice/head resources)
+        env.update(extra_env)
     proc = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, stderr=log, env=_control_plane_env()
+        cmd, stdout=subprocess.PIPE, stderr=log, env=env
     )
     log.close()
     address = _read_tagged_line(proc, "RAYLET_ADDRESS", 60)

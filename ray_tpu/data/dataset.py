@@ -323,16 +323,70 @@ class Dataset:
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
         """Split into n datasets (per-worker ingest).
 
-        Stays LAZY: sources round-robin into the splits with the pending
-        ops carried along, so each worker's shard streams independently
-        (equal=True materializes — it must count rows)."""
+        equal=False stays LAZY: sources round-robin into the splits with
+        the pending ops carried along, so each worker's shard streams
+        independently.
+
+        equal=True gives every shard EXACTLY total_rows // n rows
+        (extras dropped) — the invariant SPMD train gangs need so all
+        workers see the same batch count.  The plan executes into the
+        OBJECT STORE (distributed, spill-backed) and shards carry lazy
+        row-range slices over those blocks; nothing is concatenated in
+        this process."""
         if equal:
-            ds = self.repartition(n)
-            return [Dataset([r]) for r in ds._execute()]
+            refs = self._execute()
+
+            @ray_tpu.remote
+            def _rows(b):
+                return b.num_rows
+
+            counts = ray_tpu.get(
+                [_rows.remote(r) for r in refs], timeout=600
+            )
+            total = builtins.sum(counts)
+            per = total // n
+
+            def _slice_block(ref, lo, hi):
+                return ray_tpu.get(ref, timeout=600).slice(lo, hi - lo)
+
+            # walk blocks once, assigning contiguous [lo, hi) row ranges
+            shards: List[List[Any]] = [[] for _ in range(n)]
+            block_i, block_off = 0, 0
+            for w in range(n):
+                need = per
+                while need > 0 and block_i < len(refs):
+                    avail = counts[block_i] - block_off
+                    take = min(avail, need)
+                    if take > 0:
+                        shards[w].append(ReadTask(
+                            _slice_block, refs[block_i], block_off,
+                            block_off + take,
+                        ))
+                    need -= take
+                    block_off += take
+                    if block_off >= counts[block_i]:
+                        block_i += 1
+                        block_off = 0
+            return [Dataset(srcs) for srcs in shards]
         out: List[List[Any]] = [[] for _ in range(n)]
         for i, src in enumerate(self._input_refs):
             out[i % n].append(src)
         return [Dataset(srcs, ops=list(self._ops)) for srcs in out]
+
+    def streaming_split(
+        self, n: int, *, equal: bool = False, locality_hints=None
+    ) -> List["DataIterator"]:
+        """n per-worker streaming iterators (ray: Dataset.streaming_split,
+        python/ray/data/dataset.py:1141) — the Train ingest surface.
+
+        Each split streams its shard of source blocks through the pending
+        lazy ops independently on the consuming worker, so ingest is
+        worker-local with no central coordinator; `equal=True`
+        materializes to balance rows exactly (needed when the consumers
+        run in SPMD lockstep and must see the same batch count).
+        locality_hints is accepted for API parity; block placement is
+        store-driven here."""
+        return [DataIterator(ds) for ds in self.split(n, equal=equal)]
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -571,3 +625,39 @@ class GroupedData:
         whole = concat_blocks(self._ds._blocks())
         tbl = whole.group_by(key).aggregate([(key, "count")])
         return Dataset([ray_tpu.put(tbl)])
+
+
+class DataIterator:
+    """Per-worker streaming view of a Dataset split.
+
+    Role-equivalent of ray: python/ray/data/iterator.py (DataIterator,
+    returned by Dataset.streaming_split / passed to Train workers via
+    get_dataset_shard).  Serializable: ships the shard's source refs and
+    pending lazy ops to the consuming worker, which streams blocks from
+    the object store through the ops locally."""
+
+    def __init__(self, dataset: Dataset):
+        self._ds = dataset
+
+    def iter_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self._ds.iter_batches(**kwargs)
+
+    def iter_jax_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        """Device-resident batches with double-buffered transfer — the
+        TPU train-loop ingest path (see Dataset.iter_jax_batches)."""
+        return self._ds.iter_jax_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self._ds.iter_torch_batches(**kwargs)
+
+    def iter_rows(self):
+        return self._ds.iter_rows()
+
+    def materialize(self) -> Dataset:
+        return self._ds.materialize()
+
+    def count(self) -> int:
+        return self._ds.count()
+
+    def __repr__(self):
+        return f"DataIterator({self._ds!r})"

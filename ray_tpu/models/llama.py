@@ -477,3 +477,105 @@ def _pick_token(logits, key, *, temperature):
 def _decode_step(params, tok, cache, start, key, *, config, temperature):
     logits, cache = forward_cached(params, tok, cache, start, config)
     return _pick_token(logits, key, temperature=temperature), cache
+
+
+# -- continuous batching (row-wise positions) --------------------------------
+# Serving batches sequences at DIFFERENT positions: each cache row b has
+# its own length pos[b].  The decode step scatters the new K/V at
+# [b, pos[b]] and masks attention per row — the primitive a continuous
+# batcher needs (reference role: vLLM-on-ray / serve LLM replicas; here
+# one fused XLA step for the whole slot batch).
+
+
+def _block_decode_rowwise(x, p, cache_k, cache_v, pos, config: LlamaConfig):
+    """One block for ONE new token per row.  x: (B, 1, E); pos: (B,)
+    absolute position of the new token in each row."""
+    c = config
+    B = x.shape[0]
+    h = _rmsnorm(x, p["attn_norm"], c.rms_eps)
+    positions = pos[:, None]  # (B, 1)
+    q = _rope(
+        jnp.einsum("bse,ehd->bshd", h, p["wq"].astype(c.dtype)),
+        positions, c.rope_theta,
+    )
+    kk = _rope(
+        jnp.einsum("bse,ekd->bskd", h, p["wk"].astype(c.dtype)),
+        positions, c.rope_theta,
+    )
+    vv = jnp.einsum("bse,ekd->bskd", h, p["wv"].astype(c.dtype))
+    rows = jnp.arange(B)
+    cache_k = cache_k.at[rows, pos].set(kk[:, 0].astype(c.dtype))
+    cache_v = cache_v.at[rows, pos].set(vv[:, 0].astype(c.dtype))
+    # attention over each row's own prefix [0, pos[b]]
+    k_all, v_all = cache_k, cache_v
+    if c.q_per_kv > 1:
+        k_all = jnp.repeat(k_all, c.q_per_kv, axis=2)
+        v_all = jnp.repeat(v_all, c.q_per_kv, axis=2)
+    scores = jnp.einsum(
+        "bqhd,bthd->bhqt", q, k_all, preferred_element_type=jnp.float32
+    ) / math.sqrt(c.head_dim)
+    t_idx = jnp.arange(cache_k.shape[1])
+    mask = t_idx[None, :] <= pos[:, None]  # (B, T)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+    attn = jnp.einsum("bhqt,bthd->bqhd", probs, v_all)
+    x = x + jnp.einsum("bshd,hde->bse", attn, p["wo"].astype(c.dtype))
+    h = _rmsnorm(x, p["mlp_norm"], c.rms_eps)
+    gate = jnp.einsum("bse,em->bsm", h, p["w_gate"].astype(c.dtype))
+    up = jnp.einsum("bse,em->bsm", h, p["w_up"].astype(c.dtype))
+    x = x + jnp.einsum(
+        "bsm,me->bse", jax.nn.silu(gate) * up, p["w_down"].astype(c.dtype)
+    )
+    return x, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step_rowwise(params, tokens, cache, pos, config: LlamaConfig):
+    """One token for every row at per-row positions.
+
+    tokens: (B,) int32 last token per row; pos: (B,) its absolute
+    position.  Returns (logits (B, V) f32, new cache).  Inactive rows
+    simply keep decoding garbage into their own slots — the engine masks
+    them out — so the compiled shape never changes."""
+    c = config
+    x = params["tok_embed"].astype(c.dtype)[tokens][:, None, :]
+
+    def body(carry, layer):
+        xx, _ = carry
+        p, ck, cv = layer
+        xx, ck, cv = _block_decode_rowwise(xx, p, ck, cv, pos, c)
+        return (xx, None), (ck, cv)
+
+    (x, _), (new_k, new_v) = lax.scan(
+        body, (x, None), (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _rmsnorm(x, params["final_norm"], c.rms_eps)
+    logits = jnp.einsum(
+        "be,ve->bv",
+        x[:, -1, :],
+        _head_weight(params, c).astype(c.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": new_k, "v": new_v}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_into_slot(params, tokens, cache, slot, config: LlamaConfig):
+    """Prefill ONE sequence into batched-cache row ``slot``.
+
+    tokens: (1, S) prompt; cache: the engine's (L, B, T, KV, D) batch
+    cache.  Returns (last-token logits (1, V), updated cache).  One
+    compile per prompt-bucket length serves every slot (slot is traced).
+    """
+    sub = {
+        "k": lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
+        "v": lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
+    }
+    logits, sub = forward_cached(params, tokens, sub, jnp.int32(0), config)
+    cache = {
+        "k": lax.dynamic_update_slice_in_dim(cache["k"], sub["k"], slot,
+                                             axis=1),
+        "v": lax.dynamic_update_slice_in_dim(cache["v"], sub["v"], slot,
+                                             axis=1),
+    }
+    return logits, cache

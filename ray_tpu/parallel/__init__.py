@@ -12,6 +12,7 @@ from ray_tpu.parallel.mesh import (  # noqa: F401
     DP_AXIS,
     EP_AXIS,
     FSDP_AXIS,
+    PP_AXIS,
     SP_AXIS,
     TP_AXIS,
     MeshConfig,

@@ -14,11 +14,14 @@ Axis convention (outer → inner, matching physical locality on a pod):
         per layer; gradients reduce-scattered)
   ep    expert parallelism (MoE experts sharded; token dispatch is an
         all_to_all over this axis)
+  pp    pipeline parallelism (layer stages; activations ppermute to the
+        next stage once per microbatch — most latency-tolerant of the
+        model axes)
   sp    sequence/context parallelism (ring attention neighbors — must
         map to an ICI ring)
   tp    tensor/model parallelism (innermost: highest-bandwidth axis)
 
-Any axis may have size 1; the mesh is always constructed with all five
+Any axis may have size 1; the mesh is always constructed with all six
 named axes so sharding rules never need to special-case missing axes.
 """
 
@@ -35,13 +38,14 @@ from jax.sharding import Mesh
 DP_AXIS = "dp"
 FSDP_AXIS = "fsdp"
 EP_AXIS = "ep"
+PP_AXIS = "pp"
 SP_AXIS = "sp"
 TP_AXIS = "tp"
 
 #: Mesh axes ordered outer→inner. dp/fsdp vary slowest (their collectives
 #: tolerate the most latency: once-per-step gradient reductions), tp varies
 #: fastest (per-layer all-gathers/reduce-scatters want nearest neighbors).
-AXIS_ORDER = (DP_AXIS, FSDP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS)
+AXIS_ORDER = (DP_AXIS, FSDP_AXIS, EP_AXIS, PP_AXIS, SP_AXIS, TP_AXIS)
 
 #: Axes over which a gradient psum runs for data parallelism.
 DATA_AXES = (DP_AXIS, FSDP_AXIS)
@@ -59,12 +63,13 @@ class MeshConfig:
     dp: int = -1
     fsdp: int = 1
     ep: int = 1
+    pp: int = 1
     sp: int = 1
     tp: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
         sizes = {"dp": self.dp, "fsdp": self.fsdp, "ep": self.ep,
-                 "sp": self.sp, "tp": self.tp}
+                 "pp": self.pp, "sp": self.sp, "tp": self.tp}
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one mesh axis may be -1, got {wild}")
@@ -83,7 +88,7 @@ class MeshConfig:
 
     @property
     def shape(self) -> tuple:
-        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+        return (self.dp, self.fsdp, self.ep, self.pp, self.sp, self.tp)
 
     def describe(self) -> str:
         return "x".join(
@@ -116,7 +121,7 @@ def make_mesh(
     *,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build the 5-axis mesh over ``devices`` (default: all local devices).
+    """Build the 6-axis mesh over ``devices`` (default: all local devices).
 
     Uses `jax.experimental.mesh_utils` device ordering when available so
     the innermost axes land on physically adjacent chips (ICI neighbors);
@@ -147,4 +152,72 @@ def make_mesh(
         dev_array = np.asarray(devices).reshape(config.shape)
     mesh = Mesh(dev_array, AXIS_ORDER)
     set_current_mesh(mesh)
+    from ray_tpu.parallel import sharding as _sharding
+
+    _sharding.set_active_rules(_sharding.DEFAULT_RULES)
+    return mesh
+
+
+#: Outermost axis of a multi-slice mesh: crosses the data-center network
+#: between TPU slices, so ONLY once-per-step collectives (data-parallel
+#: gradient psums) should map onto it.
+DCN_AXIS = "dcn"
+
+
+def make_multislice_mesh(
+    n_slices: int,
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A dcn x ici product mesh over ``n_slices`` TPU slices.
+
+    The SURVEY §2.5 DCN story (role-equivalent of the reference's
+    hierarchical NCCL topology / MegaScale multi-slice training): the
+    ``dcn`` axis is OUTERMOST — its collectives ride the slower
+    inter-slice fabric exactly once per step (grad psum) while every
+    model axis (fsdp/ep/pp/sp/tp) stays inside a slice on ICI.
+
+    On real multislice hardware, devices group by their
+    ``slice_index``; on a virtual CPU mesh any even partition of the
+    devices validates the compile path.  Use MULTISLICE_RULES (or any
+    rule table mapping "batch" onto ("dcn", "dp", "fsdp")) so the batch
+    splits across slices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    # group by slice when the platform reports one (TPU multislice).  A
+    # mismatch must FAIL, not fall back: reshaping ungrouped devices puts
+    # ICI axes (per-layer tp all-gathers) across the DCN boundary — a
+    # silent order-of-magnitude step-time regression.
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    if len(by_slice) > 1:
+        sizes = {s: len(v) for s, v in by_slice.items()}
+        if len(by_slice) != n_slices or len(set(sizes.values())) != 1:
+            raise ValueError(
+                f"hardware reports {len(by_slice)} slice(s) of sizes "
+                f"{sizes}, but n_slices={n_slices} equal slices were "
+                f"requested — the dcn axis must align with physical "
+                f"slice boundaries"
+            )
+        devices = [d for s in sorted(by_slice) for d in by_slice[s]]
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_slices} slices"
+        )
+    per_slice = len(devices) // n_slices
+    config = (config or MeshConfig()).resolve(per_slice)
+    dev_array = np.asarray(devices[: n_slices * per_slice]).reshape(
+        (n_slices,) + config.shape
+    )
+    mesh = Mesh(dev_array, (DCN_AXIS,) + AXIS_ORDER)
+    set_current_mesh(mesh)
+    # model-internal constrain() calls must see the dcn-aware "batch"
+    # rule, or every constrained activation replicates across slices
+    from ray_tpu.parallel import sharding as _sharding
+
+    _sharding.set_active_rules(_sharding.MULTISLICE_RULES)
     return mesh

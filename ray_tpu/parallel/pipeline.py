@@ -32,7 +32,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-PP_AXIS = "pp"
+from ray_tpu.parallel.mesh import PP_AXIS  # the shared 6-axis mesh's axis
 
 
 def make_pp_mesh(n_stages: int, devices=None) -> Mesh:
@@ -163,3 +163,74 @@ def pipeline_train_step(
 def stage_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for per-stage-stacked params (leading dim over pp)."""
     return NamedSharding(mesh, P(PP_AXIS))
+
+
+def tailed_pipeline_train_step(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    prelude: Callable[[Any, jax.Array], jax.Array],
+    loss_tail: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    optimizer,
+    mesh: Mesh,
+    *,
+    n_micro: int,
+):
+    """Pipeline step for models with non-stage params (embeddings, final
+    norm, lm head) — the shape of a real transformer, composed with the
+    OTHER mesh axes: shard_map is manual over `pp` only, so dp/fsdp/tp
+    shardings on the params keep working through GSPMD's auto
+    propagation (jax partial-manual shard_map, `axis_names={'pp'}`).
+
+    params pytree: {"stages": per-stage-stacked pytree (n_stages,
+    layers_per_stage, ...), "tail": everything else}.
+      prelude(tail, x_micro)     -> activations (n_micro, mb, ...); runs
+                                    replicated on every stage (embedding
+                                    lookup — cheap vs a pp-scatter)
+      stage_fn(stage_slice, h)   -> h for one stage's layers
+      loss_tail(tail, outs, y)   -> scalar on the last stage's outputs
+    """
+    n_stages = mesh.shape[PP_AXIS]
+
+    def sharded_loss(params, x, y):
+        def inner(p, xx, yy):
+            from ray_tpu.parallel import sharding as sharding_mod
+
+            with sharding_mod.no_constraints():
+                h = prelude(p["tail"], xx)
+                outs = pipeline_apply(
+                    stage_fn, p["stages"], h, n_micro=n_micro
+                )
+                idx = lax.axis_index(PP_AXIS)
+                loss = loss_tail(p["tail"], outs, yy)
+            loss = jnp.where(idx == n_stages - 1, loss, 0.0)
+            return lax.psum(loss, PP_AXIS)
+
+        # prefix specs: stages split on the stacked leading dim over pp,
+        # tail replicated across pp; all other axes stay automatic
+        in_specs = (
+            {
+                "stages": jax.tree.map(lambda _: P(PP_AXIS), params["stages"]),
+                "tail": jax.tree.map(lambda _: P(), params["tail"]),
+            },
+            P(),
+            P(),
+        )
+        # check_vma=False: with manual-over-pp only, the vma type checker
+        # feeds the backward pass an HLO 'copy' binop that aborts XLA's
+        # CPU backend (jax 0.9); the pipeline's own pcasts already make
+        # the carry types consistent
+        return jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P(),
+            axis_names=frozenset({PP_AXIS}),
+            check_vma=False,
+        )(params, x, y)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(sharded_loss)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step

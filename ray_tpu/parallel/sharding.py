@@ -24,6 +24,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ray_tpu.parallel.mesh import (
+    DCN_AXIS,
     DP_AXIS,
     EP_AXIS,
     FSDP_AXIS,
@@ -51,12 +52,37 @@ DEFAULT_RULES: Rules = (
     ("expert", EP_AXIS),
 )
 
+#: Multi-slice variant: the batch additionally splits over the dcn axis
+#: (data parallelism across slices — the only collective that should
+#: cross the inter-slice fabric is the once-per-step gradient psum).
+MULTISLICE_RULES: Rules = (
+    ("batch", (DCN_AXIS, DP_AXIS, FSDP_AXIS)),
+) + tuple(r for r in DEFAULT_RULES if r[0] != "batch")
+
+#: Process-wide ACTIVE rule table.  Model-internal constrain() calls
+#: cannot thread an explicit table through every layer, so mesh
+#: construction installs the right one: make_multislice_mesh swaps in
+#: MULTISLICE_RULES (otherwise a "batch" constraint inside a block would
+#: mean "replicated over dcn" and XLA would all-gather activations
+#: across the inter-slice fabric at every layer).
+_active_rules: Rules = DEFAULT_RULES
+
+
+def set_active_rules(rules: Rules) -> None:
+    global _active_rules
+    _active_rules = rules
+
+
+def active_rules() -> Rules:
+    return _active_rules
+
 
 def logical_to_spec(
-    logical: Sequence[Optional[str]], rules: Rules = DEFAULT_RULES
+    logical: Sequence[Optional[str]], rules: Optional[Rules] = None
 ) -> PartitionSpec:
-    """Map a tuple of logical axis names to a PartitionSpec via ``rules``."""
-    table = dict(rules)
+    """Map a tuple of logical axis names to a PartitionSpec via ``rules``
+    (default: the process-wide active table)."""
+    table = dict(rules if rules is not None else _active_rules)
     used = set()
     out = []
     for name in logical:
@@ -80,7 +106,7 @@ def logical_to_spec(
     return PartitionSpec(*out)
 
 
-def tree_shardings(mesh: Mesh, logical_tree, rules: Rules = DEFAULT_RULES):
+def tree_shardings(mesh: Mesh, logical_tree, rules: Optional[Rules] = None):
     """Map a pytree of logical specs to a pytree of NamedShardings."""
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, logical_to_spec(spec, rules)),
@@ -90,12 +116,36 @@ def tree_shardings(mesh: Mesh, logical_tree, rules: Rules = DEFAULT_RULES):
     )
 
 
-def constrain(x, logical: Sequence[Optional[str]], rules: Rules = DEFAULT_RULES):
+_constraints_disabled = False
+
+
+class no_constraints:
+    """Trace-time scope that turns `constrain` into identity.
+
+    Pipeline stages trace under a partial-manual shard_map (manual over
+    `pp` only); sharding constraints on the remaining auto axes are
+    unreliable there — GSPMD propagates layouts from the parameter
+    shardings instead.  Tracing is single-threaded per program, so a
+    module flag (not a contextvar) is sufficient."""
+
+    def __enter__(self):
+        global _constraints_disabled
+        self._prev = _constraints_disabled
+        _constraints_disabled = True
+
+    def __exit__(self, *exc):
+        global _constraints_disabled
+        _constraints_disabled = self._prev
+
+
+def constrain(x, logical: Sequence[Optional[str]], rules: Optional[Rules] = None):
     """with_sharding_constraint by logical names (no-op outside a mesh).
 
     Only the "no mesh in scope" case is treated as identity; genuine
     spec errors (rank mismatch etc.) propagate.
     """
+    if _constraints_disabled:
+        return x
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or getattr(mesh, "empty", True):
         return x

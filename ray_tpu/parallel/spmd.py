@@ -33,10 +33,16 @@ class TrainState(NamedTuple):
 
 
 def batch_sharding(mesh: Mesh, *, shard_seq: bool = False) -> NamedSharding:
-    """Input batch layout: batch dim over (dp, fsdp), optionally seq over sp."""
+    """Input batch layout: batch dim over the data axes (plus dcn,
+    outermost, on a multi-slice mesh), optionally seq over sp."""
+    from ray_tpu.parallel.mesh import DCN_AXIS
+
+    data_axes = tuple(DATA_AXES)
+    if DCN_AXIS in mesh.axis_names:
+        data_axes = (DCN_AXIS,) + data_axes
     if shard_seq:
-        return NamedSharding(mesh, PartitionSpec(DATA_AXES, SP_AXIS))
-    return NamedSharding(mesh, PartitionSpec(DATA_AXES))
+        return NamedSharding(mesh, PartitionSpec(data_axes, SP_AXIS))
+    return NamedSharding(mesh, PartitionSpec(data_axes))
 
 
 def shard_batch(mesh: Mesh, batch, *, shard_seq: bool = False):
@@ -110,7 +116,7 @@ def state_shardings(
     rng,
     param_logical,
     optimizer: optax.GradientTransformation,
-    rules: Rules = DEFAULT_RULES,
+    rules: Optional[Rules] = None,
 ) -> TrainState:
     """Compute the TrainState sharding tree without materializing anything."""
     param_shardings = tree_shardings(mesh, param_logical, rules)
@@ -131,7 +137,7 @@ def sharded_init(
     rng,
     param_logical,
     optimizer: Optional[optax.GradientTransformation] = None,
-    rules: Rules = DEFAULT_RULES,
+    rules: Optional[Rules] = None,
 ) -> TrainState:
     """Initialize params + optimizer state directly into their shardings.
 

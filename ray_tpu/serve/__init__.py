@@ -25,7 +25,12 @@ from ray_tpu.serve.handle import (  # noqa: F401
     DeploymentResponse,
     DeploymentResponseGenerator,
 )
+from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.grpc_proxy import start_grpc_proxy  # noqa: F401
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
 from ray_tpu.serve.schema import (  # noqa: F401
     deploy_config,
     deploy_config_file,

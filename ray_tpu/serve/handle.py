@@ -243,16 +243,21 @@ class DeploymentHandle:
         deployment_name: str,
         method_name: str = "__call__",
         stream: bool = False,
+        multiplexed_model_id: str = "",
     ):
         self._controller = controller
         self._app = app_name
         self._deployment = deployment_name
         self._method = method_name
         self._stream = stream
+        self._model_id = multiplexed_model_id
         self._router = Router(controller, app_name, deployment_name)
 
     def options(
-        self, method_name: Optional[str] = None, stream: Optional[bool] = None
+        self,
+        method_name: Optional[str] = None,
+        stream: Optional[bool] = None,
+        multiplexed_model_id: Optional[str] = None,
     ) -> "DeploymentHandle":
         h = DeploymentHandle(
             self._controller,
@@ -260,11 +265,17 @@ class DeploymentHandle:
             self._deployment,
             method_name if method_name is not None else self._method,
             stream if stream is not None else self._stream,
+            multiplexed_model_id
+            if multiplexed_model_id is not None else self._model_id,
         )
         h._router = self._router  # share routing state
         return h
 
     def remote(self, *args, **kwargs):
+        if self._model_id:
+            from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
+            kwargs = {**kwargs, MODEL_ID_KWARG: self._model_id}
         if self._stream:
             replica = self._router.pick()
             try:

@@ -1,0 +1,222 @@
+"""LLM serving: a continuous-batching decode replica over the Llama
+KV-cache path.
+
+Role-equivalent of ray: serve's LLM deployments (serve/llm, and the
+vLLM-on-ray pattern): N concurrent streaming requests share ONE fixed
+slot batch — new requests prefill into free cache rows while existing
+rows keep decoding (continuous batching), every decode step is one fused
+XLA call over all slots (`llama.decode_step_rowwise`, per-row
+positions), and tokens stream back per request over the core
+streaming-generator transport.
+
+Wire-up::
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LlamaDeployment
+
+    app = LlamaDeployment.options(name="llm").bind(
+        config=my_config, weights_ref=ray_tpu.put(params),
+        max_slots=8, max_len=2048,
+    )
+    h = serve.run(app, name="llm_app")
+    for tok in h.options(method_name="generate", stream=True).remote(
+            prompt_ids, max_new_tokens=64):
+        ...
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+from ray_tpu import serve
+
+
+class _Slot:
+    __slots__ = ("queue", "pos", "remaining", "last_token", "max_pos")
+
+    def __init__(self, queue, pos, remaining, last_token, max_pos):
+        self.queue = queue          # per-request token queue
+        self.pos = pos              # absolute position of last_token
+        self.remaining = remaining  # tokens still to generate
+        self.last_token = last_token
+        self.max_pos = max_pos
+
+
+_END = object()
+
+
+class LLMEngine:
+    """Slot-based continuous batcher: admit-prefill + shared decode step."""
+
+    def __init__(self, params, config, *, max_slots: int = 4,
+                 max_len: int = 256):
+        from ray_tpu.models import llama
+
+        self._llama = llama
+        self.params = params
+        self.config = config
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.cache = llama.init_cache(config, max_slots, max_len)
+        self.slots: List[Optional[_Slot]] = [None] * max_slots
+        self._pending: "asyncio.Queue" = asyncio.Queue()
+        self._runner: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+
+    # -- client side -----------------------------------------------------
+    async def stream(self, prompt: List[int], max_new_tokens: int = 16):
+        """Async generator of generated token ids for one request."""
+        if self._runner is None or self._runner.done():
+            self._runner = asyncio.get_running_loop().create_task(
+                self._run()
+            )
+        q: asyncio.Queue = asyncio.Queue()
+        await self._pending.put((list(prompt), int(max_new_tokens), q))
+        self._wake.set()
+        while True:
+            tok = await q.get()
+            if tok is _END:
+                return
+            if isinstance(tok, Exception):
+                raise tok
+            yield tok
+
+    # -- engine loop -----------------------------------------------------
+    async def _run(self):
+        while True:
+            try:
+                await self._run_inner()
+            except Exception as e:  # noqa: BLE001 — delivered to clients
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "LLM engine step failed; failing active requests"
+                )
+                # fail every active stream and drain pending admissions;
+                # reinitialize the cache (a donated buffer may be stale
+                # after a mid-step failure) and keep serving
+                for i, s in enumerate(self.slots):
+                    if s is not None:
+                        await s.queue.put(e)
+                        await s.queue.put(_END)
+                        self.slots[i] = None
+                while not self._pending.empty():
+                    _, _, q = self._pending.get_nowait()
+                    await q.put(e)
+                    await q.put(_END)
+                self.cache = self._llama.init_cache(
+                    self.config, self.max_slots, self.max_len
+                )
+
+    async def _run_inner(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        llama = self._llama
+        cfg = self.config
+        while True:
+            # admit pending requests into free slots (prefill)
+            while not self._pending.empty() and None in self.slots:
+                prompt, max_new, q = self._pending.get_nowait()
+                slot = self.slots.index(None)
+                S0 = len(prompt)
+                if S0 + max_new > self.max_len or S0 == 0:
+                    await q.put(ValueError(
+                        f"prompt of {S0} tokens + {max_new} new exceeds "
+                        f"max_len {self.max_len}"
+                    ))
+                    await q.put(_END)
+                    continue
+                toks = jnp.asarray([prompt], jnp.int32)
+
+                def _prefill():
+                    return llama.prefill_into_slot(
+                        self.params, toks, self.cache, jnp.int32(slot),
+                        cfg,
+                    )
+
+                logits, self.cache = await asyncio.to_thread(_prefill)
+                first = int(jnp.argmax(logits[0]))
+                await q.put(first)
+                if max_new <= 1:
+                    await q.put(_END)
+                    continue
+                self.slots[slot] = _Slot(
+                    queue=q, pos=S0, remaining=max_new - 1,
+                    last_token=first, max_pos=self.max_len - 1,
+                )
+            active = [i for i, s in enumerate(self.slots) if s is not None]
+            if not active:
+                # idle: park until a request arrives
+                self._wake.clear()
+                if self._pending.empty():
+                    await self._wake.wait()
+                continue
+            # one fused decode step over ALL slots (inactive rows decode
+            # into their own rows harmlessly; shape stays constant)
+            tokens = np.zeros((self.max_slots,), np.int32)
+            pos = np.zeros((self.max_slots,), np.int32)
+            for i, s in enumerate(self.slots):
+                if s is not None:
+                    tokens[i] = s.last_token
+                    pos[i] = s.pos
+
+            def _step(t=tokens, p=pos):
+                return llama.decode_step_rowwise(
+                    self.params, jnp.asarray(t), self.cache,
+                    jnp.asarray(p), cfg,
+                )
+
+            logits, self.cache = await asyncio.to_thread(_step)
+            nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+            for i in active:
+                s = self.slots[i]
+                tok = int(nxt[i])
+                await s.queue.put(tok)
+                s.last_token = tok
+                s.pos += 1
+                s.remaining -= 1
+                if s.remaining <= 0 or s.pos >= s.max_pos:
+                    await s.queue.put(_END)
+                    self.slots[i] = None
+            # let admissions/consumers run between steps
+            await asyncio.sleep(0)
+
+
+@serve.deployment
+class LlamaDeployment:
+    """Decode replica: tiny-config by default, or real weights via a
+    ``weights_ref`` (object-store ref) / ``weights_loader`` callable."""
+
+    def __init__(self, config=None, weights_ref=None, weights_loader=None,
+                 max_slots: int = 4, max_len: int = 256, seed: int = 0):
+        import jax
+
+        from ray_tpu.models import llama
+
+        self.config = config or llama.LlamaConfig.tiny()
+        if weights_ref is not None:
+            import ray_tpu
+
+            params = ray_tpu.get(weights_ref)
+        elif weights_loader is not None:
+            params = weights_loader()
+        else:
+            params = llama.init(jax.random.key(seed), self.config)
+        self.engine = LLMEngine(
+            params, self.config, max_slots=max_slots, max_len=max_len
+        )
+
+    async def generate(self, prompt: List[int], max_new_tokens: int = 16):
+        """Streaming generation (use handle.options(stream=True))."""
+        async for tok in self.engine.stream(prompt, max_new_tokens):
+            yield tok
+
+    async def generate_all(self, prompt: List[int],
+                           max_new_tokens: int = 16) -> List[int]:
+        """Unary convenience: the full generated id list."""
+        return [
+            tok async for tok in self.engine.stream(prompt, max_new_tokens)
+        ]

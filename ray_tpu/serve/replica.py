@@ -33,6 +33,7 @@ class ReplicaActor:
         self._ongoing += 1
         self._total += 1
         try:
+            kwargs = self._apply_multiplex(kwargs)
             if self._is_function:
                 target = self._callable
             else:
@@ -44,6 +45,19 @@ class ReplicaActor:
         finally:
             self._ongoing -= 1
 
+    @staticmethod
+    def _apply_multiplex(kwargs):
+        """Pop the smuggled model id and expose it via the contextvar
+        (ray: serve.get_multiplexed_model_id)."""
+        from ray_tpu.serve import multiplex
+
+        if multiplex.MODEL_ID_KWARG in kwargs:
+            kwargs = dict(kwargs)
+            multiplex.set_multiplexed_model_id(
+                kwargs.pop(multiplex.MODEL_ID_KWARG)
+            )
+        return kwargs
+
     async def handle_request_stream(self, method: str, args, kwargs):
         """Streaming call: the target must return a (async) generator or
         iterable; items ride the core streaming-generator transport
@@ -54,6 +68,7 @@ class ReplicaActor:
         self._ongoing += 1
         self._total += 1
         try:
+            kwargs = self._apply_multiplex(kwargs)
             if self._is_function:
                 target = self._callable
             else:

@@ -16,6 +16,7 @@ from ray_tpu.train.session import (  # noqa: F401
     TrainContext,
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     report,
 )
 from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401

@@ -69,6 +69,7 @@ class BackendExecutor:
         train_fn: Callable[[Dict[str, Any]], Any],
         config: Dict[str, Any],
         latest_checkpoint: Optional[Checkpoint],
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         os.makedirs(self.trial_dir, exist_ok=True)
         self.backend.on_training_start(self.worker_group, self.backend_config)
@@ -77,6 +78,17 @@ class BackendExecutor:
         local_sizes: Dict[str, int] = {}
         for w in wg.workers:
             local_sizes[w.node_id] = local_sizes.get(w.node_id, 0) + 1
+        # `datasets=` ingest: each named dataset is streaming_split across
+        # the gang; worker w receives split[w.rank] and reads it with
+        # train.get_dataset_shard(name) (reference:
+        # data_parallel_trainer.py:52-111 + dataset.py streaming_split).
+        # equal=True: SPMD loops iterate in lockstep, so every worker must
+        # see the same number of batches.
+        shard_table: Dict[str, list] = {}
+        for name, ds in (datasets or {}).items():
+            shard_table[name] = ds.streaming_split(
+                len(wg.workers), equal=len(wg.workers) > 1
+            )
         starts = []
         for w in wg.workers:
             ctx = TrainContext(
@@ -88,9 +100,12 @@ class BackendExecutor:
                 experiment_name=self.experiment_name,
                 trial_dir=self.trial_dir,
             )
+            shards = {
+                name: splits[w.rank] for name, splits in shard_table.items()
+            }
             starts.append(
                 w.actor.start_training.remote(
-                    train_fn, config, ctx, latest_checkpoint
+                    train_fn, config, ctx, latest_checkpoint, shards
                 )
             )
         try:

@@ -60,10 +60,12 @@ class TrainSession:
         context: TrainContext,
         latest_checkpoint: Optional[Checkpoint] = None,
         train_config: Optional[Dict[str, Any]] = None,
+        dataset_shards: Optional[Dict[str, Any]] = None,
     ):
         self.context = context
         self.train_config = train_config or {}
         self.latest_checkpoint = latest_checkpoint
+        self.dataset_shards = dataset_shards or {}
         self.reports: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
@@ -159,3 +161,16 @@ def get_checkpoint() -> Optional[Checkpoint]:
 
 def get_context() -> TrainContext:
     return get_session().context
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's DataIterator for the trainer's `datasets[name]`
+    (reference: ray.train.get_dataset_shard, fed by streaming_split in
+    data_parallel_trainer.py:52-111)."""
+    shards = get_session().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard {name!r}: pass datasets={{{name!r}: ds}} to "
+            f"JaxTrainer (available: {sorted(shards)})"
+        )
+    return shards[name]

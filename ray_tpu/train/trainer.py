@@ -47,6 +47,7 @@ class JaxTrainer:
         run_config: Optional[RunConfig] = None,
         backend_config: Optional[BackendConfig] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self._train_fn = train_loop_per_worker
         self._config = dict(train_loop_config or {})
@@ -54,6 +55,10 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.backend_config = backend_config or JaxConfig()
         self._resume_from = resume_from_checkpoint
+        # Data ingest (reference: data_parallel_trainer.py:52-111
+        # `datasets=` → per-worker streaming_split shards surfaced in the
+        # loop via train.get_dataset_shard)
+        self._datasets = dict(datasets or {})
 
     def fit(self) -> Result:
         failure = self.run_config.failure_config or FailureConfig()
@@ -68,7 +73,8 @@ class JaxTrainer:
             try:
                 executor.start()
                 executor.start_training(
-                    self._train_fn, self._config, latest_checkpoint
+                    self._train_fn, self._config, latest_checkpoint,
+                    datasets=self._datasets,
                 )
                 while True:
                     reports = executor.next_reports()

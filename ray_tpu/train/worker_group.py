@@ -65,11 +65,13 @@ class TrainWorkerActor:
         config: Dict[str, Any],
         context: TrainContext,
         latest_checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[Dict[str, Any]] = None,
     ) -> bool:
         if self._thread is not None and self._thread.is_alive():
             raise RuntimeError("training loop already running on this worker")
         session = TrainSession(
-            context, latest_checkpoint=latest_checkpoint, train_config=config
+            context, latest_checkpoint=latest_checkpoint, train_config=config,
+            dataset_shards=dataset_shards,
         )
         self._session = session
         init_session(session)

@@ -29,12 +29,12 @@ def _clear_mesh():
 
 
 def test_mesh_config_resolve():
-    assert MeshConfig(dp=-1).resolve(8).shape == (8, 1, 1, 1, 1)
-    assert MeshConfig(dp=-1, tp=2).resolve(8).shape == (4, 1, 1, 1, 2)
+    assert MeshConfig(dp=-1).resolve(8).shape == (8, 1, 1, 1, 1, 1)
+    assert MeshConfig(dp=-1, tp=2).resolve(8).shape == (4, 1, 1, 1, 1, 2)
     assert MeshConfig(dp=2, fsdp=2, sp=1, tp=2).resolve(8).shape == (
-        2, 2, 1, 1, 2
+        2, 2, 1, 1, 1, 2
     )
-    assert MeshConfig(dp=2, ep=2, tp=2).resolve(8).shape == (2, 1, 2, 1, 2)
+    assert MeshConfig(dp=2, ep=2, tp=2).resolve(8).shape == (2, 1, 2, 1, 1, 2)
     with pytest.raises(ValueError):
         MeshConfig(dp=3).resolve(8)
     with pytest.raises(ValueError):
@@ -43,7 +43,7 @@ def test_mesh_config_resolve():
 
 def test_make_mesh_axes():
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-    assert mesh.axis_names == ("dp", "fsdp", "ep", "sp", "tp")
+    assert mesh.axis_names == ("dp", "fsdp", "ep", "pp", "sp", "tp")
     assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
 
 

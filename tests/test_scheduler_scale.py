@@ -1,0 +1,151 @@
+"""Scheduler envelope proof: 100 virtual nodes, 2k lease churn.
+
+Makes `core/gcs.py`'s "O(100s) of nodes" docstring claim real: a real
+GCS process, 100 stub raylets (one asyncio connection each, serving
+lease_worker instantly), 2000 request_lease/return_lease cycles at
+bounded concurrency with latency assertions, plus a placement-group
+churn burst over the full node set.  Mirrors the reference's
+many-node scheduler stress tests (ray: test_scheduling.py role) at the
+protocol level — raylet stubs, not processes, because the envelope
+under test is the GCS event loop.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from ray_tpu.common.ids import NodeID, WorkerID
+from ray_tpu.core import node as node_mod
+from ray_tpu.core import rpc
+
+N_NODES = 100
+N_LEASES = 2000
+CONCURRENCY = 64
+
+
+class StubRaylet:
+    """One virtual node: registers with the GCS and grants fake workers."""
+
+    def __init__(self, gcs_address: str, idx: int):
+        self.gcs_address = gcs_address
+        self.idx = idx
+        self.node_id = NodeID.random()
+        self.conn = None
+        self._worker_seq = 0
+
+    async def start(self):
+        self.conn = await rpc.connect(
+            self.gcs_address, self._handle, name=f"stub-raylet-{self.idx}"
+        )
+        await self.conn.call("register_node", {
+            "node_id": self.node_id.binary(),
+            "address": f"10.1.{self.idx // 256}.{self.idx % 256}:7000",
+            "resources": {"CPU": 16.0, "memory": 64e9},
+            "labels": {"stub": "1"},
+        })
+
+    async def _handle(self, conn, method, p):
+        if method == "lease_worker":
+            self._worker_seq += 1
+            return {
+                "worker_id": WorkerID.random().binary(),
+                "worker_addr": f"10.1.0.{self.idx}:{9000 + self._worker_seq}",
+            }
+        if method in ("release_worker", "drain_node", "delete_objects"):
+            return True
+        if method == "ping":
+            return True
+        raise rpc.RpcError(f"stub raylet: unexpected {method!r}")
+
+    async def heartbeat_loop(self):
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                await self.conn.notify(
+                    "heartbeat", {"node_id": self.node_id.binary()}
+                )
+            except Exception:
+                return
+
+
+@pytest.fixture(scope="module")
+def gcs_proc(tmp_path_factory):
+    session = str(tmp_path_factory.mktemp("sched_scale"))
+    proc, address = node_mod.start_gcs(session)
+    yield address
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_100_nodes_2k_lease_churn_latency(gcs_proc):
+    address = gcs_proc
+
+    async def main():
+        stubs = [StubRaylet(address, i) for i in range(N_NODES)]
+        # register in waves to bound connection setup bursts
+        for i in range(0, N_NODES, 20):
+            await asyncio.gather(*(s.start() for s in stubs[i:i + 20]))
+        hb_tasks = [
+            asyncio.get_running_loop().create_task(s.heartbeat_loop())
+            for s in stubs
+        ]
+        client = await rpc.connect(address, name="scale-driver")
+
+        latencies = []
+        sem = asyncio.Semaphore(CONCURRENCY)
+
+        async def one_cycle(i):
+            async with sem:
+                t0 = time.perf_counter()
+                grant = await client.call("request_lease", {
+                    "resources": {"CPU": 1.0},
+                    "strategy": {},
+                }, timeout=60)
+                latencies.append(time.perf_counter() - t0)
+                await client.call(
+                    "return_lease", {"lease_id": grant["lease_id"]}
+                )
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one_cycle(i) for i in range(N_LEASES)))
+        wall = time.perf_counter() - t0
+
+        # placement-group churn across the full node set
+        pg_t0 = time.perf_counter()
+        for i in range(100):
+            pgid = os.urandom(16)
+            await client.call("create_placement_group", {
+                "pg_id": pgid,
+                "bundles": [{"CPU": 2.0}] * 8,
+                "strategy": "SPREAD",
+                "job_id": None,
+            })
+            await client.call("remove_placement_group", {"pg_id": pgid})
+        pg_wall = time.perf_counter() - pg_t0
+
+        for t in hb_tasks:
+            t.cancel()
+        await client.close()
+        for s in stubs:
+            await s.conn.close()
+        return latencies, wall, pg_wall
+
+    latencies, wall, pg_wall = asyncio.run(main())
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    p95 = latencies[int(len(latencies) * 0.95)]
+    rate = N_LEASES / wall
+    print(
+        f"\n100-node churn: {rate:.0f} leases/s, p50={p50 * 1e3:.1f}ms, "
+        f"p95={p95 * 1e3:.1f}ms; PG churn 100 8-bundle PGs in "
+        f"{pg_wall:.2f}s ({100 / pg_wall:.0f}/s)"
+    )
+    assert len(latencies) == N_LEASES
+    # envelope: the control plane must stay interactive at this scale
+    # (bounds are generous for a loaded 1-core CI host)
+    assert p50 < 0.25, f"p50 lease latency {p50:.3f}s"
+    assert p95 < 1.0, f"p95 lease latency {p95:.3f}s"
+    assert rate > 100, f"lease churn rate {rate:.0f}/s"
+    assert pg_wall < 30, f"PG churn too slow: {pg_wall:.1f}s"
