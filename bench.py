@@ -299,47 +299,66 @@ def bench_pg_churn(ray_tpu, duration_s=3.0):
     return _timed_loop(one, duration_s, chunk=10)
 
 
+def _tpu_probe_platform(timeout_s: float = 120.0):
+    """Probe the backend in a short-lived subprocess: "tpu", "cpu" (host
+    simply has no TPU — retrying is futile), or None (probe hung: a
+    degraded axon tunnel, worth retrying).  A hang cannot be
+    interrupted in-process, hence the subprocess."""
+    import subprocess
+    import sys
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM', jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        for line in probe.stdout.splitlines():
+            if line.startswith("PLATFORM "):
+                return line.split(" ", 1)[1].strip()
+        return None
+    except subprocess.TimeoutExpired:
+        return None
+
+
+def _tpu_probe(timeout_s: float = 120.0) -> bool:
+    return _tpu_probe_platform(timeout_s) == "tpu"
+
+
+def _bench_gpt2_cpu_smoke():
+    """CPU fallback row so the bench stays runnable anywhere."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os; os.environ['JAX_PLATFORMS'] = 'cpu'; "
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import bench, json; "
+        "print('@@' + json.dumps(bench.bench_gpt2(scan_unroll=1)))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=900, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("@@"):
+            r = json.loads(line[2:])
+            r["backend_unavailable"] = True
+            return r
+    raise RuntimeError(
+        f"TPU backend wedged and CPU fallback failed: {out.stderr[-500:]}"
+    )
+
+
 def _bench_gpt2_guarded(timeout_s: float = 1500.0):
     """GPT-2 bench in timeboxed SUBPROCESSES: unrolled scan first, then
     the rolled scan (~10%-lower MFU but a known-fast compile).  Both
     attempts are subprocesses because a degraded tunneled backend can
     hang jax init/compile for tens of minutes and a hang cannot be
-    interrupted in-process — the control-plane rows must still run."""
+    interrupted in-process — the control-plane rows must still run.
+    Callers are expected to have probed the backend (_tpu_probe)."""
     import subprocess
     import sys
-
-    # preflight: a degraded axon tunnel can HANG jax init for tens of
-    # minutes; probe device availability in a short-lived subprocess and
-    # drop to the CPU smoke path immediately if the backend is wedged
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('PLATFORM', jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=180,
-        )
-        backend_ok = "PLATFORM" in probe.stdout
-    except subprocess.TimeoutExpired:
-        backend_ok = False
-    if not backend_ok:
-        code = (
-            "import os; os.environ['JAX_PLATFORMS'] = 'cpu'; "
-            "import jax; jax.config.update('jax_platforms', 'cpu'); "
-            "import bench, json; "
-            "print('@@' + json.dumps(bench.bench_gpt2(scan_unroll=1)))"
-        )
-        out = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=900, cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        for line in out.stdout.splitlines():
-            if line.startswith("@@"):
-                r = json.loads(line[2:])
-                r["backend_unavailable"] = True
-                return r
-        raise RuntimeError(
-            f"TPU backend wedged and CPU fallback failed: "
-            f"{out.stderr[-500:]}"
-        )
 
     last_err = None
     # first attempt: bench_gpt2's own default (full unroll); fallback:
@@ -369,23 +388,23 @@ def _bench_gpt2_guarded(timeout_s: float = 1500.0):
 
 
 def main():
-    # 1) TPU compute first (pure jax; no cluster yet).
+    # 1) TPU compute first (pure jax; no cluster yet).  The tunneled
+    # backend flakes for long stretches, so the TPU row gets a bounded
+    # RETRY WINDOW: if the first probe fails, the control-plane family
+    # runs first (productive use of the wait) and the TPU attempt
+    # repeats with backoff until the window closes — only then does the
+    # row fall back to the CPU smoke number.
+    retry_window_s = float(
+        os.environ.get("RT_BENCH_TPU_RETRY_WINDOW_S", "1800")
+    )
+    t_start = time.monotonic()
     gpt2_stats = None
-    try:
-        gpt2_stats = _bench_gpt2_guarded()
-        emit(
-            "gpt2_124m_train_tokens_per_sec_per_chip"
-            if gpt2_stats["on_tpu"]
-            else "gpt2_tiny_train_tokens_per_sec_cpu_smoke",
-            gpt2_stats["tokens_per_sec_per_chip"],
-            "tokens/s/chip",
-            device=gpt2_stats["device"],
-            mfu=round(gpt2_stats["mfu"], 4) if gpt2_stats["mfu"] else None,
-            step_ms=round(gpt2_stats["step_ms"], 2),
-        )
-    except Exception as e:  # noqa: BLE001 — record, keep benching
-        emit("gpt2_124m_train_tokens_per_sec_per_chip", 0.0, "tokens/s/chip",
-             error=repr(e))
+    gpt2_err = None
+    if _tpu_probe():
+        try:
+            gpt2_stats = _bench_gpt2_guarded()
+        except Exception as e:  # noqa: BLE001 — retried after the family
+            gpt2_err = e
 
     # 2) Control-plane family on a local cluster.
     import ray_tpu
@@ -410,6 +429,49 @@ def main():
                 emit(name, 0.0, unit, error=repr(e))
     finally:
         ray_tpu.shutdown()
+
+    # 3) TPU retry loop: keep probing (with backoff) until the window
+    # closes; one recovered probe is enough to capture the real row.  A
+    # probe answering "cpu" means the host HAS no TPU — stop retrying
+    # immediately instead of burning the window.
+    while gpt2_stats is None or not gpt2_stats.get("on_tpu", False):
+        remaining = retry_window_s - (time.monotonic() - t_start)
+        if remaining <= 0:
+            break
+        plat = _tpu_probe_platform(timeout_s=min(120.0, max(30.0, remaining)))
+        if plat == "tpu":
+            try:
+                gpt2_stats = _bench_gpt2_guarded(
+                    timeout_s=max(600.0, remaining)
+                )
+                gpt2_err = None
+                continue
+            except Exception as e:  # noqa: BLE001
+                gpt2_err = e
+        elif plat is not None:
+            break  # CPU-only host: the smoke row below is the answer
+        remaining = retry_window_s - (time.monotonic() - t_start)
+        if remaining > 0:
+            time.sleep(min(90.0, remaining))
+    if gpt2_stats is None:
+        try:
+            gpt2_stats = _bench_gpt2_cpu_smoke()
+        except Exception as e:  # noqa: BLE001
+            gpt2_err = gpt2_err or e
+    if gpt2_stats is not None:
+        emit(
+            "gpt2_124m_train_tokens_per_sec_per_chip"
+            if gpt2_stats["on_tpu"]
+            else "gpt2_tiny_train_tokens_per_sec_cpu_smoke",
+            gpt2_stats["tokens_per_sec_per_chip"],
+            "tokens/s/chip",
+            device=gpt2_stats["device"],
+            mfu=round(gpt2_stats["mfu"], 4) if gpt2_stats["mfu"] else None,
+            step_ms=round(gpt2_stats["step_ms"], 2),
+        )
+    else:
+        emit("gpt2_124m_train_tokens_per_sec_per_chip", 0.0,
+             "tokens/s/chip", error=repr(gpt2_err))
 
     # Headline (FINAL line — the driver parses this one).
     if gpt2_stats and gpt2_stats["on_tpu"]:

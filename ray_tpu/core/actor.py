@@ -14,15 +14,20 @@ from ray_tpu.core.remote_function import _build_resources, _strategy_dict
 
 
 class ActorMethod:
-    __slots__ = ("_handle", "_name", "_num_returns")
+    __slots__ = ("_handle", "_name", "_num_returns", "_concurrency_group")
 
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1,
+                concurrency_group: Optional[str] = None) -> "ActorMethod":
+        return ActorMethod(
+            self._handle, self._name, num_returns, concurrency_group
+        )
 
     def bind(self, *args):
         """Lazy DAG binding (ray: python/ray/dag/class_node.py).  Returns
@@ -41,6 +46,7 @@ class ActorMethod:
             kwargs,
             num_returns=self._num_returns,
             retries=self._handle._max_task_retries,
+            concurrency_group=self._concurrency_group,
         )
         if self._num_returns == "streaming":
             return refs  # an ObjectRefGenerator
@@ -116,8 +122,23 @@ class ActorClass:
             strategy=_strategy_dict(o.get("scheduling_strategy")),
             runtime_env=o.get("runtime_env"),
             max_concurrency=o.get("max_concurrency"),
+            concurrency_groups=o.get("concurrency_groups"),
+            method_groups=self._method_groups(),
         )
         return ActorHandle(actor_id, max_task_retries)
+
+    def _method_groups(self):
+        """Per-method concurrency-group assignments declared with
+        @ray_tpu.method(concurrency_group=...)."""
+        out = {}
+        for name in dir(self._cls):
+            if name.startswith("__"):
+                continue
+            m = getattr(self._cls, name, None)
+            opts = getattr(m, "__rt_method_opts__", None)
+            if opts and opts.get("concurrency_group"):
+                out[name] = opts["concurrency_group"]
+        return out
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

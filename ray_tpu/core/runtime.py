@@ -1362,6 +1362,8 @@ class Runtime:
         strategy=None,
         runtime_env=None,
         max_concurrency=None,
+        concurrency_groups=None,
+        method_groups=None,
     ) -> "ActorID":
         actor_id = ActorID.random()
         rtenv_desc = self._normalize_runtime_env(runtime_env)
@@ -1373,6 +1375,13 @@ class Runtime:
         }
         if max_concurrency is not None:
             creation_spec["max_concurrency"] = int(max_concurrency)
+        if concurrency_groups:
+            # named groups with per-group limits (reference:
+            # python/ray/actor.py:521-539 concurrency_groups)
+            creation_spec["concurrency_groups"] = {
+                str(k): int(v) for k, v in concurrency_groups.items()
+            }
+            creation_spec["method_groups"] = dict(method_groups or {})
         resources = dict(resources if resources is not None else {"CPU": 1})
         reply = self._run(
             self.gcs.call(
@@ -1500,6 +1509,7 @@ class Runtime:
         kwargs,
         num_returns: int = 1,
         retries: int = 0,
+        concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
         task_id = TaskID.random()
         aid = actor_id.binary()
@@ -1520,6 +1530,8 @@ class Runtime:
         }
         if streaming:
             spec["streaming"] = True
+        if concurrency_group:
+            spec["concurrency_group"] = concurrency_group
         return_ids = [
             ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
         ]

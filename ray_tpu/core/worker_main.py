@@ -44,6 +44,8 @@ class WorkerServer:
         self._actor_is_async = False
         self._actor_sem: Optional[asyncio.Semaphore] = None
         self._actor_thread_pool = None  # set for threaded sync actors
+        self._concurrency_groups: Dict[str, dict] = {}  # name -> sem/pool
+        self._method_groups: Dict[str, str] = {}  # method -> group name
         self._running_task_threads: Dict[bytes, int] = {}  # task_id -> thread id
         self._running_tasks: Dict[bytes, dict] = {}  # task_id -> descriptor
         self._cancelled: set = set()
@@ -348,6 +350,23 @@ class WorkerServer:
             self._actor_thread_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=mc, thread_name_prefix="actor-mc"
             )
+        # Named concurrency groups (reference: python/ray/actor.py:521-539):
+        # each group gets its own limit — a semaphore for async methods, a
+        # thread pool for sync ones — so saturating one group never blocks
+        # another.  Method→group defaults come from @method(
+        # concurrency_group=...); per-call .options() overrides.
+        self._concurrency_groups = {}
+        self._method_groups = dict(spec.get("method_groups") or {})
+        for gname, limit in (spec.get("concurrency_groups") or {}).items():
+            import concurrent.futures
+
+            self._concurrency_groups[gname] = {
+                "sem": asyncio.Semaphore(limit),
+                "pool": concurrent.futures.ThreadPoolExecutor(
+                    max_workers=limit,
+                    thread_name_prefix=f"actor-cg-{gname}",
+                ),
+            }
         loop = asyncio.get_running_loop()
         self.actor_instance = await loop.run_in_executor(
             self._exec, lambda: cls(*args, **kwargs)
@@ -473,6 +492,22 @@ class WorkerServer:
 
         reply_fut: asyncio.Future = asyncio.get_running_loop().create_future()
         cs["inflight"][tid] = reply_fut
+        # concurrency group: explicit per-call choice, else the method's
+        # declared group, else the default (flat) limits.  An unknown
+        # name is an ERROR — silently falling back would strip the limit
+        # the caller asked for (the reference raises too).
+        gname = spec.get("concurrency_group") or self._method_groups.get(
+            spec["method"]
+        )
+        cg = self._concurrency_groups.get(gname) if gname else None
+        if gname and cg is None:
+            return self._error_reply(
+                ValueError(
+                    f"unknown concurrency group {gname!r}; declared "
+                    f"groups: {sorted(self._concurrency_groups)}"
+                ),
+                spec,
+            )
         try:
             if spec.get("streaming"):
                 try:
@@ -482,7 +517,8 @@ class WorkerServer:
                 else:
                     reply = await self._run_streaming(
                         conn, spec, method, args, kwargs,
-                        self._actor_thread_pool or self._exec,
+                        (cg["pool"] if cg else None)
+                        or self._actor_thread_pool or self._exec,
                     )
             elif inspect.iscoroutinefunction(method):
                 try:
@@ -490,7 +526,7 @@ class WorkerServer:
                 except Exception as e:
                     reply = self._error_reply(e, spec)
                 else:
-                    async with self._actor_sem:
+                    async with (cg["sem"] if cg else self._actor_sem):
                         self._running_tasks[tid] = {
                             "task_id": tid.hex(),
                             "name": spec.get("name")
@@ -506,9 +542,14 @@ class WorkerServer:
                         finally:
                             self._running_tasks.pop(tid, None)
             else:
-                reply = self._maybe_execute_inline(method, spec)
+                reply = None if cg else self._maybe_execute_inline(
+                    method, spec
+                )
                 if reply is None:
-                    pool = self._actor_thread_pool or self._exec
+                    pool = (
+                        cg["pool"] if cg
+                        else self._actor_thread_pool or self._exec
+                    )
                     mname = spec["method"]
                     self._sync_exec_inflight += 1
                     t0 = time.perf_counter()

@@ -75,6 +75,86 @@ refresh(); setInterval(refresh, 5000);
 </script></body></html>"""
 
 
+def render_prometheus(metrics: list) -> str:
+    """GCS metric aggregate → Prometheus text exposition format.
+
+    Series keys are json-encoded sorted tag pairs with optional
+    ``|le=...`` / ``|sum`` histogram suffixes; gauges are prefixed
+    ``reporter|`` (kept as a `reporter` label so per-process values stay
+    distinct under aggregation)."""
+    import json as _json
+    import re
+
+    def sanitize(name: str) -> str:
+        return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+    def labels(tags_json: str, extra: dict) -> str:
+        try:
+            pairs = dict(tuple(p) for p in _json.loads(tags_json))
+        except Exception:
+            pairs = {}
+        pairs.update(extra)
+        if not pairs:
+            return ""
+        def esc(v) -> str:
+            return (
+                str(v)
+                .replace("\\", "\\\\")
+                .replace("\n", "\\n")
+                .replace('"', '\\"')
+            )
+
+        inner = ",".join(
+            f'{sanitize(k)}="{esc(v)}"' for k, v in sorted(pairs.items())
+        )
+        return "{" + inner + "}"
+
+    out = []
+    for m in metrics:
+        name = sanitize(m["name"])
+        mtype = m["type"]
+        if m.get("description"):
+            desc = (
+                str(m["description"])
+                .replace("\\", "\\\\")
+                .replace("\n", "\\n")
+            )
+            out.append(f"# HELP {name} {desc}")
+        out.append(f"# TYPE {name} {mtype}")
+        for key, value in sorted(m["series"].items()):
+            extra = {}
+            if mtype == "gauge" and "|" in key:
+                reporter, key = key.split("|", 1)
+                extra["reporter"] = reporter
+            if mtype == "histogram":
+                # suffixes are APPENDED after the json tags, so the LAST
+                # '|' is the real separator (a '|' inside a tag value
+                # must not split the key)
+                tags_json, _, suffix = key.rpartition("|")
+                if suffix.startswith("le="):
+                    le = suffix[3:]
+                    out.append(
+                        f"{name}_bucket"
+                        f"{labels(tags_json, {**extra, 'le': le})} {value}"
+                    )
+                elif suffix == "sum":
+                    out.append(
+                        f"{name}_sum{labels(tags_json, extra)} {value}"
+                    )
+                continue
+            out.append(f"{name}{labels(key, extra)} {value}")
+    # histogram _count = the +Inf bucket, emitted in a second pass
+    for m in metrics:
+        if m["type"] != "histogram":
+            continue
+        name = sanitize(m["name"])
+        for key, value in sorted(m["series"].items()):
+            if key.endswith("|le=+Inf"):
+                tags_json = key.rsplit("|", 1)[0]
+                out.append(f"{name}_count{labels(tags_json, {})} {value}")
+    return "\n".join(out) + "\n"
+
+
 @ray_tpu.remote
 class DashboardActor:
     """Serves the dashboard; runs as a detached actor on the cluster."""
@@ -101,6 +181,7 @@ class DashboardActor:
             app.router.add_get(f"/api/{name}", self._make_list(name))
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/metrics", self._metrics)
+        app.router.add_get("/metrics", self._metrics_prometheus)
         app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/logs", self._logs_index)
@@ -173,6 +254,22 @@ class DashboardActor:
         from ray_tpu.util import state
 
         return self._json(await self._offload(state.get_metrics))
+
+    async def _metrics_prometheus(self, req):
+        """Prometheus text exposition of the GCS metric aggregate
+        (reference role: the per-node metrics agent's /metrics endpoint,
+        ray: dashboard/modules/reporter — here one scrape target for the
+        cluster, point `prometheus.yml` at /metrics)."""
+        from aiohttp import web
+
+        from ray_tpu.util import state
+
+        metrics = await self._offload(state.get_metrics)
+        return web.Response(
+            text=render_prometheus(metrics),
+            content_type="text/plain",
+            charset="utf-8",
+        )
 
     async def _events(self, req):
         from ray_tpu.util import events

@@ -17,6 +17,13 @@ from ray_tpu.rllib.impala import (  # noqa: F401
     IMPALALearner,
     vtrace,
 )
+from ray_tpu.rllib.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rllib.multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rllib.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.offline import (  # noqa: F401
     BC,
     BCConfig,

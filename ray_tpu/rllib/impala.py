@@ -149,12 +149,14 @@ class IMPALA(Algorithm):
     aggregated async sampling, minus the GPU aggregation actors the
     single-learner case doesn't need)."""
 
+    learner_cls = IMPALALearner  # overridden by APPO
+
     def _setup(self, config: IMPALAConfig):
         import ray_tpu
 
         spaces = probe_env_spaces(config.env, config.env_to_module)
         self.module_config = build_module_config(config, spaces)
-        self.learner = IMPALALearner(config, self.module_config)
+        self.learner = self.learner_cls(config, self.module_config)
         self.env_runner_group = EnvRunnerGroup(
             config.env,
             self.module_config,

@@ -1,0 +1,257 @@
+"""SAC (discrete): twin soft-Q critics, categorical policy, learned
+temperature.
+
+Role-equivalent of ray: rllib/algorithms/sac/sac.py (SACConfig, SAC) in
+its discrete-action form (Christodoulou 2019, arXiv:1910.07207), on this
+stack's replay-based shapes (shared with DQN): sample → store → replay
+→ one jit'd soft actor-critic update → polyak target sync.
+
+Discrete SAC computes exact expectations over actions (no
+reparameterization): soft state value
+V(s) = Σ_a π(a|s)[min(Q1t, Q2t)(s, a) − α log π(a|s)], critic targets
+y = r + γ(1−d)V(s'), actor loss E_s Σ_a π(a|s)[α log π(a|s) − minQ(s,a)],
+and α is trained toward a target entropy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib import core
+from ray_tpu.rllib.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    build_module_config,
+    probe_env_spaces,
+)
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+
+@dataclasses.dataclass
+class SACConfig(AlgorithmConfig):
+    lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.01             # polyak factor for target critics
+    buffer_size: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    updates_per_env_step: float = 1.0
+    target_entropy_scale: float = 0.5  # H_target = scale * log(|A|)
+    initial_alpha: float = 1.0
+    grad_clip: float = 10.0
+    hidden: tuple = (64, 64)
+    rollout_fragment_length: int = 16
+
+
+class SACLearner:
+    """params = {"pi", "q1", "q2", "q1_t", "q2_t", "log_alpha"} — three
+    independent MLP modules (the value heads of the Q nets are unused)."""
+
+    def __init__(self, config: SACConfig, module_config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        self.module_config = module_config
+        self._fwd = core.get_forward(module_config)
+        ks = jax.random.split(jax.random.key(config.seed), 3)
+        pi = core.module_init(ks[0], module_config)
+        q1 = core.module_init(ks[1], module_config)
+        q2 = core.module_init(ks[2], module_config)
+        self.params = {
+            "pi": pi, "q1": q1, "q2": q2,
+            "q1_t": jax.tree.map(jnp.copy, q1),
+            "q2_t": jax.tree.map(jnp.copy, q2),
+            "log_alpha": jnp.log(jnp.float32(config.initial_alpha)),
+        }
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr),
+        )
+        trainable = {k: self.params[k] for k in ("pi", "q1", "q2")}
+        self.opt_state = self.optimizer.init(trainable)
+        self.alpha_opt = optax.adam(config.alpha_lr)
+        self.alpha_opt_state = self.alpha_opt.init(self.params["log_alpha"])
+        self.target_entropy = config.target_entropy_scale * float(
+            np.log(module_config.num_actions)
+        )
+        self._update = jax.jit(self._build_update())
+
+    def _q(self, qparams, obs):
+        return self._fwd(qparams, obs)[0]  # logits head read as Q values
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        c = self.config
+
+        def losses(trainable, frozen, batch):
+            pi, q1, q2 = trainable["pi"], trainable["q1"], trainable["q2"]
+            q1_t, q2_t = frozen["q1_t"], frozen["q2_t"]
+            alpha = jnp.exp(frozen["log_alpha"])
+            obs, nobs = batch["obs"], batch["next_obs"]
+            B = obs.shape[0]
+            a = batch["actions"]
+
+            # critic targets from the CURRENT policy at s'
+            nlogits, _ = self._fwd(pi, nobs)
+            nlogp = jax.nn.log_softmax(nlogits)
+            nprobs = jnp.exp(nlogp)
+            minq_t = jnp.minimum(self._q(q1_t, nobs), self._q(q2_t, nobs))
+            v_next = (nprobs * (minq_t - alpha * nlogp)).sum(-1)
+            y = jax.lax.stop_gradient(
+                batch["rewards"]
+                + c.gamma * (1.0 - batch["dones"]) * v_next
+            )
+            q1_sa = jnp.take_along_axis(
+                self._q(q1, obs), a[:, None], axis=1
+            )[:, 0]
+            q2_sa = jnp.take_along_axis(
+                self._q(q2, obs), a[:, None], axis=1
+            )[:, 0]
+            critic = 0.5 * (
+                ((q1_sa - y) ** 2).mean() + ((q2_sa - y) ** 2).mean()
+            )
+
+            # actor: expected soft value under π at s (critics frozen)
+            logits, _ = self._fwd(pi, obs)
+            logp = jax.nn.log_softmax(logits)
+            probs = jnp.exp(logp)
+            minq = jax.lax.stop_gradient(
+                jnp.minimum(self._q(q1, obs), self._q(q2, obs))
+            )
+            actor = (probs * (alpha * logp - minq)).sum(-1).mean()
+            entropy = -(probs * logp).sum(-1).mean()
+            return critic + actor, {
+                "critic_loss": critic,
+                "actor_loss": actor,
+                "entropy": entropy,
+                "alpha": alpha,
+            }
+
+        def update(params, opt_state, alpha_opt_state, batch):
+            trainable = {k: params[k] for k in ("pi", "q1", "q2")}
+            frozen = {k: params[k] for k in ("q1_t", "q2_t", "log_alpha")}
+            (_, metrics), grads = jax.value_and_grad(
+                losses, has_aux=True
+            )(trainable, frozen, batch)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, trainable
+            )
+            trainable = optax.apply_updates(trainable, updates)
+
+            # temperature toward the target entropy: α grows while the
+            # policy is below target entropy, shrinks above it
+            def alpha_loss(log_alpha):
+                return log_alpha * jax.lax.stop_gradient(
+                    metrics["entropy"] - self.target_entropy
+                )
+
+            agrad = jax.grad(alpha_loss)(params["log_alpha"])
+            aupd, alpha_opt_state = self.alpha_opt.update(
+                agrad, alpha_opt_state
+            )
+            log_alpha = optax.apply_updates(params["log_alpha"], aupd)
+
+            # polyak critic-target sync
+            tau = c.tau
+            new = dict(trainable)
+            new["q1_t"] = jax.tree.map(
+                lambda t, s: (1 - tau) * t + tau * s,
+                params["q1_t"], trainable["q1"],
+            )
+            new["q2_t"] = jax.tree.map(
+                lambda t, s: (1 - tau) * t + tau * s,
+                params["q2_t"], trainable["q2"],
+            )
+            new["log_alpha"] = log_alpha
+            return new, opt_state, alpha_opt_state, metrics
+
+        return update
+
+    def update(self, batch) -> Dict[str, Any]:
+        (self.params, self.opt_state, self.alpha_opt_state,
+         metrics) = self._update(
+            self.params, self.opt_state, self.alpha_opt_state, batch
+        )
+        return metrics
+
+
+class SAC(Algorithm):
+    def _setup(self, config: SACConfig):
+        spaces = probe_env_spaces(config.env, config.env_to_module)
+        self.module_config = build_module_config(config, spaces)
+        self.learner = SACLearner(config, self.module_config)
+        self.buffer = ReplayBuffer(config.buffer_size, spaces["obs_dim"])
+        self._rng = np.random.default_rng(config.seed)
+        self.env_runner_group = EnvRunnerGroup(
+            config.env,
+            self.module_config,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed,
+            env_to_module_fn=config.env_to_module,
+        )
+        self._sync()
+
+    def _sync(self):
+        # runners sample from the categorical policy head
+        self.env_runner_group.sync_weights(self.learner.params["pi"])
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.monotonic()
+        # on-policy categorical sampling (no epsilon): SAC's exploration
+        # is the policy's own entropy, held up by the temperature
+        frags = self.env_runner_group.sample(c.rollout_fragment_length)
+        env_steps = 0
+        for frag in frags:
+            T, B = frag["actions"].shape
+            obs = frag["obs"]
+            next_obs = np.concatenate(
+                [obs[1:], frag["final_obs"][None]], axis=0
+            )
+            self.buffer.add_batch(
+                obs.reshape(T * B, -1),
+                frag["actions"].reshape(-1),
+                frag["rewards"].reshape(-1),
+                next_obs.reshape(T * B, -1),
+                frag["dones"].reshape(-1),
+            )
+            env_steps += T * B
+            self._record_returns(frag["episode_returns"])
+        self._total_steps += env_steps
+        stats: Dict[str, Any] = {"env_steps": env_steps}
+        if self.buffer.size >= c.learning_starts:
+            n_updates = max(1, int(env_steps * c.updates_per_env_step))
+            metrics: Dict[str, Any] = {}
+            for _ in range(n_updates):
+                batch = self.buffer.sample(self._rng, c.train_batch_size)
+                metrics = self.learner.update(batch)
+            stats.update({k: float(v) for k, v in metrics.items()})
+            stats["updates"] = n_updates
+            self._sync()
+        stats["iter_time_s"] = time.monotonic() - t0
+        return stats
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.learner.params}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.learner.params = state["params"]
+        self._sync()
+
+    def stop(self) -> None:
+        self.env_runner_group.stop()
+
+
+SACConfig.algo_class = SAC

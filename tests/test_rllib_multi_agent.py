@@ -1,0 +1,130 @@
+"""Multi-agent RL: env contract, per-policy batching, and learning.
+
+Mirrors ray: rllib/env/tests/test_multi_agent_env.py +
+multi-agent learning-regression areas
+(rllib/env/multi_agent_episode.py:33 role).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import MultiAgentEnv, MultiAgentPPOConfig
+
+
+class TwoLeverTeam(MultiAgentEnv):
+    """Two agents; each sees which lever pays this round (obs one-hot of
+    2) and must pull it.  Reward 1 per correct pull; episode length 16.
+    Learnable fast by independent policies; random play averages 0.5."""
+
+    possible_agents = ["a0", "a1"]
+    num_actions = 2
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+        self._t = 0
+        self._good = 0
+
+    def _obs(self):
+        one_hot = np.zeros(2, np.float32)
+        one_hot[self._good] = 1.0
+        return {a: one_hot.copy() for a in self.possible_agents}
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._good = int(self._rng.integers(0, 2))
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        rew = {
+            a: float(action_dict[a] == self._good)
+            for a in self.possible_agents
+        }
+        self._t += 1
+        self._good = int(self._rng.integers(0, 2))
+        done = self._t >= 16
+        term = {a: done for a in self.possible_agents}
+        term["__all__"] = done
+        trunc = {"__all__": False}
+        return self._obs(), rew, term, trunc, {}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestMultiAgentEnvContract:
+    def test_env_shapes(self):
+        env = TwoLeverTeam()
+        obs, _ = env.reset(seed=1)
+        assert set(obs) == {"a0", "a1"}
+        obs2, rew, term, trunc, _ = env.step({"a0": 0, "a1": 1})
+        assert set(rew) == {"a0", "a1"}
+        assert "__all__" in term
+
+
+class TestMultiAgentPPO:
+    def test_two_policies_learn(self, cluster):
+        algo = (
+            MultiAgentPPOConfig()
+            .environment(TwoLeverTeam)
+            .env_runners(num_env_runners=2)
+            .training(lr=5e-3, entropy_coeff=0.001, num_epochs=4,
+                      minibatch_size=64, episodes_per_runner_sample=4)
+            .multi_agent(
+                policies=("left", "right"),
+                policy_mapping_fn=lambda aid: (
+                    "left" if aid == "a0" else "right"
+                ),
+            )
+            .build()
+        )
+        try:
+            first = None
+            best = -1.0
+            result = {}
+            for _ in range(25):
+                result = algo.train()
+                ret = result["episode_return_mean"]
+                if first is None and not np.isnan(ret):
+                    first = ret
+                if not np.isnan(ret):
+                    best = max(best, ret)
+                if best > 28:  # max 32 (16 steps x 2 agents); random ~16
+                    break
+            assert first is not None
+            assert best > 24, (first, best)
+            # both policies actually trained (per-policy metrics present)
+            assert any(k.startswith("left/") for k in result)
+            assert any(k.startswith("right/") for k in result)
+        finally:
+            algo.stop()
+
+    def test_checkpoint_roundtrip(self, cluster, tmp_path):
+        algo = (
+            MultiAgentPPOConfig()
+            .environment(TwoLeverTeam)
+            .env_runners(num_env_runners=1)
+            .training(episodes_per_runner_sample=2)
+            .multi_agent(policies=("p0",))
+            .build()
+        )
+        try:
+            algo.train()
+            path = algo.save(str(tmp_path / "ckpt"))
+            state = algo.get_state()
+            algo.restore(path)
+            import jax
+
+            same = jax.tree.map(
+                lambda a, b: bool(np.allclose(np.asarray(a), np.asarray(b))),
+                state["params"]["p0"], algo.learners["p0"].params,
+            )
+            assert all(jax.tree.leaves(same))
+        finally:
+            algo.stop()
