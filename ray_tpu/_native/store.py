@@ -266,11 +266,15 @@ class ShmStore:
         if v is not None:
             v.release()
 
-    def put(self, object_id: bytes, data) -> None:
-        """Convenience one-shot: create + copy + seal."""
+    def put(self, object_id: bytes, data, *, protect: bool = False) -> None:
+        """Convenience one-shot: create + copy + seal.  ``protect=True``
+        marks the entry as a primary copy BEFORE sealing (sealed+unpinned
+        entries are LRU-evictable the instant the seal lands)."""
         data = memoryview(data).cast("B")
         buf = self.create(object_id, data.nbytes)
         buf[:] = data
+        if protect:
+            self.protect(object_id)
         self.seal(object_id)
 
     # -- read path -------------------------------------------------------

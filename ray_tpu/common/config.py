@@ -83,6 +83,9 @@ D("object_store_auto_cap_bytes", int, 8 * 1024 * 1024 * 1024)
 D("inline_object_max_bytes", int, 100 * 1024)  # small results ride the RPC reply
 D("object_chunk_bytes", int, 16 * 1024 * 1024)  # node-to-node transfer chunk
 
+# --- pip runtime envs (reference: runtime_env/pip.py role)
+D("pip_env_install_timeout_s", float, 600.0)
+
 # --- streaming generator returns (reference: num_returns="streaming")
 D("streaming_backpressure_items", int, 64)  # unacked items before the
 #   producing worker pauses the generator

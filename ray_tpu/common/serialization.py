@@ -75,6 +75,12 @@ def _restore_numpy(a):
     return a
 
 
+def _restore_arrow_table(buf):
+    import pyarrow as pa
+
+    return pa.ipc.open_stream(pa.py_buffer(buf)).read_all()
+
+
 _by_value_checked: set = set()
 
 
@@ -145,6 +151,20 @@ class _Pickler(cloudpickle.CloudPickler):
             import numpy as np
 
             return (_restore_numpy, (np.asarray(obj),))
+        pa = sys.modules.get("pyarrow")
+        if pa is not None and isinstance(obj, pa.Table):
+            # Arrow IPC, not arrow's own pickle: pickling a SLICED table
+            # ships every chunk's entire parent buffer (a 1 MB slice of a
+            # 25 MB block serializes as 25 MB; a shuffle reduce that
+            # concats K slices ships K parents).  The IPC writer trims
+            # buffers to the slice.  The payload rides out-of-band.
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, obj.schema) as w:
+                w.write_table(obj)
+            return (
+                _restore_arrow_table,
+                (pickle.PickleBuffer(sink.getvalue()),),
+            )
         for typ, red in self._custom.items():
             if isinstance(obj, typ):
                 return red(obj)

@@ -363,7 +363,7 @@ _READONLY_RPCS = frozenset({
     "get_autoscaler_state", "list_tasks", "list_objects",
     "metrics_push", "get_metrics", "get_job_info", "get_job_logs",
     "list_jobs", "list_events", "report_event", "get_worker_death_info",
-    "cluster_store_stats",
+    "cluster_store_stats", "dump_worker_stacks",
 })
 
 
@@ -989,6 +989,17 @@ class GcsServer:
         return {"job_id": job_id.binary()}
 
     # ---- workers (register their duplex conns for GCS-initiated pushes)
+    async def rpc_dump_worker_stacks(self, conn, p):
+        """Per-thread Python stacks of a live worker (reference role:
+        dashboard py-spy profiling, reporter/profile_manager.py:83)."""
+        wid = WorkerID(p["worker_id"])
+        wconn = self._worker_conns.get(wid)
+        if wconn is None or wconn.closed:
+            raise rpc.RpcError(f"worker {wid.hex()[:12]} not connected")
+        return await asyncio.wait_for(
+            wconn.call("dump_stacks", {}), timeout=15.0
+        )
+
     async def rpc_register_worker(self, conn, p):
         self._worker_conns[WorkerID(p["worker_id"])] = conn
         return True

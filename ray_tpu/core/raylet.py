@@ -44,6 +44,7 @@ class WorkerEntry:
     addr: Optional[str] = None  # worker's own rpc server address
     bound_env: Optional[Dict[str, str]] = None  # accelerator env, once set
     rtenv_key: str = ""  # runtime-env binding (core/runtime_env.py)
+    venv_key: str = ""   # pip-env interpreter this worker was spawned with
     lease_id: Optional[int] = None
     tpu_chips: tuple = ()
     started_at: float = field(default_factory=time.monotonic)
@@ -101,6 +102,9 @@ class Raylet:
         self._spill_count = 0
         self._restore_count = 0
         self._spill_lock = asyncio.Lock()
+        # pip runtime envs: requirement-hash -> creation lock (venvs live
+        # under session_dir/pip_envs; see _ensure_pip_env)
+        self._pip_env_locks: Dict[str, asyncio.Lock] = {}
 
     # ---- lifecycle -----------------------------------------------------
     async def start(self):
@@ -230,8 +234,11 @@ class Raylet:
             st = self.store.stats()
             cap = st["capacity"] or 1
             if needed_bytes:
-                if needed_bytes > cap:
-                    return 0  # can never fit: don't strip the whole arena
+                # clamp instead of refusing: escalating retries may ask
+                # for more than capacity while the OBJECT still fits —
+                # worst case we spill the whole arena, which is exactly
+                # what a near-capacity create needs
+                needed_bytes = min(needed_bytes, cap)
                 headroom = cap - st["used"]
                 shortfall = needed_bytes - headroom
                 if shortfall <= 0:
@@ -332,15 +339,24 @@ class Raylet:
         except OSError:
             logger.exception("spill restore failed for %s", oid.hex()[:12])
             return False
-        try:
-            self._store_put_new(oid, data)
-        except Exception:
-            # arena full: make room for the restore and retry once
-            await self._maybe_spill(needed_bytes=len(data))
+        placed = False
+        for attempt in range(3):
             try:
                 self._store_put_new(oid, data)
+                placed = True
+                break
             except Exception:
-                return False
+                # arena full: make room (exact size first, then
+                # escalating; _maybe_spill clamps to capacity) — the
+                # pull path treats a failed restore as retryable, but
+                # succeeding here saves the caller a full round trip
+                freed = await self._maybe_spill(
+                    needed_bytes=len(data) * (attempt + 1)
+                )
+                if not freed and attempt:
+                    break
+        if not placed:
+            return False
         self._restore_count += 1
         await self._announce(oid, len(data))
         return True
@@ -386,7 +402,8 @@ class Raylet:
         return out
 
     # ---- worker pool ---------------------------------------------------
-    def _spawn_worker(self) -> WorkerEntry:
+    def _spawn_worker(self, python_exe: Optional[str] = None,
+                      venv_key: str = "") -> WorkerEntry:
         worker_id = WorkerID.random()
         env = dict(os.environ)
         env["RT_WORKER_ID"] = worker_id.hex()
@@ -398,15 +415,89 @@ class Raylet:
         log_path = os.path.join(self.session_dir, f"worker-{worker_id.hex()[:12]}.log")
         logf = open(log_path, "ab")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            [python_exe or sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
             stdout=logf,
             stderr=subprocess.STDOUT,
         )
         logf.close()
-        entry = WorkerEntry(worker_id=worker_id, proc=proc)
+        entry = WorkerEntry(worker_id=worker_id, proc=proc, venv_key=venv_key)
         self.workers[worker_id] = entry
         return entry
+
+    async def _ensure_pip_env(self, rtenv: dict) -> str:
+        """Materialize (once) a virtualenv for a pip runtime env; returns
+        its python executable.  Keyed by the requirement list; creation
+        is lock-serialized and marker-gated, so concurrent leases — and a
+        restarted raylet — reuse one env (reference role:
+        python/ray/_private/runtime_env/pip.py PipProcessor).  The venv
+        uses --system-site-packages so the base image's jax/numpy stay
+        importable; isolation comes from the venv's OWN site-packages
+        shadowing them where the requirements overlap."""
+        import hashlib
+        import json as _json
+
+        reqs = list(rtenv["pip"])
+        key = hashlib.sha256(_json.dumps(reqs).encode()).hexdigest()[:16]
+        root = os.path.join(self.session_dir, "pip_envs", key)
+        python = os.path.join(root, "bin", "python")
+        marker = os.path.join(root, ".ready")
+        if os.path.exists(marker):
+            return python
+        lock = self._pip_env_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            if os.path.exists(marker):
+                return python
+
+            def build():
+                import shutil
+
+                shutil.rmtree(root, ignore_errors=True)
+                os.makedirs(os.path.dirname(root), exist_ok=True)
+                subprocess.run(
+                    [sys.executable, "-m", "venv",
+                     "--system-site-packages", root],
+                    check=True, capture_output=True,
+                    timeout=cfg.pip_env_install_timeout_s,
+                )
+                # When THIS process runs inside a venv (the common
+                # deployment), the child venv's "system site" resolves to
+                # the base interpreter — not to our venv where jax &
+                # friends live.  A .pth appends our site dirs AFTER the
+                # child's own site-packages, so its installed
+                # requirements shadow ours where they overlap.
+                vs = os.path.join(
+                    root, "lib",
+                    f"python{sys.version_info[0]}.{sys.version_info[1]}",
+                    "site-packages",
+                )
+                parents = [
+                    p for p in sys.path if p.endswith("site-packages")
+                ]
+                if parents and os.path.isdir(vs):
+                    with open(os.path.join(vs, "_rt_parent_env.pth"),
+                              "w") as f:
+                        f.write("\n".join(parents) + "\n")
+                r = subprocess.run(
+                    [python, "-m", "pip", "install",
+                     "--no-build-isolation", *reqs],
+                    capture_output=True, text=True,
+                    timeout=cfg.pip_env_install_timeout_s,
+                )
+                if r.returncode != 0:
+                    raise RuntimeError(
+                        f"pip install {reqs} failed: {r.stderr[-800:]}"
+                    )
+                with open(marker, "w") as f:
+                    f.write("ok")
+
+            try:
+                await asyncio.to_thread(build)
+            except Exception as e:
+                raise rpc.RpcError(
+                    f"pip runtime env setup failed: {e}"
+                ) from e
+            return python
 
     async def rpc_worker_ready(self, conn: rpc.Connection, p):
         """A spawned worker reports in with its own server address."""
@@ -502,6 +593,11 @@ class Raylet:
         resources = p["resources"]
         rtenv = p.get("runtime_env")
         rtenv_key = rtenv_mod.descriptor_key(rtenv)
+        venv_python: Optional[str] = None
+        venv_key = ""
+        if rtenv and rtenv.get("pip"):
+            venv_python = await self._ensure_pip_env(rtenv)
+            venv_key = rtenv_key
         n_tpu = int(resources.get("TPU", 0))
         if n_tpu <= 0 and resources.get("TPU", 0) > 0:
             n_tpu = 1
@@ -537,15 +633,23 @@ class Raylet:
                 w = cand
                 break
         if w is None:
-            # fresh workers (no binding yet) can take any env
+            # fresh workers (no binding yet) can take any env — but the
+            # INTERPRETER is fixed at spawn, so a plain worker can never
+            # serve a pip env (nor the reverse)
             pool = self._idle_by_env.get(_env_key(None), [])
+            mismatched = []
             while pool:
                 cand = pool.pop()
+                if cand.venv_key != venv_key:
+                    mismatched.append(cand)
+                    continue
                 if cand.proc.poll() is None and cand.conn and not cand.conn.closed:
                     w = cand
                     break
+            pool.extend(mismatched)
         if w is None:
-            w = self._spawn_worker()
+            w = self._spawn_worker(python_exe=venv_python,
+                                   venv_key=venv_key)
             await self._wait_for_worker(w)
             # worker_ready put the fresh worker in the idle pool; it is being
             # handed out right now, so pull it back out
@@ -659,8 +763,10 @@ class Raylet:
         )
         locations = reply["locations"]
         spilled = reply.get("spilled")
+        had_spill_here = False
         if spilled is not None and spilled["node_id"] == self.node_id.hex():
             # our own disk holds it: restore locally, no network
+            had_spill_here = True
             if await self._restore_from_spill(oid):
                 return True
         elif spilled is not None and spilled["node_id"] not in {
@@ -669,7 +775,10 @@ class Raylet:
             # the spilling node serves fetches straight from its file
             locations = locations + [spilled]
         if not locations:
-            return False
+            # "retry": the directory knows a copy exists (our spill file,
+            # restore transiently failed under arena pressure) — the
+            # caller must NOT treat this as object loss
+            return "retry" if had_spill_here else False
         # Shuffle: under a broadcast (N nodes pulling one seeder's object)
         # each completed pull registers a new location, and randomized
         # source choice spreads the remaining pulls across all replicas —
@@ -693,6 +802,10 @@ class Raylet:
                 continue
         if last_err:
             logger.warning("pull of %s failed: %r", oid.hex()[:12], last_err)
+        if peers or had_spill_here:
+            # a copy is known to exist but this round's transfer/restore
+            # failed (peer mid-restore, arena pressure): retryable
+            return "retry"
         return False
 
     async def _pull_from(self, oid: bytes, loc, all_peers) -> bool:

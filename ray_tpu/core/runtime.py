@@ -344,6 +344,9 @@ class Runtime:
             return True
         if method == "create_actor" and self._worker_server is not None:
             return await self._worker_server.handle_create_actor(payload)
+        if method == "dump_stacks" and self._worker_server is not None:
+            return await self._worker_server._handle(conn, "dump_stacks",
+                                                     payload)
         raise rpc.RpcError(f"unexpected GCS push {method!r}")
 
     _worker_server = None  # set by worker_main for GCS-initiated actor creation
@@ -462,11 +465,33 @@ class Runtime:
             self._shared.add(oid)
             return size
         except StoreFullError:
-            # the arena is packed with protected primaries: ask the raylet
-            # to spill LRU primaries to disk, then retry once
-            if not self._request_spill(size):
+            # The arena is packed with protected primaries: ask the raylet
+            # to spill LRU primaries to disk and retry.  Escalating
+            # requests ride out fragmentation (freed regions merge only
+            # when adjacent) and concurrent writers racing us to the
+            # freed space; the bounded patience window rides out a busy
+            # raylet whose spill pass (fsync per object) is slow under
+            # load — failing a task because disk IO lagged is worse than
+            # waiting.  Only caller/executor threads wait; the io loop
+            # (which cannot block) keeps the single-attempt behavior.
+            buf = None
+            on_loop = threading.current_thread() is self._thread
+            deadline = time.monotonic() + (0 if on_loop else 60.0)
+            mult = 1  # exact size first: a near-arena-sized object must
+            #           not escalate past capacity (the raylet clamps, but
+            #           requesting precisely what fits spills the least)
+            while True:
+                self._request_spill(size * mult)
+                try:
+                    buf = self.store.create(oid, size)
+                    break
+                except StoreFullError:
+                    if time.monotonic() >= deadline:
+                        break
+                    mult = min(mult + 1, 6)
+                    time.sleep(0.25)
+            if buf is None:
                 raise
-            buf = self.store.create(oid, size)
         try:
             s.write_into(buf)
         except BaseException:
@@ -505,7 +530,7 @@ class Runtime:
                 self.raylet.call(
                     "spill_now", {"needed_bytes": needed_bytes}
                 ),
-                timeout=120,
+                timeout=30,
             )
             return bool(freed)
         except Exception:
@@ -809,12 +834,18 @@ class Runtime:
                 {"object_id": oid, "timeout": min(remaining, 30.0)},
                 timeout=min(remaining, 30.0) + 10,
             )
-            if not ok:
+            if not ok or ok == "retry":
                 # last chance: it may have landed locally while we pulled
                 value, found = self._read_from_store(oid)
                 if found:
                     return value
                 failed_pulls += 1
+                if ok == "retry" and failed_pulls < 8:
+                    # a copy exists (spill file / live peer) but this
+                    # round's restore or transfer failed — transient
+                    # arena pressure, NOT object loss; back off and retry
+                    await asyncio.sleep(min(0.2 * failed_pulls, 2.0))
+                    continue
                 # A failed pull already waited a location round: if we own
                 # lineage for the object, re-execute its producing task now
                 # (reference: object_recovery_manager.h:41) — whatever the
@@ -1298,9 +1329,11 @@ class Runtime:
                 self.memory_store[oid] = value
                 if oid in self._escaped and oid not in self._shared:
                     # a borrower is waiting on the shared store: publish the
-                    # raw serialized bytes there now
+                    # raw serialized bytes there now — as a PROTECTED
+                    # primary (an unprotected copy is LRU-evictable and the
+                    # borrower's pull would find nothing)
                     try:
-                        self.store.put(oid, ret[1])
+                        self.store.put(oid, ret[1], protect=True)
                         self._shared.add(oid)
                         self._spawn(
                             self.gcs.notify(

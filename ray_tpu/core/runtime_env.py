@@ -70,16 +70,28 @@ def normalize(
     cached = _normalize_cache.get(cache_key)
     if cached is not None:
         return cached
-    unknown = set(env) - {"env_vars", "working_dir", "py_modules"}
-    if unknown & {"pip", "conda"}:
+    unknown = set(env) - {"env_vars", "working_dir", "py_modules", "pip"}
+    if unknown & {"conda"}:
         raise ValueError(
-            "pip/conda runtime envs are not supported: the image is "
-            "hermetic; bake dependencies into it or ship pure-python code "
-            "via working_dir/py_modules"
+            "conda runtime envs are not supported; use pip=[...] (a "
+            "per-env virtualenv over the base image) or ship pure-python "
+            "code via working_dir/py_modules"
         )
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
     desc: Dict[str, Any] = {}
+    pip = env.get("pip")
+    if pip:
+        # per-env virtualenv (reference: runtime_env/pip.py role): the
+        # RAYLET materializes a venv keyed by the requirement list and
+        # spawns the env's workers with its interpreter (worker reuse is
+        # already partitioned by descriptor_key, so envs never mix)
+        if isinstance(pip, dict):
+            pip = pip.get("packages", [])
+        if not (isinstance(pip, (list, tuple))
+                and all(isinstance(p, str) for p in pip)):
+            raise ValueError("pip must be a list of requirement strings")
+        desc["pip"] = sorted(pip)
     env_vars = env.get("env_vars")
     if env_vars:
         if not all(

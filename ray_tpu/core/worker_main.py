@@ -109,6 +109,22 @@ class WorkerServer:
             return True
         if method == "ping":
             return {"pid": os.getpid(), "actor": bool(self.actor_instance)}
+        if method == "dump_stacks":
+            # on-demand stack capture (reference role: the dashboard's
+            # py-spy integration, dashboard/modules/reporter/
+            # profile_manager.py:83 — here native: every thread's Python
+            # stack, no external profiler binary)
+            import traceback
+
+            frames = sys._current_frames()
+            threads = {t.ident: t.name for t in threading.enumerate()}
+            out = {}
+            for ident, frame in frames.items():
+                name = threads.get(ident, f"thread-{ident}")
+                out[f"{name} ({ident})"] = "".join(
+                    traceback.format_stack(frame)
+                )
+            return {"pid": os.getpid(), "stacks": out}
         if method == "status":
             # live task/actor view for the state API (ray: util/state)
             return {

@@ -182,6 +182,7 @@ class DashboardActor:
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/metrics", self._metrics_prometheus)
+        app.router.add_get("/api/profile/stacks", self._profile_stacks)
         app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/logs", self._logs_index)
@@ -270,6 +271,21 @@ class DashboardActor:
             content_type="text/plain",
             charset="utf-8",
         )
+
+    async def _profile_stacks(self, req):
+        """GET /api/profile/stacks?worker=<hex> — on-demand per-thread
+        stacks of a live worker (py-spy role)."""
+        from ray_tpu.util import state
+
+        worker = req.query.get("worker", "")
+        if not worker:
+            return self._json({"error": "pass ?worker=<hex worker id>"})
+        try:
+            return self._json(
+                await self._offload(lambda: state.worker_stacks(worker))
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            return self._json({"error": repr(e)})
 
     async def _events(self, req):
         from ray_tpu.util import events

@@ -158,10 +158,15 @@ class BlockAccessor:
 
 
 def concat_blocks(blocks: List[Block]) -> Block:
-    blocks = [b for b in blocks if b.num_rows > 0]
-    if not blocks:
+    nonempty = [b for b in blocks if b.num_rows > 0]
+    if not nonempty:
+        # all-empty: keep the SCHEMA (downstream group_by/sort need the
+        # columns even for zero rows — a schemaless table breaks them)
+        for b in blocks:
+            if b.num_columns:
+                return b.slice(0, 0)
         return pa.table({})
-    return pa.concat_tables(blocks, promote_options="default")
+    return pa.concat_tables(nonempty, promote_options="default")
 
 
 def empty_like(block: Optional[Block]) -> Block:

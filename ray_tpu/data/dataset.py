@@ -27,6 +27,7 @@ overlaps the caller's step N compute.
 from __future__ import annotations
 
 import builtins
+import os
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 import numpy as np
@@ -270,38 +271,157 @@ class Dataset:
         return Dataset(refs)
 
     # -- shuffle-boundary ops -------------------------------------------
+    # -- distributed shuffle core ---------------------------------------
+    # Two-stage map/reduce exchange (ray: data/_internal/planner/exchange
+    # push-based shuffle role): a map task splits every input block into
+    # n_out partitions (num_returns=n_out), a reduce task per output
+    # partition merges its pieces.  All block-sized work happens in
+    # worker tasks — the driver never concatenates the dataset, so these
+    # ops scale to datasets far beyond driver memory (blocks spill as
+    # needed).
+
+    def _block_counts(self, refs) -> List[int]:
+        @ray_tpu.remote
+        def _rows(b):
+            return b.num_rows
+
+        return ray_tpu.get([_rows.remote(r) for r in refs], timeout=600)
+
+    @staticmethod
+    def _exchange(refs, n_out: int, map_fn, reduce_fn,
+                  map_args=None) -> "Dataset":
+        """map_fn(block, j_args...) -> tuple of n_out blocks;
+        reduce_fn(*pieces) -> block.  map_args: per-input extra args."""
+        if not refs:
+            return Dataset([])
+
+        @ray_tpu.remote
+        def shuffle_map(block, *args):
+            pieces = tuple(map_fn(block, *args))
+            # num_returns=1 stores the RETURN VALUE as the single object:
+            # unwrap, or the reduce would receive a 1-tuple
+            return pieces if n_out > 1 else pieces[0]
+
+        @ray_tpu.remote
+        def shuffle_reduce(*parts):
+            return reduce_fn(list(parts))
+
+        map_outs = []
+        for i, r in enumerate(refs):
+            args = map_args[i] if map_args is not None else ()
+            out = shuffle_map.options(num_returns=n_out).remote(r, *args)
+            map_outs.append(out if n_out > 1 else [out])
+        return Dataset([
+            shuffle_reduce.remote(*[mo[j] for mo in map_outs])
+            for j in range(n_out)
+        ])
+
     def repartition(self, num_blocks: int) -> "Dataset":
-        blocks = self._blocks()
-        whole = concat_blocks(blocks)
-        total = whole.num_rows
+        """Order-preserving rebalance into num_blocks equal-ish blocks."""
+        refs = self._execute()
+        if not refs:
+            return Dataset([])
+        counts = self._block_counts(refs)
+        total = builtins.sum(counts)
         step = (total + num_blocks - 1) // num_blocks if total else 0
-        out = []
-        for i in range(num_blocks):
-            lo = min(i * step, total)
-            hi = min((i + 1) * step, total)
-            out.append(ray_tpu.put(whole.slice(lo, hi - lo)))
-        return Dataset(out)
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+
+        def cut(block, off):
+            pieces = []
+            for j in range(num_blocks):
+                glo = min(j * step, total)
+                ghi = min((j + 1) * step, total)
+                lo = min(max(glo - off, 0), block.num_rows)
+                hi = min(max(ghi - off, 0), block.num_rows)
+                pieces.append(block.slice(lo, hi - lo))
+            return pieces
+
+        return self._exchange(
+            refs, num_blocks, cut, concat_blocks,
+            map_args=[(int(offsets[i]),) for i in range(len(refs))],
+        )
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        blocks = self._blocks()
-        whole = concat_blocks(blocks)
-        rng = np.random.default_rng(seed)
-        idx = rng.permutation(whole.num_rows)
-        shuffled = whole.take(pa.array(idx))
-        n = max(1, len(blocks))
-        step = (whole.num_rows + n - 1) // n
-        return Dataset(
-            [
-                ray_tpu.put(shuffled.slice(i * step, step))
-                for i in range(n)
+        """Distributed uniform shuffle: rows scatter to random output
+        partitions, each reduce locally permutes its merged rows."""
+        refs = self._execute()
+        if not refs:
+            return Dataset([])
+        n = len(refs)
+        base = seed if seed is not None else int.from_bytes(
+            os.urandom(4), "little"
+        )
+
+        def scatter(block, block_idx):
+            rng = np.random.default_rng((base, 1, block_idx))
+            shard = rng.integers(0, n, block.num_rows)
+            return [
+                block.take(pa.array(np.nonzero(shard == j)[0]))
+                for j in range(n)
             ]
+
+        def merge_permute(parts):
+            whole = concat_blocks(parts)
+            # deterministic per-partition permutation: partition identity
+            # comes from the pieces' total, block_idx is unavailable — a
+            # content-independent stream per reduce is enough for
+            # uniformity given the random scatter
+            rng = np.random.default_rng((base, 2, whole.num_rows))
+            return whole.take(pa.array(rng.permutation(whole.num_rows)))
+
+        return self._exchange(
+            refs, n, scatter, merge_permute,
+            map_args=[(i,) for i in range(n)],
         )
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        whole = concat_blocks(self._blocks())
+        """Distributed range-partitioned sort: sample keys → quantile
+        boundaries → scatter by range → per-partition local sort.  The
+        output blocks are globally ordered."""
+        refs = self._execute()
+        if not refs:
+            return Dataset([])
+        n = len(refs)
         order = "descending" if descending else "ascending"
-        out = whole.sort_by([(key, order)])
-        return Dataset([ray_tpu.put(out)])
+
+        if n == 1:
+            @ray_tpu.remote
+            def sort_one(block):
+                return block.sort_by([(key, order)])
+
+            return Dataset([sort_one.remote(refs[0])])
+
+        @ray_tpu.remote
+        def sample_keys(block, cap=128):
+            vals = block.column(key).to_numpy(zero_copy_only=False)
+            if len(vals) > cap:
+                idx = np.linspace(0, len(vals) - 1, cap).astype(np.int64)
+                vals = vals[idx]
+            return np.sort(vals)
+
+        samples = np.concatenate(
+            ray_tpu.get([sample_keys.remote(r) for r in refs], timeout=600)
+        )
+        samples = np.sort(samples)
+        # n-1 quantile boundaries over the sampled key distribution
+        bounds = samples[np.linspace(
+            0, len(samples) - 1, n + 1
+        ).astype(np.int64)][1:-1] if len(samples) else np.array([])
+
+        def scatter(block):
+            vals = block.column(key).to_numpy(zero_copy_only=False)
+            part = np.searchsorted(bounds, vals, side="right")
+            if descending:
+                part = (n - 1) - part
+            return [
+                block.take(pa.array(np.nonzero(part == j)[0]))
+                for j in range(n)
+            ]
+
+        def merge_sort(parts):
+            return concat_blocks(parts).sort_by([(key, order)])
+
+        return self._exchange(refs, n, scatter, merge_sort)
 
     def union(self, *others: "Dataset") -> "Dataset":
         refs = list(self._execute())
@@ -599,14 +719,42 @@ class GroupedData:
         self._ds = ds
         self._key = key
 
-    def _aggregate(self, aggs: Dict[str, str]) -> Dataset:
-        """aggs: {column: 'sum'|'mean'|'min'|'max'|'count'}"""
-        key = self._key
-        whole = concat_blocks(self._ds._blocks())
-        tbl = whole.group_by(key).aggregate(
-            [(c, k) for c, k in aggs.items()]
+    @staticmethod
+    def _hash_scatter(block, key: str, n: int):
+        """Rows → n partitions by a process-stable hash of the group key
+        (python hash() is salted per process, so crc32 instead)."""
+        from zlib import crc32
+
+        vals = block.column(key).to_pylist()
+        part = np.fromiter(
+            (crc32(repr(v).encode()) % n for v in vals),
+            np.int64, count=len(vals),
         )
-        return Dataset([ray_tpu.put(tbl)])
+        return [
+            block.take(pa.array(np.nonzero(part == j)[0]))
+            for j in range(n)
+        ]
+
+    def _aggregate(self, aggs: Dict[str, str]) -> Dataset:
+        """aggs: {column: 'sum'|'mean'|'min'|'max'|'count'}
+
+        Distributed: hash-partition by the group key (every key lands
+        whole in exactly one partition, so per-partition aggregates are
+        exact), aggregate per partition, no driver concatenation."""
+        key = self._key
+        refs = self._ds._execute()
+        if not refs:
+            return Dataset([])
+        n = len(refs)
+        agg_list = [(c, k) for c, k in aggs.items()]
+
+        def scatter(block):
+            return GroupedData._hash_scatter(block, key, n)
+
+        def merge_agg(parts):
+            return concat_blocks(parts).group_by(key).aggregate(agg_list)
+
+        return Dataset._exchange(refs, n, scatter, merge_agg)
 
     def sum(self, col: str) -> Dataset:
         return self._aggregate({col: "sum"})
@@ -621,10 +769,7 @@ class GroupedData:
         return self._aggregate({col: "max"})
 
     def count(self) -> Dataset:
-        key = self._key
-        whole = concat_blocks(self._ds._blocks())
-        tbl = whole.group_by(key).aggregate([(key, "count")])
-        return Dataset([ray_tpu.put(tbl)])
+        return self._aggregate({self._key: "count"})
 
 
 class DataIterator:

@@ -103,6 +103,9 @@ class MultiAgentEnvRunner:
             obs_d, _ = self._env.reset(seed=self._seed + self._episode)
             # per-agent episode records
             rec: Dict[str, Dict[str, list]] = {}
+            # rewards credited to an agent BEFORE its first action
+            # (late joiners): deferred onto its first recorded step
+            pending_rew: Dict[str, float] = {}
             ep_return = 0.0
             while True:
                 agents = list(obs_d)
@@ -134,16 +137,23 @@ class MultiAgentEnvRunner:
                         r["logp"].append(float(logp[j]))
                         r["values"].append(float(value[j]))
                         # placeholder keeps rewards aligned with actions
-                        # even when the env omits a reward this step
-                        r["rewards"].append(0.0)
+                        # even when the env omits a reward this step;
+                        # deferred pre-action rewards land here
+                        r["rewards"].append(pending_rew.pop(aid, 0.0))
                 obs_d, rew_d, term_d, trunc_d, _ = self._env.step(actions)
                 for aid, rew in rew_d.items():
+                    ep_return += float(rew)
                     if aid in rec and rec[aid]["rewards"]:
                         # credited to the agent's LAST acted step — also
                         # captures late rewards for agents absent from
                         # this step's obs (e.g. terminal team rewards)
                         rec[aid]["rewards"][-1] += float(rew)
-                        ep_return += float(rew)
+                    else:
+                        # reward before the agent's first action (late
+                        # joiner): defer to its first step
+                        pending_rew[aid] = (
+                            pending_rew.get(aid, 0.0) + float(rew)
+                        )
                 terminated = bool(term_d.get("__all__"))
                 truncated = bool(trunc_d.get("__all__"))
                 if terminated or truncated:

@@ -125,6 +125,16 @@ def memory_summary() -> Dict[str, Any]:
     return _call("cluster_store_stats")
 
 
+def worker_stacks(worker_id: str) -> Dict[str, Any]:
+    """Per-thread Python stacks of a live worker, captured on demand
+    (reference role: the dashboard's py-spy stack profiling —
+    dashboard/modules/reporter/profile_manager.py:83).  ``worker_id``
+    is the hex id from list_workers()."""
+    return _call(
+        "dump_worker_stacks", {"worker_id": bytes.fromhex(worker_id)}
+    )
+
+
 # single implementation lives in util.events; re-exported here so the
 # state API surface is complete (ray: list_cluster_events)
 from ray_tpu.util.events import list_events  # noqa: E402,F401

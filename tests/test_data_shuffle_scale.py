@@ -1,0 +1,88 @@
+"""Distributed shuffle ops: map/reduce exchange without driver
+concatenation.
+
+Mirrors ray: data/_internal/planner/exchange (push-based shuffle) at
+the behavioral level: repartition/random_shuffle/sort/groupby run as
+two-stage task exchanges, so a dataset larger than the object store
+(let alone driver memory) flows through — the blocks spill, the driver
+never holds more than metadata.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+STORE_BYTES = 96 * 1024 * 1024
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0, object_store_bytes=STORE_BYTES)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestDistributedShuffleCorrectness:
+    def test_repartition_preserves_order_and_balances(self, cluster):
+        ds = rd.range(1000).repartition(7)
+        assert ds.num_blocks() == 7
+        ids = [r["id"] for r in ds.take_all()]
+        assert ids == list(range(1000))  # order-preserving
+
+    def test_random_shuffle_is_seeded_permutation(self, cluster):
+        ds = rd.range(500)
+        a = [r["id"] for r in ds.random_shuffle(seed=7).take_all()]
+        b = [r["id"] for r in ds.random_shuffle(seed=7).take_all()]
+        c = [r["id"] for r in ds.random_shuffle(seed=8).take_all()]
+        assert sorted(a) == list(range(500))
+        assert a != list(range(500))
+        assert a == b  # deterministic under a seed
+        assert a != c
+
+    def test_sort_globally_ordered_across_blocks(self, cluster):
+        rng = np.random.default_rng(0)
+        vals = rng.permutation(2000)
+        ds = rd.from_items([{"k": int(v)} for v in vals])
+        ds = ds.repartition(6).sort("k")
+        out = [r["k"] for r in ds.take_all()]
+        assert out == sorted(vals.tolist())
+        out_d = [
+            r["k"] for r in rd.from_items(
+                [{"k": int(v)} for v in vals]
+            ).repartition(6).sort("k", descending=True).take_all()
+        ]
+        assert out_d == sorted(vals.tolist(), reverse=True)
+
+    def test_groupby_hash_exchange_is_exact(self, cluster):
+        rows = [{"g": f"key{i % 13}", "x": float(i)} for i in range(1300)]
+        ds = rd.from_items(rows).repartition(5)
+        out = ds.groupby("g").sum("x").take_all()
+        got = {r["g"]: r["x_sum"] for r in out}
+        expect = {}
+        for r in rows:
+            expect[r["g"]] = expect.get(r["g"], 0.0) + r["x"]
+        assert got == expect
+        counts = {
+            r["g"]: r["g_count"]
+            for r in ds.groupby("g").count().take_all()
+        }
+        assert all(v == 100 for v in counts.values()), counts
+
+
+class TestShuffleThroughSmallStore:
+    def test_shuffle_4x_store(self, cluster):
+        # ~200 MB through a 96 MB arena: the exchange's map outputs and
+        # reduce inputs must spill rather than co-reside
+        n_blocks = 25
+        rows_per = 1_000_000  # 8 MB per block of int64
+        ds = rd.range(n_blocks * rows_per).repartition(n_blocks)
+        shuffled = ds.random_shuffle(seed=1)
+        # spot-check totals without materializing in the driver
+        assert shuffled.count() == n_blocks * rows_per
+        s = 0
+        for batch in shuffled.iter_batches(batch_size=500_000):
+            s += int(batch["id"].sum())
+        total = n_blocks * rows_per
+        assert s == total * (total - 1) // 2

@@ -171,3 +171,36 @@ class TestMemorySummary:
         for node_id, st in summary.items():
             assert "error" not in st, st
         del ref  # refcounting frees the shm allocation
+
+
+class TestWorkerStacks:
+    def test_stack_dump_of_running_worker(self, cluster):
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu.util import state
+
+        @ray_tpu.remote
+        class Spinner:
+            def spin_briefly(self):
+                deadline = _time.monotonic() + 3.0
+                while _time.monotonic() < deadline:
+                    _time.sleep(0.01)
+                return True
+
+            def ready(self):
+                return True
+
+        s = Spinner.remote()
+        assert ray_tpu.get(s.ready.remote(), timeout=60)
+        ref = s.spin_briefly.remote()
+        _time.sleep(0.3)
+        workers = state.list_workers()
+        spinner = [w for w in workers if w.get("actor_class") == "Spinner"]
+        assert spinner, workers
+        dump = state.worker_stacks(spinner[0]["worker_id"])
+        assert dump["pid"] == spinner[0]["pid"]
+        joined = "\n".join(dump["stacks"].values())
+        assert "spin_briefly" in joined, joined[-1500:]
+        assert ray_tpu.get(ref, timeout=60)
+        ray_tpu.kill(s)

@@ -26,9 +26,11 @@ class TestNormalize:
         desc = rtenv_mod.normalize({"env_vars": {"A": "1"}}, kv_put=None)
         assert desc == {"env_vars": {"A": "1"}}
 
-    def test_pip_rejected(self):
-        with pytest.raises(ValueError, match="hermetic"):
-            rtenv_mod.normalize({"pip": ["requests"]}, kv_put=None)
+    def test_pip_normalizes_and_conda_rejected(self):
+        desc = rtenv_mod.normalize({"pip": ["b", "a"]}, kv_put=None)
+        assert desc["pip"] == ["a", "b"]  # sorted for a stable env key
+        with pytest.raises(ValueError, match="conda"):
+            rtenv_mod.normalize({"conda": {"x": 1}}, kv_put=None)
 
     def test_unknown_key_rejected(self):
         with pytest.raises(ValueError, match="unknown"):

@@ -1,0 +1,103 @@
+"""pip runtime environments: per-env virtualenvs over the base image.
+
+Mirrors ray: python/ray/_private/runtime_env/pip.py — a task/actor with
+runtime_env={"pip": [...]} runs in a worker whose interpreter is a
+venv (--system-site-packages, so jax/ray_tpu stay importable) with the
+requirements installed; workers are env-keyed so environments never
+mix.  The test installs a LOCAL package directory (offline: --no-index
+works because the requirement is a path).
+"""
+
+import os
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def pkg_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rtpkg") / "rt_test_pkg"
+    (d / "rt_test_pkg").mkdir(parents=True)
+    (d / "rt_test_pkg" / "__init__.py").write_text(
+        "MAGIC = 'pip-env-42'\n"
+    )
+    (d / "pyproject.toml").write_text(textwrap.dedent("""\
+        [build-system]
+        requires = ["setuptools"]
+        build-backend = "setuptools.build_meta"
+
+        [project]
+        name = "rt-test-pkg"
+        version = "0.0.1"
+    """))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestPipRuntimeEnv:
+    def test_task_runs_in_pip_env(self, cluster, pkg_dir):
+        @ray_tpu.remote
+        def probe():
+            import sys
+
+            import rt_test_pkg
+
+            return (rt_test_pkg.MAGIC, sys.prefix != sys.base_prefix)
+
+        magic, in_venv = ray_tpu.get(
+            probe.options(runtime_env={"pip": [pkg_dir]}).remote(),
+            timeout=600,
+        )
+        assert magic == "pip-env-42"
+        assert in_venv, "worker did not run inside a virtualenv"
+
+    def test_plain_worker_lacks_the_package(self, cluster, pkg_dir):
+        @ray_tpu.remote
+        def probe():
+            try:
+                import rt_test_pkg  # noqa: F401
+
+                return "importable"
+            except ImportError:
+                return "absent"
+
+        assert ray_tpu.get(probe.remote(), timeout=120) == "absent"
+
+    def test_env_reuse_same_requirements(self, cluster, pkg_dir):
+        @ray_tpu.remote
+        def pid_and_prefix():
+            import os
+            import sys
+
+            return os.getpid(), sys.prefix
+
+        env = {"pip": [pkg_dir]}
+        a = ray_tpu.get(
+            pid_and_prefix.options(runtime_env=env).remote(), timeout=600
+        )
+        b = ray_tpu.get(
+            pid_and_prefix.options(runtime_env=env).remote(), timeout=600
+        )
+        # same venv (same requirements hash); the worker may even be the
+        # exact same reused process
+        assert a[1] == b[1]
+
+    def test_actor_in_pip_env(self, cluster, pkg_dir):
+        @ray_tpu.remote
+        class Holder:
+            def magic(self):
+                import rt_test_pkg
+
+                return rt_test_pkg.MAGIC
+
+        h = Holder.options(runtime_env={"pip": [pkg_dir]}).remote()
+        assert ray_tpu.get(h.magic.remote(), timeout=600) == "pip-env-42"
+        ray_tpu.kill(h)
