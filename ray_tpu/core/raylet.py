@@ -225,14 +225,20 @@ class Raylet:
     def _spill_path(self, oid: bytes) -> str:
         return os.path.join(self.spill_dir, oid.hex() + ".obj")
 
-    async def _maybe_spill(self, needed_bytes: int = 0) -> int:
+    async def _maybe_spill(self, needed_bytes: int = 0,
+                           object_bytes: int = 0) -> int:
         """Spill LRU primaries until the arena is under the low-water mark
-        (or `needed_bytes` have been freed).  Returns bytes freed."""
+        (or `needed_bytes` have been freed).  Returns bytes freed.
+        ``object_bytes`` (when known) is the size of the single object
+        the caller is trying to place — one that can NEVER fit fails
+        fast instead of stripping the whole arena for nothing."""
         if not cfg.object_spill_enabled:
             return 0
         async with self._spill_lock:
             st = self.store.stats()
             cap = st["capacity"] or 1
+            if object_bytes and object_bytes > cap:
+                return 0
             if needed_bytes:
                 # clamp instead of refusing: escalating retries may ask
                 # for more than capacity while the OBJECT still fits —
@@ -351,7 +357,8 @@ class Raylet:
                 # pull path treats a failed restore as retryable, but
                 # succeeding here saves the caller a full round trip
                 freed = await self._maybe_spill(
-                    needed_bytes=len(data) * (attempt + 1)
+                    needed_bytes=len(data) * (attempt + 1),
+                    object_bytes=len(data),
                 )
                 if not freed and attempt:
                     break
@@ -375,7 +382,10 @@ class Raylet:
 
     async def rpc_spill_now(self, conn, p):
         """Synchronous pressure relief: a client's create just failed."""
-        return await self._maybe_spill(needed_bytes=p.get("needed_bytes", 0))
+        return await self._maybe_spill(
+            needed_bytes=p.get("needed_bytes", 0),
+            object_bytes=p.get("object_bytes", 0),
+        )
 
     # ---- dispatch ------------------------------------------------------
     async def _handle(self, conn: rpc.Connection, method: str, p: Any):
@@ -793,20 +803,26 @@ class Raylet:
         if not peers and self.store.contains(oid):
             return True
         last_err = None
+        transient = had_spill_here
         for loc in peers:
             try:
                 if await self._pull_from(oid, loc, peers):
                     return True
+                # the peer ANSWERED but had nothing to serve: it may be
+                # mid-restore/mid-spill — retryable
+                transient = True
+            except (rpc.ConnectionLost, ConnectionError, OSError) as e:
+                # dead peer with a stale location: NOT retryable — let
+                # the caller fall through to lineage reconstruction
+                last_err = e
+                continue
             except Exception as e:
                 last_err = e
+                transient = True
                 continue
         if last_err:
             logger.warning("pull of %s failed: %r", oid.hex()[:12], last_err)
-        if peers or had_spill_here:
-            # a copy is known to exist but this round's transfer/restore
-            # failed (peer mid-restore, arena pressure): retryable
-            return "retry"
-        return False
+        return "retry" if transient else False
 
     async def _pull_from(self, oid: bytes, loc, all_peers) -> bool:
         """Fetch one object from `loc` (chunked + pipelined when large,

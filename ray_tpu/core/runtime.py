@@ -481,11 +481,14 @@ class Runtime:
             #           not escalate past capacity (the raylet clamps, but
             #           requesting precisely what fits spills the least)
             while True:
-                self._request_spill(size * mult)
+                requested = self._request_spill(size * mult,
+                                                object_bytes=size)
                 try:
                     buf = self.store.create(oid, size)
                     break
                 except StoreFullError:
+                    if requested is None:
+                        break  # no raylet to ask: patience is futile
                     if time.monotonic() >= deadline:
                         break
                     mult = min(mult + 1, 6)
@@ -516,25 +519,29 @@ class Runtime:
         )
         return size
 
-    def _request_spill(self, needed_bytes: int) -> bool:
+    def _request_spill(self, needed_bytes: int,
+                       object_bytes: int = 0):
         """Ask our raylet to spill primaries so a create can proceed.
-        Only usable off the io loop (the call must block); the io-loop
-        contexts that write to the store tolerate failure and retry via
-        the raylet's periodic pressure pass instead."""
+
+        Returns None when requesting is IMPOSSIBLE (no raylet, raylet
+        gone, or called on the io loop, which must not block) — callers
+        stop retrying; True/False report whether the pass freed bytes."""
         if self.raylet is None or getattr(self.raylet, "closed", True):
-            return False
+            return None
         if threading.current_thread() is self._thread:
-            return False
+            return None
         try:
             freed = self._run(
                 self.raylet.call(
-                    "spill_now", {"needed_bytes": needed_bytes}
+                    "spill_now",
+                    {"needed_bytes": needed_bytes,
+                     "object_bytes": object_bytes},
                 ),
                 timeout=30,
             )
             return bool(freed)
         except Exception:
-            return False
+            return None
 
     # ---- puts / gets ---------------------------------------------------
     def put(self, value) -> ObjectRef:

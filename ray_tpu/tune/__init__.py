@@ -8,7 +8,8 @@ ResultGrid.
 """
 
 from ray_tpu.train.session import get_checkpoint, report  # noqa: F401
-from ray_tpu.tune.schedulers import (  # noqa: F401
+from ray_tpu.tune.schedulers import (
+    HyperBandForBOHB,  # noqa: F401
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
@@ -19,6 +20,7 @@ from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
     Searcher,
     TPESearcher,
+    TuneBOHB,
     choice,
     grid_search,
     loguniform,

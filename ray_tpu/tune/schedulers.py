@@ -281,3 +281,14 @@ class MedianStoppingRule:
         median = means[len(means) // 2]
         my_mean = self._sums[trial_id] / self._counts[trial_id]
         return STOP if my_mean < median else CONTINUE
+
+
+class HyperBandForBOHB(AsyncHyperBandScheduler):
+    """BOHB's bandit half (reference: ray.tune.schedulers.HyperBandForBOHB):
+    async HyperBand whose trials are proposed by TuneBOHB's density
+    model instead of random sampling.  Functionally the async-bracket
+    variant is what the reference's implementation reduces to on this
+    stack (trial proposals already arrive sequentially from the
+    searcher, so no bracket-filling coordination is needed)."""
+
+

@@ -247,12 +247,22 @@ class TPESearcher(Searcher):
         assert mode in (None, "min", "max")
         self.metric = metric
         self.mode = mode
-        self.space = param_space
         self.n_startup = n_startup
         self.gamma = gamma
         self.n_candidates = n_candidates
         self.max_trials = max_trials
         self._rng = random.Random(seed)
+        self.set_space(param_space)
+        self._suggested = 0
+        # completed observations: list of (dict path->model-space value, score)
+        self._obs: List[tuple] = []
+        self._pending: Dict[str, Dict[tuple, Any]] = {}
+
+    def set_space(self, param_space: Dict[str, Any]) -> None:
+        """(Re)bind the search space — the Tuner injects its param_space
+        into a searcher constructed without one (reference:
+        set_search_properties)."""
+        self.space = param_space
         leaves = list(_walk(param_space))
         # grid leaves are modeled as categoricals; opaque/sample_from
         # leaves stay random
@@ -268,10 +278,6 @@ class TPESearcher(Searcher):
                 self._dependent.append((path, spec))
             else:
                 self._dims.append((path, spec))
-        self._suggested = 0
-        # completed observations: list of (dict path->model-space value, score)
-        self._obs: List[tuple] = []
-        self._pending: Dict[str, Dict[tuple, Any]] = {}
 
     # -- model-space transforms ---------------------------------------
 
@@ -377,3 +383,19 @@ class TPESearcher(Searcher):
         v = float(result[self.metric])
         score = v if (self.mode or "max") == "max" else -v
         self._obs.append((xs, score))
+
+
+class TuneBOHB(TPESearcher):
+    """BOHB's model-based half (reference: ray.tune.search.bohb.TuneBOHB,
+    built on the BOHB paper's TPE-style KDE sampler).  Pair with
+    HyperBandForBOHB: the scheduler runs successive-halving brackets,
+    this searcher proposes configs from a density model of completed
+    results — together the BOHB algorithm (Falkner et al. 2018).
+
+    Reference-style construction: the space may be omitted and is then
+    injected by the Tuner from its ``param_space``."""
+
+    def __init__(self, space=None, metric=None, mode=None, **kw):
+        super().__init__(space or {}, metric=metric, mode=mode, **kw)
+
+

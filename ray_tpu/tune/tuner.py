@@ -141,6 +141,17 @@ class Tuner:
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         if tc.search_alg is not None:
+            # set_search_properties role: a searcher constructed without a
+            # space/metric inherits the Tuner's (explicit settings win)
+            if not getattr(tc.search_alg, "space", None) and self.param_space:
+                if hasattr(tc.search_alg, "set_space"):
+                    tc.search_alg.set_space(self.param_space)
+                else:
+                    tc.search_alg.space = self.param_space
+            if getattr(tc.search_alg, "metric", None) is None:
+                tc.search_alg.metric = tc.metric
+            if getattr(tc.search_alg, "mode", None) is None:
+                tc.search_alg.mode = tc.mode
             configs = []  # trials come from the searcher, one at a time
         else:
             configs = generate_variants(

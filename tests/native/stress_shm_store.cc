@@ -1,0 +1,140 @@
+// Multi-process stress driver for the shm arena, built to run under
+// AddressSanitizer + UBSan (the repo's TSAN/ASAN-harness role for the
+// one native component; reference analogue: plasma store ASAN CI jobs).
+//
+// N forked workers hammer one arena: create/write/seal/verify/unpin/
+// delete/protect with randomized sizes, while the parent reaps and
+// checks stats invariants.  One worker is SIGKILLed mid-pin to exercise
+// the robust-mutex + dead-client reap path.  Exit 0 = clean under
+// sanitizers.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <signal.h>
+
+extern "C" {
+uint64_t rt_store_min_size();
+void* rt_store_create(const char* path, uint64_t size);
+void* rt_store_attach(const char* path);
+void rt_store_detach(void* h);
+int rt_store_create_object(void* h, const uint8_t* id, uint64_t size,
+                           uint64_t* out_offset);
+int rt_store_seal(void* h, const uint8_t* id);
+int rt_store_abort(void* h, const uint8_t* id);
+int rt_store_get(void* h, const uint8_t* id, uint64_t* off, uint64_t* size);
+int rt_store_contains(void* h, const uint8_t* id);
+int rt_store_unpin(void* h, const uint8_t* id);
+int rt_store_delete(void* h, const uint8_t* id);
+int rt_store_reap(void* h);
+void rt_store_stats(void* h, uint64_t* cap, uint64_t* used, uint64_t* objs,
+                    uint64_t* evs);
+int rt_store_protect(void* h, const uint8_t* id, int on);
+uint64_t rt_store_list_spillable(void* h, uint8_t* ids, uint64_t* sizes,
+                                 uint64_t max_n);
+void* rt_store_base(void* h);
+}
+
+static void make_id(uint8_t* id, int worker, int i) {
+  memset(id, 0, 16);
+  memcpy(id, &worker, sizeof(worker));
+  memcpy(id + 4, &i, sizeof(i));
+}
+
+static int worker_main(const char* path, int worker, int iters,
+                       int kill_self_at) {
+  void* h = rt_store_attach(path);
+  if (!h) { fprintf(stderr, "worker %d: attach failed\n", worker); return 2; }
+  uint8_t* base = static_cast<uint8_t*>(rt_store_base(h));
+  unsigned seed = 1234u + worker;
+  for (int i = 0; i < iters; i++) {
+    uint8_t id[16];
+    make_id(id, worker, i);
+    uint64_t size = 64 + (rand_r(&seed) % (256 * 1024));
+    uint64_t off = 0;
+    int rc = rt_store_create_object(h, id, size, &off);
+    if (rc != 0) continue;  // full / exists: fine under pressure
+    memset(base + off, (worker + i) & 0xff, size);
+    if (i % 7 == 0) { rt_store_abort(h, id); continue; }
+    if (i % 3 == 0) rt_store_protect(h, id, 1);
+    rt_store_seal(h, id);
+    // read back + verify
+    uint64_t goff = 0, gsize = 0;
+    if (rt_store_get(h, id, &goff, &gsize) == 0) {
+      if (gsize != size || base[goff] != ((worker + i) & 0xff) ||
+          base[goff + gsize - 1] != ((worker + i) & 0xff)) {
+        fprintf(stderr, "worker %d: data mismatch at iter %d\n", worker, i);
+        return 3;
+      }
+      if (kill_self_at == i) {
+        // die while HOLDING the pin (and possibly the lock path hot):
+        // the parent's reap must recover the slot
+        _exit(42);
+      }
+      rt_store_unpin(h, id);
+    }
+    if (i % 5 == 0) rt_store_protect(h, id, 0);
+    if (i % 4 == 0) rt_store_delete(h, id);
+    if (i % 11 == 0) {
+      uint8_t ids[16 * 64];
+      uint64_t sizes[64];
+      rt_store_list_spillable(h, ids, sizes, 64);
+    }
+  }
+  rt_store_detach(h);
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  const char* path = argc > 1 ? argv[1] : "/dev/shm/rt_stress_arena";
+  int workers = argc > 2 ? atoi(argv[2]) : 4;
+  int iters = argc > 3 ? atoi(argv[3]) : 400;
+  unlink(path);
+  uint64_t cap = rt_store_min_size() + (48ull << 20);
+  void* h = rt_store_create(path, cap);
+  if (!h) { fprintf(stderr, "create failed\n"); return 1; }
+
+  pid_t pids[64];
+  for (int w = 0; w < workers; w++) {
+    pid_t p = fork();
+    if (p == 0) _exit(worker_main(path, w, iters,
+                                  w == 0 ? iters / 2 : -1));
+    pids[w] = p;
+  }
+  int failures = 0;
+  for (int w = 0; w < workers; w++) {
+    int st = 0;
+    waitpid(pids[w], &st, 0);
+    int code = WIFEXITED(st) ? WEXITSTATUS(st) : 128;
+    if (w == 0) {
+      if (code != 42) { fprintf(stderr, "killer worker exit %d\n", code); failures++; }
+    } else if (code != 0) {
+      fprintf(stderr, "worker %d exit %d\n", w, code);
+      failures++;
+    }
+  }
+  // dead-client recovery: the pin held by the killed worker must reap
+  rt_store_reap(h);
+  uint64_t c, u, o, e;
+  rt_store_stats(h, &c, &u, &o, &e);
+  fprintf(stderr, "stats: cap=%lu used=%lu objs=%lu evs=%lu\n",
+          (unsigned long)c, (unsigned long)u, (unsigned long)o,
+          (unsigned long)e);
+  if (u > c) { fprintf(stderr, "used > capacity!\n"); failures++; }
+  // arena still serviceable after the chaos
+  uint8_t id[16];
+  make_id(id, 999, 1);
+  uint64_t off = 0;
+  if (rt_store_create_object(h, id, 4096, &off) != 0) {
+    fprintf(stderr, "post-chaos create failed\n");
+    failures++;
+  } else {
+    rt_store_seal(h, id);
+  }
+  rt_store_detach(h);
+  unlink(path);
+  return failures ? 1 : 0;
+}

@@ -1,0 +1,53 @@
+"""Sanitizer harness for the C shm arena.
+
+Builds `tests/native/stress_shm_store.cc` together with
+`ray_tpu/_native/shm_store.cc` under AddressSanitizer + UBSan and runs
+a multi-process stress (concurrent create/seal/get/delete/protect, one
+worker SIGKILLed while holding a pin) — the repo's ASAN/race-harness
+role for its one native component (reference analogue: plasma-store
+ASAN CI).  A sanitizer report or invariant violation fails the run.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "ray_tpu", "_native", "shm_store.cc")
+DRIVER = os.path.join(REPO, "tests", "native", "stress_shm_store.cc")
+
+
+@pytest.fixture(scope="module")
+def stress_bin(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("san") / "stress_shm_store")
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", "-pthread",
+         "-fsanitize=address,undefined", "-fno-omit-frame-pointer",
+         DRIVER, SRC, "-o", out],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert build.returncode == 0, build.stderr[-2000:]
+    return out
+
+
+class TestSanitizedArena:
+    def test_multiprocess_stress_clean_under_asan_ubsan(
+        self, stress_bin, tmp_path
+    ):
+        arena = "/dev/shm/rt_stress_" + os.path.basename(str(tmp_path))
+        r = subprocess.run(
+            [stress_bin, arena, "4", "400"],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ,
+                 # abort (nonzero exit) on the first sanitizer report
+                 "ASAN_OPTIONS": "abort_on_error=0:exitcode=99",
+                 "UBSAN_OPTIONS": "halt_on_error=1:exitcode=99"},
+        )
+        sys.stderr.write(r.stderr[-2000:])
+        assert r.returncode == 0, (
+            f"rc={r.returncode}\n{r.stderr[-3000:]}"
+        )
+        assert "ERROR: AddressSanitizer" not in r.stderr
+        assert "runtime error:" not in r.stderr  # UBSan report line

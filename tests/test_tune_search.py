@@ -178,3 +178,31 @@ class TestSearcherEndToEnd:
         grid = tuner.fit()
         best = grid.get_best_result()
         assert best.metrics.get("score", 0) >= 16
+
+
+class TestBOHB:
+    def test_bohb_pair_runs_and_improves(self, cluster):
+        from ray_tpu import tune
+        from ray_tpu.tune import HyperBandForBOHB, TuneBOHB, TuneConfig, Tuner
+
+
+        def objective(config):
+            x = config["x"]
+            for i in range(8):
+                tune.report({"score": -(x - 3.0) ** 2 - i * 0.01})
+
+        tuner = Tuner(
+            objective,
+            param_space={"x": tune.uniform(-10.0, 10.0)},
+            tune_config=TuneConfig(
+                metric="score", mode="max", num_samples=10,
+                search_alg=TuneBOHB(metric="score", mode="max", seed=0),
+                scheduler=HyperBandForBOHB(
+                    metric="score", mode="max", max_t=8,
+                ),
+                max_concurrent_trials=2,
+            ),
+        )
+        results = tuner.fit()
+        best = results.get_best_result()
+        assert best.metrics["score"] > -20.0
