@@ -5,7 +5,12 @@ fused per-block task execution; TPU ingest via iter_jax_batches.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
-from ray_tpu.data.dataset import Dataset, GroupedData  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    ActorPoolStrategy,
+    DataIterator,
+    Dataset,
+    GroupedData,
+)
 from ray_tpu.data.read_api import (  # noqa: F401
     from_arrow,
     from_items,

@@ -60,6 +60,20 @@ class _Op:
     pass
 
 
+class ActorPoolStrategy:
+    """Run a map_batches stage on a pool of stateful actors (ray:
+    ray.data.ActorPoolStrategy; the actor_pool_map_operator role).
+    The pool provisions between min_size and max_size actors, scaled to
+    the stage's block count (no dynamic autoscaling mid-stage)."""
+
+    def __init__(self, size: int = 2, min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        self.min_size = min_size if min_size is not None else (
+            size if max_size is None else 1
+        )
+        self.max_size = max_size if max_size is not None else size
+
+
 class _MapBatches(_Op):
     def __init__(self, fn, batch_format="numpy", fn_kwargs=None):
         self.fn = fn
@@ -127,6 +141,16 @@ def _to_block(batch) -> Block:
     )
 
 
+def _kill_actor_pool(pool):
+    import ray_tpu as _rt
+
+    for a in pool:
+        try:
+            _rt.kill(a)
+        except Exception:
+            pass
+
+
 def _apply_ops(block: Block, ops: List[_Op]) -> Block:
     for op in ops:
         block = op.apply(block)
@@ -152,9 +176,81 @@ class Dataset:
         *,
         batch_format: str = "numpy",
         fn_kwargs: Optional[dict] = None,
+        compute: Any = None,
+        concurrency: Optional[int] = None,
+        fn_constructor_args: tuple = (),
         **_ignored,
     ) -> "Dataset":
+        """Batch transform.  Plain functions fuse into per-block tasks
+        (lazy).  A CLASS — or compute=ActorPoolStrategy(...) — runs on a
+        pool of stateful actors instead (ray: actor_pool_map_operator
+        role): the callable is constructed ONCE per actor (load a model
+        there), blocks round-robin across the pool (each serial actor
+        executes one block at a time), and the stage is an async plan
+        boundary like the shuffles — the pool lives until the resulting
+        Dataset is garbage-collected."""
+        wants_actors = (
+            isinstance(compute, ActorPoolStrategy)
+            or compute == "actors"
+            or isinstance(fn, type)
+        )
+        if wants_actors:
+            if concurrency:
+                lo = hi = concurrency
+            elif isinstance(compute, ActorPoolStrategy):
+                lo, hi = compute.min_size, compute.max_size
+            else:
+                lo = hi = 2
+            return self._map_batches_actors(
+                fn, lo, hi, batch_format, fn_kwargs or {},
+                fn_constructor_args,
+            )
         return self._chain(_MapBatches(fn, batch_format, fn_kwargs))
+
+    def _map_batches_actors(
+        self, fn, min_size: int, max_size: int, batch_format: str,
+        fn_kwargs: dict, ctor_args: tuple,
+    ) -> "Dataset":
+        refs = self._execute()
+        if not refs:
+            return Dataset([])
+        size = max(1, max(min_size, min(max_size, len(refs))))
+
+        @ray_tpu.remote
+        class _MapWorker:
+            def __init__(self, fn, ctor_args):
+                self._callable = (
+                    fn(*ctor_args) if isinstance(fn, type) else fn
+                )
+
+            def apply(self, block):
+                batch = _from_block(block, batch_format)
+                out = self._callable(batch, **fn_kwargs)
+                return _to_block(out)
+
+        pool = [
+            _MapWorker.options(num_cpus=0.5).remote(fn, ctor_args)
+            for _ in range(size)
+        ]
+        out = [pool[i % size].apply.remote(r) for i, r in enumerate(refs)]
+        # The pool dies when the LAST output ref does — not with the
+        # Dataset object, which a chained stage may drop while its refs
+        # live on.  Finalizers hold the handles; consumption proceeds
+        # asynchronously.  (Inline results ride replies; stored results
+        # live in node shm independent of the producing actor, so actor
+        # teardown after the refs die never strands data.)
+        import weakref
+
+        remaining = {"n": len(out)}
+
+        def _one_ref_dead():
+            remaining["n"] -= 1
+            if remaining["n"] == 0:
+                _kill_actor_pool(pool)
+
+        for r in out:
+            weakref.finalize(r, _one_ref_dead)
+        return Dataset(out)
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         return self._chain(_MapRows(fn))

@@ -315,3 +315,36 @@ def test_iter_torch_batches(cluster):
     typed = next(iter(ds.iter_torch_batches(
         batch_size=10, dtypes={"id": torch.float32})))
     assert typed["id"].dtype == torch.float32
+
+
+class TestActorPoolMapBatches:
+    def test_stateful_class_runs_on_pool(self, cluster):
+        class AddBias:
+            def __init__(self, bias):
+                import os
+
+                self.bias = bias
+                self.pid = os.getpid()
+
+            def __call__(self, batch):
+                return {"x": batch["x"] + self.bias, "pid": [self.pid] *
+                        len(batch["x"])}
+
+        ds = rd.range(200).repartition(8).map_batches(
+            lambda b: {"x": b["id"]}
+        ).map_batches(
+            AddBias, compute=rd.ActorPoolStrategy(size=2),
+            fn_constructor_args=(100,),
+        )
+        rows = ds.take_all()
+        assert sorted(r["x"] for r in rows) == [i + 100 for i in range(200)]
+        # the pool was 2 actors: at most 2 distinct constructor pids
+        assert len({r["pid"] for r in rows}) <= 2
+
+    def test_concurrency_kwarg_with_class(self, cluster):
+        class Echo:
+            def __call__(self, batch):
+                return {"id": batch["id"]}
+
+        ds = rd.range(64).repartition(4).map_batches(Echo, concurrency=2)
+        assert sorted(r["id"] for r in ds.take_all()) == list(range(64))
