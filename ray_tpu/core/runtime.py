@@ -710,8 +710,49 @@ class Runtime:
         return ObjectRef(oid)
 
     async def stream_next_async(self, tid: bytes):
-        """Async variant of stream_next (for async actors / drivers)."""
-        return await asyncio.to_thread(self.stream_next, tid)
+        """Async variant of stream_next.
+
+        Loop-native when awaited on the runtime's own io loop (async
+        actor methods, serve replicas/proxies): NO thread is parked per
+        in-flight stream — the delivery path sets an asyncio.Event.
+        Elsewhere it falls back to a worker thread."""
+        try:
+            on_loop = asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            on_loop = False
+        if not on_loop:
+            try:
+                return await asyncio.to_thread(self.stream_next, tid)
+            except StopIteration:
+                raise StopAsyncIteration from None
+        # exhaustion raises StopAsyncIteration (PEP 479: a coroutine must
+        # not let StopIteration escape)
+        buf = self._streams.get(tid)
+        if buf is None:
+            raise StopAsyncIteration
+        while True:
+            with buf.cond:
+                idx = buf.next_idx
+                if idx in buf.items:
+                    buf.items.discard(idx)
+                    buf.next_idx = idx + 1
+                    conn = buf.conn
+                    break
+                if buf.count is not None and idx >= buf.count:
+                    if not buf.items:
+                        self._streams.pop(tid, None)
+                    raise StopAsyncIteration
+                if buf.failed is not None:
+                    raise buf.failed
+                buf.aev = asyncio.Event()
+                ev = buf.aev
+            await ev.wait()
+        oid = ObjectID.for_task_return(TaskID(tid), idx)
+        if conn is not None and not conn.closed:
+            self._spawn(
+                conn.notify("stream_ack", {"task_id": tid, "upto": idx})
+            )
+        return ObjectRef(oid)
 
     def stream_cancel(self, tid: bytes) -> bool:
         """Stop a streaming producer; the consumer's next() receives a
@@ -2003,7 +2044,7 @@ class _StreamBuf:
 
     __slots__ = (
         "cond", "items", "next_idx", "count", "failed", "conn",
-        "cancel_state",
+        "cancel_state", "aev",
     )
 
     def __init__(self):
@@ -2014,22 +2055,30 @@ class _StreamBuf:
         self.failed: Optional[Exception] = None
         self.conn = None  # connection items arrived on (for acks/cancel)
         self.cancel_state = 0  # 0 none, 1 requested (conn unknown), 2 sent
+        # loop-native waiter (stream_next_async); all signal paths run ON
+        # the io loop, so setting an asyncio.Event here is safe
+        self.aev: Optional[Any] = None
+
+    def _signal(self):
+        self.cond.notify_all()
+        if self.aev is not None:
+            self.aev.set()
 
     def deliver(self, idx: int, conn):
         with self.cond:
             self.items.add(idx)
             self.conn = conn
-            self.cond.notify_all()
+            self._signal()
 
     def complete(self, count: int):
         with self.cond:
             self.count = count
-            self.cond.notify_all()
+            self._signal()
 
     def fail(self, exc: Exception):
         with self.cond:
             self.failed = exc
-            self.cond.notify_all()
+            self._signal()
 
 
 class ObjectRefGenerator:
@@ -2063,9 +2112,9 @@ class ObjectRefGenerator:
             raise StopAsyncIteration
         try:
             return await get_runtime().stream_next_async(self._task_id)
-        except StopIteration:
+        except (StopIteration, StopAsyncIteration):
             self._exhausted = True
-            raise StopAsyncIteration
+            raise StopAsyncIteration from None
 
     def next_with_timeout(self, timeout: float) -> "ObjectRef":
         return get_runtime().stream_next(self._task_id, timeout=timeout)

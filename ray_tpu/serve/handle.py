@@ -208,6 +208,26 @@ class DeploymentResponseGenerator:
             self._settle()
             raise
 
+    async def _next_async(self):
+        """Loop-native next + value fetch (no parked threads): used by the
+        HTTP proxy's streaming path.  Raises StopAsyncIteration at end."""
+        from ray_tpu.core.runtime import get_runtime
+
+        try:
+            ref = await self._gen.__anext__()
+        except StopAsyncIteration:
+            self._done = True
+            self._settle()
+            raise
+        except BaseException:
+            self._settle()
+            raise
+        try:
+            return await get_runtime().await_ref(ref)
+        except BaseException:
+            self._settle()
+            raise
+
     def cancel(self):
         if not self._done:
             try:

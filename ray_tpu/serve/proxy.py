@@ -32,8 +32,21 @@ class ProxyActor:
         self._controller = None
         self._runner = None
         self._site = None
+        self._start_task = None
 
     async def start(self) -> int:
+        # memoized: concurrent callers (async actor methods interleave)
+        # await ONE bring-up and all receive the resolved bound port —
+        # a bare started-flag would hand an ephemeral-port caller 0
+        import asyncio
+
+        if self._start_task is None:
+            self._start_task = asyncio.get_running_loop().create_task(
+                self._do_start()
+            )
+        return await asyncio.shield(self._start_task)
+
+    async def _do_start(self) -> int:
         from aiohttp import web
 
         app = web.Application()
@@ -42,6 +55,10 @@ class ProxyActor:
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, "0.0.0.0", self._port)
         await self._site.start()
+        if self._port == 0:  # ephemeral: resolve the real port
+            for server in self._runner.sites:
+                self._port = server._server.sockets[0].getsockname()[1]
+                break
         return self._port
 
     # Route state is owned by the controller (like the reference's
@@ -87,6 +104,17 @@ class ProxyActor:
             return web.Response(text="ok")
         kwargs: Dict[str, Any] = {}
         args = ()
+        # routing modifiers ride the query string (never the body):
+        #   ?method=generate   call a named method instead of __call__
+        #   ?stream=1          newline-delimited-JSON streaming response
+        method_name = request.query.get("method")
+        want_stream = request.query.get("stream", "") in ("1", "true")
+        if method_name and method_name.startswith("_"):
+            # the replica getattr()s the user callable: private/dunder
+            # attributes must not be reachable over unauthenticated HTTP
+            return web.Response(
+                status=403, text="private method names are not routable"
+            )
         body = await request.read()
         if body:
             try:
@@ -98,7 +126,10 @@ class ProxyActor:
             except (json.JSONDecodeError, UnicodeDecodeError):
                 args = (body,)
         elif request.query:
-            kwargs = dict(request.query)
+            kwargs = {
+                k: v for k, v in request.query.items()
+                if k not in ("method", "stream")
+            }
         try:
             import asyncio
 
@@ -126,6 +157,11 @@ class ProxyActor:
                 if prefix is None:
                     return None
                 handle = self._handle_for(prefix)
+                if method_name or want_stream:
+                    handle = handle.options(
+                        method_name=method_name or "__call__",
+                        stream=want_stream,
+                    )
                 return handle.remote(*args, **kwargs)
 
             resp = await asyncio.get_running_loop().run_in_executor(
@@ -133,6 +169,39 @@ class ProxyActor:
             )
             if resp is None:
                 return web.Response(status=404, text="no route")
+            if want_stream:
+                # newline-delimited JSON over chunked transfer (the HTTP
+                # face of the core streaming-generator transport), fully
+                # loop-native: no executor thread parks per stream, so
+                # slow token cadences can't exhaust the shared pool.
+                # Errors after prepare() must TERMINATE this stream (an
+                # error line + eof) — the outer handler's 500 would write
+                # a second response into the open chunked body.
+                sr = web.StreamResponse(
+                    headers={"Content-Type": "application/x-ndjson"}
+                )
+                await sr.prepare(request)
+                try:
+                    while True:
+                        try:
+                            item = await resp._next_async()
+                        except StopAsyncIteration:
+                            break
+                        await sr.write(
+                            (json.dumps(item, default=str) + "\n").encode()
+                        )
+                except Exception as e:  # noqa: BLE001 — ends the stream
+                    try:
+                        await sr.write((json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        ) + "\n").encode())
+                    except Exception:
+                        pass  # client already gone
+                try:
+                    await sr.write_eof()
+                except Exception:
+                    pass
+                return sr
             # result_async carries the pow-2 router's replica-death
             # failover — HTTP clients get the same retry semantics as
             # handle-API callers instead of a bare 500.

@@ -196,3 +196,39 @@ class TestLLMServing:
                 time.sleep(2)
         assert out is not None and len(out) == 3
         serve.delete("llm2_app")
+
+
+class TestHTTPStreaming:
+    def test_llm_tokens_stream_over_http_ndjson(self, cluster):
+        import json as _json
+        import urllib.request
+
+        from ray_tpu.serve.llm import LlamaDeployment
+
+        serve.run(
+            LlamaDeployment.options(name="llmh").bind(
+                max_slots=2, max_len=48
+            ),
+            name="llmh_app", route_prefix="/llm", http_port=0,
+        )
+        from ray_tpu.serve import api as serve_api
+
+        port = ray_tpu.get(
+            serve_api._proxy_handle.start.remote(), timeout=60
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/llm?method=generate&stream=1",
+            data=_json.dumps(
+                {"prompt": [1, 2, 3], "max_new_tokens": 5}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers["Content-Type"].startswith(
+                "application/x-ndjson"
+            )
+            lines = [ln for ln in r.read().decode().splitlines() if ln]
+        toks = [_json.loads(ln) for ln in lines]
+        assert len(toks) == 5
+        assert all(isinstance(t, int) for t in toks)
+        serve.delete("llmh_app")
