@@ -152,6 +152,23 @@ def cmd_metrics(args) -> None:
     print(json.dumps(state.get_metrics(), indent=2))
 
 
+def cmd_stacks(args) -> None:
+    """Per-thread Python stacks of a live worker (py-spy role)."""
+    from ray_tpu.util import state
+
+    _connect(args)
+    if args.worker:
+        dump = state.worker_stacks(args.worker)
+        print(f"pid {dump['pid']}")
+        for name, stack in dump["stacks"].items():
+            print(f"\n--- {name} ---\n{stack}")
+    else:
+        for w in state.list_workers():
+            print(json.dumps(
+                {k: w.get(k) for k in ("worker_id", "pid", "actor_class")}
+            ))
+
+
 def cmd_memory(args) -> None:
     from ray_tpu.util import state
 
@@ -224,6 +241,14 @@ def main(argv=None) -> None:
     p = sub.add_parser("metrics", help="aggregated application metrics")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "stacks",
+        help="dump a live worker's thread stacks (no arg: list workers)",
+    )
+    p.add_argument("worker", nargs="?", help="worker id (hex)")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_stacks)
 
     args = ap.parse_args(argv)
     args.fn(args)
