@@ -273,8 +273,17 @@ class ShmStore:
         data = memoryview(data).cast("B")
         buf = self.create(object_id, data.nbytes)
         buf[:] = data
-        if protect:
-            self.protect(object_id)
+        if protect and not self.protect(object_id):
+            # between create and here the entry can only vanish via a bug
+            # (it is unsealed and creator-pinned) — surface, don't let the
+            # caller believe the primary is eviction-proof.  Abort first:
+            # an unsealed creator-pinned entry is otherwise unreclaimable
+            # until this client detaches, and a retried put would hit
+            # ObjectExistsError.
+            self.abort(object_id)
+            raise StoreError(
+                f"protect failed for {bytes(object_id).hex()[:12]}"
+            )
         self.seal(object_id)
 
     # -- read path -------------------------------------------------------
@@ -330,14 +339,19 @@ class ShmStore:
         """Release pins held by dead client processes; returns clients reaped."""
         return self._lib.rt_store_reap(self._h)
 
-    def protect(self, object_id: bytes, on: bool = True) -> None:
+    def protect(self, object_id: bytes, on: bool = True) -> bool:
         """Mark/unmark an object as a primary copy: LRU eviction skips
         protected entries, so the only copy of a value can never vanish
         silently — the raylet's spill manager writes protected entries to
         disk under memory pressure instead (reference role:
-        local_object_manager.h pinned-primary + spill)."""
+        local_object_manager.h pinned-primary + spill).
+
+        Returns True iff the flag was applied.  False means the object is
+        gone (deleted/evicted between create and protect, or a bad id) —
+        callers that rely on the primary surviving LRU must check."""
         object_id = _check_id(object_id)
-        self._lib.rt_store_protect(self._h, object_id, 1 if on else 0)
+        rc = self._lib.rt_store_protect(self._h, object_id, 1 if on else 0)
+        return rc == 0
 
     def list_spillable(self, max_n: int = 4096) -> list:
         """(object_id, size) of sealed, unpinned, protected entries in

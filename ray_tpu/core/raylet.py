@@ -250,9 +250,20 @@ class Raylet:
                 if shortfall <= 0:
                     # the caller's create failed despite apparent headroom:
                     # fragmentation — spill ~needed_bytes of LRU primaries
-                    # so arena_free can merge a contiguous run
+                    # so arena_free can merge a contiguous run.  Do NOT
+                    # clamp this to the low-water mark: when used is
+                    # already below it the clamp would free 0 bytes on
+                    # every retry and the fragmented create starves (the
+                    # retry loops in _write_to_store / _restore_from_spill
+                    # give up on a zero-freed pass).  Spill amount stays
+                    # bounded at ~needed_bytes per pass (callers escalate
+                    # needed_bytes across retries; min(needed, cap) above
+                    # bounds the worst case).
                     shortfall = needed_bytes
-                target = st["used"] - shortfall
+                # floor at 0: needed_bytes >= used means the caller needs
+                # more than everything currently resident — draining all
+                # spillables is then exactly the progress required
+                target = max(st["used"] - shortfall, 0)
             elif st["used"] > cfg.object_spill_high_frac * cap:
                 target = int(cfg.object_spill_low_frac * cap)
             else:

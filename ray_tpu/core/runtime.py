@@ -27,7 +27,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from ray_tpu._native.store import ObjectExistsError, ShmStore, StoreFullError
+from ray_tpu._native.store import (
+    ObjectExistsError,
+    ShmStore,
+    StoreError,
+    StoreFullError,
+)
 from ray_tpu.common.config import cfg
 from ray_tpu.common.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.common import serialization as ser
@@ -504,7 +509,9 @@ class Runtime:
         # eviction pass must never reclaim it between seal (refcnt drops
         # to 0) and the flag landing — spilling is the only sanctioned way
         # out of the arena for a primary
-        self.store.protect(oid)
+        if not self.store.protect(oid):
+            self.store.abort(oid)
+            raise StoreError(f"protect failed for {oid.hex()[:12]}")
         self.store.seal(oid)
         self._shared.add(oid)
         self._spawn(

@@ -15,6 +15,7 @@ ActorSchedulingQueue.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import inspect
 import logging
 import os
@@ -191,7 +192,9 @@ class WorkerServer:
     # reply closes the stream with the total item count.  `stream_ack`
     # notifies from the consumer advance the credit window.
 
-    async def _run_streaming(self, conn, spec, fn, args, kwargs, pool) -> dict:
+    async def _run_streaming(
+        self, conn, spec, fn, args, kwargs, pool, sem=None
+    ) -> dict:
         tid = spec["task_id"]
         state = {"acked": -1, "sent": 0, "credit": asyncio.Event()}
         self._out_streams[tid] = state
@@ -202,8 +205,13 @@ class WorkerServer:
                 self._cancelled.discard(tid)
                 raise TaskCancelledError("cancelled before start")
             if inspect.isasyncgenfunction(fn):
-                async for item in fn(*args, **kwargs):
-                    await self._stream_send(conn, spec, state, item)
+                # Generator methods count against the actor/group
+                # concurrency limit for their whole lifetime, like the
+                # non-streaming async path (sync generators are bounded
+                # by the pool they occupy below).
+                async with sem if sem is not None else contextlib.nullcontext():
+                    async for item in fn(*args, **kwargs):
+                        await self._stream_send(conn, spec, state, item)
             else:
                 def pump():
                     # sync generator on the executor thread; each item ships
@@ -406,24 +414,6 @@ class WorkerServer:
         ActorSchedulingQueue sequence numbers + duplicate suppression).
         Async methods run concurrently under the semaphore (admission order
         only), like the reference's out-of-order queue for async actors."""
-        if self.actor_instance is None:
-            return self._error_reply(
-                RuntimeError("actor instance not created on this worker"), spec
-            )
-        if spec["method"] == "__rt_apply__":
-            # generic in-actor apply (reference: __ray_call__): first arg
-            # is a function called as fn(instance, *rest) — the compiled
-            # DAG exec loop rides this, as can any diagnostic.
-            inst = self.actor_instance
-
-            def method(__fn, *a, **kw):
-                return __fn(inst, *a, **kw)
-        else:
-            try:
-                method = getattr(self.actor_instance, spec["method"])
-            except AttributeError as e:
-                return self._error_reply(e, spec)
-
         caller = spec.get("caller_id", b"")
         seq = spec.get("seq")
         epoch = spec.get("seq_epoch", 0)
@@ -506,8 +496,33 @@ class WorkerServer:
         if fut is not None:
             return await asyncio.shield(fut)
 
-        reply_fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        cs["inflight"][tid] = reply_fut
+        # Method / instance / concurrency-group resolution ALL happen
+        # after seq admission and before the inflight future exists: an
+        # error return earlier would leave the failed call's seq slot
+        # unconsumed (every later call from this caller parks on
+        # `seq > next_seq` forever — and h.typo.remote() is reachable by
+        # any user, ActorHandle does no client-side method validation);
+        # an error return after registering reply_fut would leave a
+        # never-resolved future that a retried push awaits forever.
+        if self.actor_instance is None:
+            return self._cache_reply(cs, tid, self._error_reply(
+                RuntimeError("actor instance not created on this worker"),
+                spec,
+            ))
+        if spec["method"] == "__rt_apply__":
+            # generic in-actor apply (reference: __ray_call__): first arg
+            # is a function called as fn(instance, *rest) — the compiled
+            # DAG exec loop rides this, as can any diagnostic.
+            inst = self.actor_instance
+
+            def method(__fn, *a, **kw):
+                return __fn(inst, *a, **kw)
+        else:
+            try:
+                method = getattr(self.actor_instance, spec["method"])
+            except AttributeError as e:
+                return self._cache_reply(cs, tid, self._error_reply(e, spec))
+
         # concurrency group: explicit per-call choice, else the method's
         # declared group, else the default (flat) limits.  An unknown
         # name is an ERROR — silently falling back would strip the limit
@@ -517,13 +532,16 @@ class WorkerServer:
         )
         cg = self._concurrency_groups.get(gname) if gname else None
         if gname and cg is None:
-            return self._error_reply(
+            return self._cache_reply(cs, tid, self._error_reply(
                 ValueError(
                     f"unknown concurrency group {gname!r}; declared "
                     f"groups: {sorted(self._concurrency_groups)}"
                 ),
                 spec,
-            )
+            ))
+
+        reply_fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        cs["inflight"][tid] = reply_fut
         try:
             if spec.get("streaming"):
                 try:
@@ -535,6 +553,7 @@ class WorkerServer:
                         conn, spec, method, args, kwargs,
                         (cg["pool"] if cg else None)
                         or self._actor_thread_pool or self._exec,
+                        sem=(cg["sem"] if cg else self._actor_sem),
                     )
             elif inspect.iscoroutinefunction(method):
                 try:
@@ -584,11 +603,18 @@ class WorkerServer:
                 e if isinstance(e, Exception) else RuntimeError(repr(e)), spec
             )
         cs["inflight"].pop(tid, None)
+        self._cache_reply(cs, tid, reply)
+        if not reply_fut.done():
+            reply_fut.set_result(reply)
+        return reply
+
+    def _cache_reply(self, cs, tid, reply) -> dict:
+        """Insert into the per-caller reply cache with the size bound
+        applied (every insertion path must trim, or a caller repeatedly
+        hitting an error path grows the cache without bound)."""
         cs["replies"][tid] = reply
         while len(cs["replies"]) > self._REPLY_CACHE_PER_CALLER:
             cs["replies"].pop(next(iter(cs["replies"])))
-        if not reply_fut.done():
-            reply_fut.set_result(reply)
         return reply
 
     def _maybe_execute_inline(self, method, spec) -> Optional[dict]:

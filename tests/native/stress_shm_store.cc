@@ -53,6 +53,25 @@ static int worker_main(const char* path, int worker, int iters,
   for (int i = 0; i < iters; i++) {
     uint8_t id[16];
     make_id(id, worker, i);
+    if (kill_self_at == i) {
+      // Die while HOLDING a pin: create a tiny dedicated object (64 B
+      // fits even when the arena is under heavy pressure), seal+get it
+      // so we hold the pin, then _exit without unpinning.  The parent's
+      // reap must recover the slot.  If even 64 B cannot be placed
+      // (arena momentarily full of pinned objects), still exit 42 —
+      // the kill itself must be unconditional or the parent's exit-code
+      // check encodes memory-pressure timing instead of an invariant.
+      uint8_t kid[16];
+      make_id(kid, worker, 1000000 + i);
+      uint64_t koff = 0;
+      if (rt_store_create_object(h, kid, 64, &koff) == 0) {
+        memset(base + koff, 0xAB, 64);
+        rt_store_seal(h, kid);
+        uint64_t goff = 0, gsize = 0;
+        rt_store_get(h, kid, &goff, &gsize);  // hold the pin
+      }
+      _exit(42);
+    }
     uint64_t size = 64 + (rand_r(&seed) % (256 * 1024));
     uint64_t off = 0;
     int rc = rt_store_create_object(h, id, size, &off);
@@ -68,11 +87,6 @@ static int worker_main(const char* path, int worker, int iters,
           base[goff + gsize - 1] != ((worker + i) & 0xff)) {
         fprintf(stderr, "worker %d: data mismatch at iter %d\n", worker, i);
         return 3;
-      }
-      if (kill_self_at == i) {
-        // die while HOLDING the pin (and possibly the lock path hot):
-        // the parent's reap must recover the slot
-        _exit(42);
       }
       rt_store_unpin(h, id);
     }
@@ -124,6 +138,21 @@ int main(int argc, char** argv) {
           (unsigned long)c, (unsigned long)u, (unsigned long)o,
           (unsigned long)e);
   if (u > c) { fprintf(stderr, "used > capacity!\n"); failures++; }
+  // Workers intentionally leave some objects spill-protected (the
+  // protect/unprotect cadences don't cover every id, and worker 0 died
+  // mid-run).  Protection is a policy bit owned by the raylet, not an
+  // arena invariant — lift it all before asserting serviceability, or
+  // this check encodes the interleaving-dependent fill level instead
+  // of crash-recovery correctness.
+  for (int w = 0; w < workers; w++) {
+    for (int i = 0; i < iters; i++) {
+      uint8_t wid[16];
+      make_id(wid, w, i);
+      rt_store_protect(h, wid, 0);  // RT_NOT_FOUND is fine
+      make_id(wid, w, 1000000 + i);
+      rt_store_protect(h, wid, 0);
+    }
+  }
   // arena still serviceable after the chaos
   uint8_t id[16];
   make_id(id, 999, 1);

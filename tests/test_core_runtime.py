@@ -218,6 +218,18 @@ class TestActors:
         with pytest.raises(TaskError, match="actor method failed"):
             ray_tpu.get(b.fail.remote(), timeout=60)
 
+    def test_unknown_method_does_not_wedge_sequence(self, cluster):
+        # A typo'd method name reaches the worker (ActorHandle does no
+        # client-side validation); the error reply must still consume
+        # that call's seq slot or every later call from this caller
+        # parks forever on `seq > next_seq`.
+        c = Counter.remote()
+        bad = c.no_such_method.remote()
+        good = c.inc.remote()
+        with pytest.raises(TaskError, match="no_such_method"):
+            ray_tpu.get(bad, timeout=60)
+        assert ray_tpu.get(good, timeout=60) == 1
+
     def test_named_actor(self, cluster):
         from ray_tpu.core.actor import get_actor
 
