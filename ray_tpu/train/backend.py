@@ -45,13 +45,16 @@ class JaxConfig(BackendConfig):
     """Configuration of the jax.distributed bootstrap.
 
     ``coordinator_port``: port the rank-0 process binds for the
-    distributed service.  ``init_distributed``: call
+    distributed service; 0 (default) asks the coordinator worker for a
+    free port at gang start — re-picked on every gang (re)start, so
+    restarts never trip over TIME_WAIT and concurrent gangs on one host
+    never collide.  ``init_distributed``: call
     `jax.distributed.initialize` on each worker at training start (True
     for real multi-host SPMD; False leaves single-process jax, used by
     single-worker runs and CPU tests).
     """
 
-    coordinator_port: int = 8476
+    coordinator_port: int = 0
     init_distributed: bool = False
 
     @property
@@ -67,19 +70,40 @@ def _jax_distributed_init(coordinator: str, num_processes: int, process_id: int)
         num_processes=num_processes,
         process_id=process_id,
     )
-    return True
+    # prove the gang actually formed — callers gate training on this
+    return jax.process_count() == num_processes
+
+
+def _find_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
 
 
 class JaxBackend(Backend):
+    def __init__(self):
+        self._resolved_port: int = 0
+
     def on_start(self, worker_group: "WorkerGroup", backend_config: JaxConfig):
         """Publish the SPMD bootstrap env to every worker.
 
         (ray: _TorchBackend picks MASTER_ADDR/PORT from worker 0 —
         train/torch/config.py:94-112; here worker 0 of node 0 hosts the
-        jax coordinator.)
+        jax coordinator.)  Resolved fresh per gang start: a restarted
+        gang must not inherit a dead coordinator's port.
         """
         coord = worker_group.workers[0]
-        coordinator = f"{coord.ip}:{backend_config.coordinator_port}"
+        port = backend_config.coordinator_port
+        if not port:
+            import ray_tpu
+
+            port = ray_tpu.get(
+                coord.actor.execute.remote(_find_free_port), timeout=60
+            )
+        self._resolved_port = port
+        coordinator = f"{coord.ip}:{port}"
         envs: List[Dict[str, str]] = []
         for w in worker_group.workers:
             envs.append(
@@ -98,11 +122,11 @@ class JaxBackend(Backend):
         if not backend_config.init_distributed:
             return
         coord = worker_group.workers[0]
-        coordinator = f"{coord.ip}:{backend_config.coordinator_port}"
+        coordinator = f"{coord.ip}:{self._resolved_port}"
         n = len(worker_group.workers)
         import ray_tpu
 
-        ray_tpu.get(
+        ok = ray_tpu.get(
             [
                 w.actor.execute.remote(
                     _jax_distributed_init, coordinator, n, w.rank
@@ -111,3 +135,13 @@ class JaxBackend(Backend):
             ],
             timeout=300,
         )
+        if not all(ok):
+            # surface as a gang failure so the trainer's teardown +
+            # FailureConfig restart policy run (a bare RuntimeError would
+            # escape fit()'s retry loop and leak the worker group)
+            from ray_tpu.train.backend_executor import TrainWorkerGroupError
+
+            raise TrainWorkerGroupError(
+                f"jax.distributed gang formed with wrong process count "
+                f"(expected {n}): {ok}"
+            )
