@@ -54,6 +54,7 @@ class ServeControllerActor:
         # serve/_private/controller.py) — so a second driver or a driver
         # restart can't clobber routes installed by others.
         self._http_routes: Dict[str, tuple] = {}  # prefix -> (app, deployment)
+        self._app_roots: Dict[str, str] = {}  # app -> ingress deployment
         self._routes_version = 0
         self._lock = threading.RLock()
         # serializes whole reconcile passes (the loop thread and
@@ -68,9 +69,20 @@ class ServeControllerActor:
         self._thread.start()
 
     # -- deploy API ------------------------------------------------------
-    def deploy_application(self, app_name: str, deployments: list) -> bool:
-        """Deploy/update an app (list of Deployment objects)."""
+    def deploy_application(
+        self, app_name: str, deployments: list, root_name: str = None
+    ) -> bool:
+        """Deploy/update an app (list of Deployment objects).
+
+        ``root_name`` marks the ingress deployment of a composed graph
+        (children are listed before parents, so "first in list" is NOT
+        the ingress); defaults to the first deployment for single-node
+        apps and config-file deploys."""
         with self._lock:
+            self._app_roots[app_name] = (
+                root_name if root_name is not None
+                else (deployments[0].name if deployments else None)
+            )
             states = self._apps.setdefault(app_name, {})
             new_names = {d.name for d in deployments}
             for name in list(states):
@@ -86,8 +98,13 @@ class ServeControllerActor:
         self._reconcile_once()
         return True
 
+    def get_app_root(self, app_name: str):
+        with self._lock:
+            return self._app_roots.get(app_name)
+
     def delete_application(self, app_name: str) -> bool:
         with self._lock:
+            self._app_roots.pop(app_name, None)
             states = self._apps.pop(app_name, {})
             for st in states.values():
                 self._drain(st)
@@ -189,7 +206,10 @@ class ServeControllerActor:
                     num_tpus=opts.get("num_tpus"),
                     resources=opts.get("resources"),
                     max_restarts=0,
-                ).remote(d.func_or_class, d.init_args, d.init_kwargs, None)
+                ).remote(
+                    d.func_or_class, d.init_args, d.init_kwargs, None,
+                    st.app_name,
+                )
                 with self._lock:
                     if self._is_current(st):
                         st.replicas.append(handle)

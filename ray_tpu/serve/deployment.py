@@ -39,6 +39,14 @@ class Deployment:
         return dataclasses.replace(self, **kwargs)
 
     def bind(self, *args, **kwargs) -> "Application":
+        """Bind init args, producing an Application node.
+
+        Args may themselves be Application objects (other bound
+        deployments): that composes a multi-deployment app graph — at
+        serve.run each nested Application becomes its own deployment and
+        the parent receives a live DeploymentHandle in its place
+        (reference: serve/_private/deployment_graph_build.py:65-69).
+        """
         return Application(
             dataclasses.replace(self, init_args=args, init_kwargs=kwargs)
         )
@@ -51,7 +59,21 @@ class Deployment:
 
 @dataclasses.dataclass
 class Application:
+    """One node of a deployment graph.  ``deployment.init_args`` /
+    ``init_kwargs`` may contain further Application nodes; binding the
+    SAME Application object into several parents shares one deployment
+    (and its replicas), exactly like the reference's DAG build."""
+
     deployment: Deployment
+
+
+@dataclasses.dataclass(frozen=True)
+class HandleRef:
+    """Placeholder left in a deployment's init args where a nested
+    Application was bound; the replica resolves it to a DeploymentHandle
+    for the named deployment in the same app at construction time."""
+
+    deployment_name: str
 
 
 def deployment(

@@ -102,17 +102,32 @@ class DeploymentResponse:
     re-dispatched to another one.
     """
 
-    def __init__(self, router: Router, replica, ref, redispatch, attempts=3):
+    def __init__(
+        self, router: Router, replica, ref, redispatch, attempts=3,
+    ):
         self._router = router
         self._replica = replica
-        self._ref = ref
+        self._ref = ref  # None = lazy (dispatch deferred off the io loop)
         self._redispatch = redispatch  # () -> (replica, ref)
         self._attempts = attempts
         self._done = False
+        self._dispatch_lock = threading.Lock()
+
+    def _ensure_dispatched(self):
+        """Blocking first dispatch of a lazy response.  Called from the
+        driver thread or an executor thread — NEVER the io loop (the
+        router's route refresh blocks on a controller get, and blocking
+        a replica's io loop starves the very reply it waits for).
+        Locked: concurrent awaiters of one lazy response (gather, or
+        await + chain) must not double-execute the request."""
+        with self._dispatch_lock:
+            if self._ref is None:
+                self._replica, self._ref = self._redispatch()
 
     def result(self, timeout_s: Optional[float] = 60.0):
         from ray_tpu.core.errors import ActorDiedError, GetTimeoutError
 
+        self._ensure_dispatched()
         while True:
             try:
                 value = ray_tpu.get(self._ref, timeout=timeout_s)
@@ -145,6 +160,10 @@ class DeploymentResponse:
         from ray_tpu.core.runtime import get_runtime
 
         rt = get_runtime()
+        if self._ref is None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._ensure_dispatched
+            )
         while True:
             try:
                 value = await rt.await_ref(self._ref)
@@ -170,8 +189,14 @@ class DeploymentResponse:
             self._done = True
             self._router.done(self._replica)
 
+    def __await__(self):
+        """`await handle.remote(...)` inside an async deployment — the
+        composition idiom (reference: DeploymentResponse.__await__)."""
+        return self.result_async().__await__()
+
     @property
     def ref(self):
+        self._ensure_dispatched()
         return self._ref
 
 
@@ -182,17 +207,27 @@ class DeploymentResponseGenerator:
     mid-stream raises (generator state is not reconstructible on another
     replica)."""
 
-    def __init__(self, router: Router, replica, gen):
+    def __init__(self, router: Router, replica, gen, start=None):
         self._router = router
         self._replica = replica
-        self._gen = gen
+        self._gen = gen  # None = lazy (dispatch deferred off the io loop)
+        self._start = start  # () -> (replica, gen)
         self._done = False
         self._settled = False
+        self._start_lock = threading.Lock()
+
+    def _ensure_started(self):
+        """Blocking first dispatch of a lazy stream (same io-loop
+        starvation hazard as DeploymentResponse._ensure_dispatched)."""
+        with self._start_lock:
+            if self._gen is None:
+                self._replica, self._gen = self._start()
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        self._ensure_started()
         try:
             ref = next(self._gen)
         except StopIteration:
@@ -213,6 +248,12 @@ class DeploymentResponseGenerator:
         HTTP proxy's streaming path.  Raises StopAsyncIteration at end."""
         from ray_tpu.core.runtime import get_runtime
 
+        if self._gen is None:
+            import asyncio
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._ensure_started
+            )
         try:
             ref = await self._gen.__anext__()
         except StopAsyncIteration:
@@ -229,7 +270,7 @@ class DeploymentResponseGenerator:
             raise
 
     def cancel(self):
-        if not self._done:
+        if not self._done and self._gen is not None:
             try:
                 ray_tpu.cancel(self._gen)
             except Exception:
@@ -292,25 +333,81 @@ class DeploymentHandle:
         return h
 
     def remote(self, *args, **kwargs):
+        import asyncio
+
         if self._model_id:
             from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
             kwargs = {**kwargs, MODEL_ID_KWARG: self._model_id}
+
+        def materialize_chained():
+            # DeploymentResponse args chain by REFERENCE: the downstream
+            # replica receives the upstream result without the caller
+            # materializing it (reference: passing DeploymentResponses
+            # into other handle calls).  Recurses into containers, like
+            # the graph-build substitution — a response nested in a list
+            # would otherwise hit the serializer raw (its Router holds a
+            # threading.Lock).  Runs inside dispatch — off the io loop —
+            # because a lazy inner response may need its own blocking
+            # first dispatch here.
+            def chain(v):
+                if isinstance(v, DeploymentResponse):
+                    ref = v.ref  # ensures dispatched
+                    v._settle()
+                    return ref
+                if isinstance(v, list):
+                    return [chain(x) for x in v]
+                if isinstance(v, tuple):
+                    return tuple(chain(x) for x in v)
+                if isinstance(v, dict):
+                    return {k: chain(x) for k, x in v.items()}
+                return v
+
+            return (
+                tuple(chain(a) for a in args),
+                {k: chain(v) for k, v in kwargs.items()},
+            )
+
+        try:
+            asyncio.get_running_loop()
+            on_loop = True
+        except RuntimeError:
+            on_loop = False
+
         if self._stream:
-            replica = self._router.pick()
-            try:
-                gen = replica.handle_request_stream.options(
-                    num_returns="streaming"
-                ).remote(self._method, args, kwargs)
-            except BaseException:
-                self._router.done(replica)  # keep in-flight accounting sane
-                raise
-            return DeploymentResponseGenerator(self._router, replica, gen)
+            def start():
+                a2, k2 = materialize_chained()
+                replica = self._router.pick()
+                try:
+                    gen = replica.handle_request_stream.options(
+                        num_returns="streaming"
+                    ).remote(self._method, a2, k2)
+                except BaseException:
+                    self._router.done(replica)  # keep accounting sane
+                    raise
+                return replica, gen
+
+            if on_loop:
+                # a replica composing a streaming call over this handle:
+                # first dispatch must not block the loop — defer it
+                return DeploymentResponseGenerator(
+                    self._router, None, None, start
+                )
+            replica, gen = start()
+            return DeploymentResponseGenerator(
+                self._router, replica, gen, start
+            )
 
         def dispatch():
+            a2, k2 = materialize_chained()
             replica = self._router.pick()
-            ref = replica.handle_request.remote(self._method, args, kwargs)
+            ref = replica.handle_request.remote(self._method, a2, k2)
             return replica, ref
 
+        if on_loop:
+            # inside an event loop (a replica composing over this handle,
+            # or any async caller): dispatch must not block the loop —
+            # defer it; result_async/await runs it on an executor thread
+            return DeploymentResponse(self._router, None, None, dispatch)
         replica, ref = dispatch()
         return DeploymentResponse(self._router, replica, ref, dispatch)

@@ -14,9 +14,36 @@ from typing import Any
 import ray_tpu
 
 
+def _resolve_handle_refs(value, app_name: str):
+    """Swap HandleRef placeholders (left by serve.run's graph flatten)
+    for live DeploymentHandles to sibling deployments of this app —
+    model composition's injection point (reference:
+    serve/_private/deployment_graph_build.py handle injection)."""
+    from ray_tpu.serve.deployment import HandleRef
+
+    if isinstance(value, HandleRef):
+        from ray_tpu.serve.api import get_deployment_handle
+
+        return get_deployment_handle(value.deployment_name, app_name)
+    if isinstance(value, list):
+        return [_resolve_handle_refs(v, app_name) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_resolve_handle_refs(v, app_name) for v in value)
+    if isinstance(value, dict):
+        return {
+            k: _resolve_handle_refs(v, app_name) for k, v in value.items()
+        }
+    return value
+
+
 @ray_tpu.remote
 class ReplicaActor:
-    def __init__(self, func_or_class, init_args, init_kwargs, method_default):
+    def __init__(
+        self, func_or_class, init_args, init_kwargs, method_default,
+        app_name: str = "",
+    ):
+        init_args = _resolve_handle_refs(tuple(init_args), app_name)
+        init_kwargs = _resolve_handle_refs(dict(init_kwargs), app_name)
         self._is_function = inspect.isfunction(func_or_class) or (
             callable(func_or_class) and not inspect.isclass(func_or_class)
         )
@@ -29,10 +56,37 @@ class ReplicaActor:
         self._ongoing = 0
         self._total = 0
 
+    @staticmethod
+    async def _resolve_chained(args, kwargs):
+        """Resolve ObjectRef args left by response-chaining (an upstream
+        DeploymentResponse passed into this call travels as its ref;
+        it's nested inside the method-args tuple, so the task layer's
+        top-level auto-resolution never sees it)."""
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+
+        async def one(v):
+            if isinstance(v, ObjectRef):
+                return await rt.await_ref(v)
+            if isinstance(v, list):
+                return [await one(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple([await one(x) for x in v])
+            if isinstance(v, dict):
+                return {k: await one(x) for k, x in v.items()}
+            return v
+
+        args = [await one(a) for a in args]
+        kwargs = {k: await one(v) for k, v in kwargs.items()}
+        return args, kwargs
+
     async def handle_request(self, method: str, args, kwargs) -> Any:
         self._ongoing += 1
         self._total += 1
         try:
+            args, kwargs = await self._resolve_chained(args, kwargs)
             kwargs = self._apply_multiplex(kwargs)
             if self._is_function:
                 target = self._callable
@@ -68,6 +122,7 @@ class ReplicaActor:
         self._ongoing += 1
         self._total += 1
         try:
+            args, kwargs = await self._resolve_chained(args, kwargs)
             kwargs = self._apply_multiplex(kwargs)
             if self._is_function:
                 target = self._callable

@@ -1,0 +1,297 @@
+"""Serve model composition: multi-deployment application graphs.
+
+Bound deployments passed into other deployments' ``bind()`` become live
+DeploymentHandles inside the parent replica — ensembles, routers over
+experts, response chaining (reference: ray python/ray/serve/tests/
+test_deployment_graph*.py; graph build at
+serve/_private/deployment_graph_build.py:65-69).
+"""
+
+import urllib.request
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    serve.start()
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+@serve.deployment
+class Model:
+    """A toy 'model': scales its input."""
+
+    def __init__(self, factor):
+        self.factor = factor
+
+    def __call__(self, x):
+        return x * self.factor
+
+
+class TestEnsemble:
+    def test_two_models_and_combiner(self, cluster):
+        @serve.deployment
+        class Combiner:
+            def __init__(self, m1, m2):
+                # injected DeploymentHandles, not Application objects
+                self.m1, self.m2 = m1, m2
+
+            async def __call__(self, x):
+                a = self.m1.remote(x)
+                b = self.m2.remote(x)
+                return (await a) + (await b)
+
+        app = Combiner.bind(Model.bind(2), Model.bind(3))
+        h = serve.run(app, name="ensemble", route_prefix=None)
+        assert h.remote(10).result(timeout_s=60) == 50
+        # the graph flattened into THREE deployments with deduped names
+        st = serve.status()["ensemble"]
+        assert set(st) == {"Combiner", "Model", "Model_1"}
+        serve.delete("ensemble")
+
+    def test_ingress_routes_to_graph_root(self, cluster):
+        @serve.deployment
+        class Doubler:
+            def __init__(self, inner):
+                self.inner = inner
+
+            async def __call__(self, x=1):
+                return 2 * await self.inner.remote(x)
+
+        app = Doubler.bind(Model.bind(5))
+        serve.run(
+            app, name="http_graph", route_prefix="/graph", http_port=8213
+        )
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:8213/graph",
+                data=json.dumps({"x": 4}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read()) == 40  # 2 * (4*5)
+        finally:
+            serve.delete("http_graph")
+
+    def test_shared_node_is_one_deployment(self, cluster):
+        shared = Model.bind(7)
+
+        @serve.deployment
+        class TwoHeads:
+            def __init__(self, left, right):
+                self.left, self.right = left, right
+
+            async def __call__(self, x):
+                return (await self.left.remote(x)) + (
+                    await self.right.remote(x)
+                )
+
+        h = serve.run(
+            TwoHeads.bind(shared, shared), name="shared", route_prefix=None
+        )
+        assert h.remote(1).result(timeout_s=60) == 14
+        # the SAME Application object bound twice → one shared deployment
+        assert set(serve.status()["shared"]) == {"TwoHeads", "Model"}
+        serve.delete("shared")
+
+    def test_response_chaining_passes_by_reference(self, cluster):
+        # driver-side chaining: feed one deployment's response straight
+        # into another without materializing it in the driver
+        m2 = serve.run(Model.bind(2), name="chain_a", route_prefix=None)
+        m3 = serve.run(Model.bind(3), name="chain_b", route_prefix=None)
+        resp = m2.remote(5)
+        out = m3.remote(resp).result(timeout_s=60)
+        assert out == 30  # (5*2)*3
+        serve.delete("chain_a")
+        serve.delete("chain_b")
+
+    def test_cycle_rejected(self, cluster):
+        a = Model.bind(1)
+        b = Model.bind(a)
+        # force a cycle by mutating post-bind (a DAG by construction
+        # otherwise)
+        a.deployment.init_args = (b,)
+        with pytest.raises(ValueError, match="cycle"):
+            serve.run(b, name="cyc", route_prefix=None)
+
+
+class TestGraphEdges:
+    def test_get_app_handle_returns_ingress_root(self, cluster):
+        @serve.deployment
+        class Root:
+            def __init__(self, inner):
+                self.inner = inner
+
+            async def __call__(self, x):
+                return 100 + await self.inner.remote(x)
+
+        serve.run(Root.bind(Model.bind(2)), name="rooted", route_prefix=None)
+        h = serve.get_app_handle("rooted")
+        # children flatten before parents: the handle must still target
+        # the graph ROOT, not the first-listed leaf
+        assert h.remote(5).result(timeout_s=60) == 110
+        serve.delete("rooted")
+
+    def test_dedupe_suffix_avoids_genuine_name(self, cluster):
+        @serve.deployment(name="Model_1")
+        class Genuine:
+            def __call__(self, x):
+                return -x
+
+        @serve.deployment
+        class Agg:
+            def __init__(self, a, b, c):
+                self.parts = (a, b, c)
+
+            async def __call__(self, x):
+                vals = [await p.remote(x) for p in self.parts]
+                return vals
+
+        app = Agg.bind(Genuine.bind(), Model.bind(2), Model.bind(3))
+        h = serve.run(app, name="dedupe", route_prefix=None)
+        assert sorted(h.remote(10).result(timeout_s=60)) == [-10, 20, 30]
+        names = set(serve.status()["dedupe"])
+        assert "Model_1" in names and len(names) == 4  # nothing dropped
+        serve.delete("dedupe")
+
+    def test_streaming_composition_inside_replica(self, cluster):
+        @serve.deployment
+        class TokenSource:
+            def gen(self, n):
+                for i in range(n):
+                    yield {"tok": i}
+
+        @serve.deployment
+        class StreamWrapper:
+            def __init__(self, src):
+                self.src = src
+
+            async def __call__(self, n):
+                # streaming handle call composed INSIDE a replica: the
+                # lazy first dispatch must not block the replica's loop
+                out = []
+                gen = self.src.options(
+                    method_name="gen", stream=True
+                ).remote(n)
+                while True:
+                    try:
+                        import asyncio
+
+                        item = await gen._next_async()
+                    except StopAsyncIteration:
+                        break
+                    out.append(item["tok"])
+                return out
+
+        app = StreamWrapper.bind(TokenSource.bind())
+        h = serve.run(app, name="stream_comp", route_prefix=None)
+        assert h.remote(4).result(timeout_s=60) == [0, 1, 2, 3]
+        serve.delete("stream_comp")
+
+    def test_concurrent_await_dispatches_once(self, cluster):
+        @serve.deployment
+        class Counter:
+            def __init__(self):
+                self.calls = 0
+
+            def bump(self):
+                self.calls += 1
+                return self.calls
+
+            def total(self):
+                return self.calls
+
+        @serve.deployment
+        class Waiter:
+            def __init__(self, inner):
+                self.inner = inner
+
+            async def __call__(self):
+                import asyncio
+
+                resp = self.inner.options(method_name="bump").remote()
+                # two concurrent consumers of ONE lazy response: the
+                # request must execute exactly once
+                a, b = await asyncio.gather(
+                    resp.result_async(), resp.result_async()
+                )
+                total = await self.inner.options(
+                    method_name="total"
+                ).remote()
+                return {"a": a, "b": b, "total": total}
+
+        app = Waiter.bind(Counter.bind())
+        h = serve.run(app, name="once", route_prefix=None)
+        out = h.remote().result(timeout_s=60)
+        assert out["a"] == out["b"] == 1
+        assert out["total"] == 1
+        serve.delete("once")
+
+    def test_nested_response_chaining(self, cluster):
+        m2 = serve.run(Model.bind(2), name="nest_a", route_prefix=None)
+
+        @serve.deployment
+        class SumList:
+            def __call__(self, items):
+                return sum(items)
+
+        s = serve.run(SumList.bind(), name="nest_b", route_prefix=None)
+        # responses nested in a container chain by reference too
+        out = s.remote([m2.remote(1), m2.remote(2)]).result(timeout_s=60)
+        assert out == 6  # 2 + 4
+        serve.delete("nest_a")
+        serve.delete("nest_b")
+
+
+class TestLLMRouterExperts:
+    """Router→experts: the LLM-serving composition shape — an ingress
+    router picks an expert deployment per request (by task tag), each
+    expert a separately-scaled model deployment."""
+
+    def test_router_dispatches_to_experts(self, cluster):
+        @serve.deployment
+        class Expert:
+            def __init__(self, name):
+                self.name = name
+
+            def __call__(self, prompt):
+                return {"expert": self.name, "completion": f"[{self.name}] {prompt}"}
+
+        @serve.deployment
+        class LLMRouter:
+            def __init__(self, experts):
+                self.experts = experts  # dict[str, DeploymentHandle]
+
+            async def __call__(self, prompt, task="chat"):
+                handle = self.experts.get(task)
+                if handle is None:
+                    return {"error": f"no expert for {task!r}"}
+                return await handle.remote(prompt)
+
+        app = LLMRouter.bind(
+            {"chat": Expert.bind("chat-7b"), "code": Expert.bind("code-13b")}
+        )
+        h = serve.run(app, name="llm_router", route_prefix=None)
+        out = h.remote("write a haiku", task="chat").result(timeout_s=60)
+        assert out["expert"] == "chat-7b"
+        out = h.remote("fix this bug", task="code").result(timeout_s=60)
+        assert out["expert"] == "code-13b"
+        assert "no expert" in h.remote("x", task="video").result(
+            timeout_s=60
+        )["error"]
+        # three deployments behind one ingress
+        assert set(serve.status()["llm_router"]) == {
+            "LLMRouter", "Expert", "Expert_1",
+        }
+        serve.delete("llm_router")
