@@ -423,6 +423,49 @@ def bench_broadcast_1gib(ray_tpu, n_readers=8, gib=1.0):
     return wall
 
 
+def bench_scheduler_scale(n_nodes=1000, n_leases=10_000):
+    """1k virtual nodes on a fresh GCS, lease churn latency + GCS CPU
+    (tests/test_scheduler_scale.py tier 2 is the full envelope proof;
+    this row is the driver-captured excerpt).  Self-contained: own GCS
+    subprocess, no ray_tpu.init needed."""
+    import asyncio
+    import tempfile
+
+    from ray_tpu.core import node as node_mod
+    from ray_tpu.util import sched_bench as sb
+
+    prev = os.environ.get("RT_NODE_DEATH_TIMEOUT_S")
+    os.environ["RT_NODE_DEATH_TIMEOUT_S"] = "600"  # single-loop stubs
+    tmp = tempfile.mkdtemp(prefix="rt_bench_sched_")
+    proc, address = node_mod.start_gcs(tmp)
+    try:
+        meter = sb.GcsCpuMeter(proc.pid)
+
+        async def main():
+            stubs, hb = await sb.start_fleet(address, n_nodes)
+            clients = await sb.connect_clients(address, 8)
+            lats, wall = await sb.lease_churn(clients, n_leases, 512)
+            await sb.close_clients(clients)
+            await sb.stop_fleet(stubs, hb)
+            return lats, wall
+
+        lats, wall = asyncio.run(main())
+        cpu = meter.sample()
+        return {
+            "p50_ms": lats[len(lats) // 2] * 1e3,
+            "p95_ms": lats[int(len(lats) * 0.95)] * 1e3,
+            "rate": n_leases / wall,
+            "gcs_cpu_frac": cpu["cpu_frac"],
+        }
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        if prev is None:
+            os.environ.pop("RT_NODE_DEATH_TIMEOUT_S", None)
+        else:
+            os.environ["RT_NODE_DEATH_TIMEOUT_S"] = prev
+
+
 def bench_get_calls(ray_tpu, duration_s=3.0):
     ref = ray_tpu.put(b"x" * 1024)
     ray_tpu.get(ref)
@@ -657,6 +700,21 @@ def main():
             ray_tpu.shutdown()
     except Exception as e:  # noqa: BLE001
         emit("control_plane_family", 0.0, "rows", error=repr(e))
+
+    # scheduler scale excerpt: 1k virtual nodes, lease-churn latency
+    # (full tier: tests/test_scheduler_scale.py).  After the cluster
+    # shut down — it needs the host's whole core.
+    if remaining() > 150:
+        try:
+            s = bench_scheduler_scale()
+            emit(
+                "scheduler_1k_nodes_lease_churn", s["rate"], "leases/s",
+                p50_ms=round(s["p50_ms"], 1), p95_ms=round(s["p95_ms"], 1),
+                gcs_cpu_frac=s["gcs_cpu_frac"],
+            )
+        except Exception as e:  # noqa: BLE001
+            emit("scheduler_1k_nodes_lease_churn", 0.0, "leases/s",
+                 error=repr(e))
 
     # Leftover budget: upgrade/recover the TPU row.  Upgrade = unrolled
     # scan (~0.44 MFU vs rolled ~0.36); recover = tunnel was down

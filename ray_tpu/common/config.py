@@ -98,6 +98,11 @@ D("object_spill_max_restore_bytes", int, 0)  # 0 = no cap on restore size
 
 # --- scheduler ---
 D("sched_spread_threshold", float, 0.5)
+# pending-lease wake scan: max non-placeable requests scanned (rotated
+# to the tail) and max waiters woken per pass — bounds each pass at
+# O(window) instead of O(backlog); grant-chaining re-kicks keep large
+# capacity releases draining
+D("sched_kick_scan_window", int, 64)
 D("sched_max_pending_lease_s", float, 60.0)
 D("worker_pool_prestart", int, 0)
 D("worker_idle_timeout_s", float, 300.0)

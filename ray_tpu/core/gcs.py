@@ -25,7 +25,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
+from typing import Deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -60,6 +61,26 @@ class NodeEntry:
     alive: bool = True
     draining: bool = False  # drain requested: stop scheduling onto it
     last_heartbeat: float = field(default_factory=time.monotonic)
+
+    # Write-through scheduler index: every assignment to a field the
+    # scheduler scores by re-buckets this node (class attrs, not dataclass
+    # fields — set per-instance by Scheduler.index_node).
+    _sched = None
+    _bucket = None
+
+    def __setattr__(self, name, value):
+        if name == "resources_available":
+            old = getattr(self, "resources_available", None)
+            object.__setattr__(self, name, value)
+            sched = self._sched
+            if sched is not None:
+                sched.note_available_change(self, old, value)
+            return
+        object.__setattr__(self, name, value)
+        if name in ("alive", "draining", "conn"):
+            sched = self._sched
+            if sched is not None:
+                sched.rebucket(self)
 
 
 @dataclass
@@ -153,28 +174,112 @@ class PendingLease:
 # --------------------------------------------------------------------------
 
 
+_NBUCKETS = 64          # utilization buckets (~1.6% granularity)
+_FULL_BUCKET = _NBUCKETS        # max-utilization >= 1.0
+_PARKED_BUCKET = _NBUCKETS + 1  # dead / draining / not-yet-attached
+
+
 class Scheduler:
     """Global resource accounting + node selection.
 
-    Scale envelope (documented, by design): node selection is O(nodes)
-    per lease and `_kick_pending` re-evaluates the pending queue after
-    each release/registration — linear scans sized for TPU clusters
-    (O(100s) of hosts; a v5e-256 pod is 64 hosts), not the reference's
-    2,000-node CPU fleets.  At that scale the constant factors here are
-    noise next to worker spawn and XLA compile; a feasibility-class
-    index is the upgrade path if host counts grow 10x.
+    Scale: nodes live in a write-through utilization-bucket index
+    (NodeEntry.__setattr__ re-buckets on every availability/liveness
+    change), so node selection is O(1) amortized instead of an O(nodes)
+    scan — the binpack/spread orderings become bucket-granular (~1.6%)
+    approximations of their exact forms.  Feasibility checks are cached
+    per demand signature (totals only change on membership changes).
+    `_kick_pending` wakes queued requests through a bounded scan window,
+    so a deep backlog (100k+ queued, reference envelope: 1M) costs
+    O(granted + window) per freed lease, not O(backlog).
     """
 
     def __init__(self, gcs: "GcsServer"):
         self.gcs = gcs
-        self.pending: List[PendingLease] = []
-
-    def feasible_nodes(self, demand: ResourceSet) -> List[NodeEntry]:
-        return [
-            n
-            for n in self.gcs.nodes.values()
-            if n.alive and n.resources_total.covers(demand)
+        self.pending: Deque[PendingLease] = deque()
+        self._buckets: List[Dict[NodeID, NodeEntry]] = [
+            {} for _ in range(_PARKED_BUCKET + 1)
         ]
+        self._node_entry: Dict[NodeID, NodeEntry] = {}  # indexed entry
+        self._feasible_cache: Dict[tuple, bool] = {}
+        # no-fit fast path: when nothing in the cluster fits a demand,
+        # every queued waiter re-asks constantly (kick scans) — a full
+        # fail scan touches the whole "full" bucket, O(nodes).  A no-fit
+        # verdict stays valid until capacity INCREASES somewhere, so it's
+        # cached against an epoch bumped on every availability increase
+        # (returns, node joins, unparks) — never on debits, which can't
+        # turn no-fit into fit.
+        self._capacity_epoch = 0
+        self._nofit: Dict[tuple, int] = {}
+
+    # -- index maintenance ----------------------------------------------
+    def index_node(self, n: NodeEntry):
+        # Evict a superseded entry for the same node (raylet
+        # re-registration builds a fresh NodeEntry): the old one may sit
+        # in a different bucket and would otherwise remain pickable
+        # forever — a live ghost the scheduler grants against.
+        old = self._node_entry.get(n.node_id)
+        if old is not None and old is not n:
+            if old._bucket is not None:
+                self._buckets[old._bucket].pop(n.node_id, None)
+            object.__setattr__(old, "_sched", None)
+            object.__setattr__(old, "_bucket", None)
+        self._node_entry[n.node_id] = n
+        object.__setattr__(n, "_sched", self)
+        object.__setattr__(n, "_bucket", None)
+        self.rebucket(n)
+        self._feasible_cache.clear()
+
+    def _bucket_of(self, n: NodeEntry) -> int:
+        if not n.alive or n.conn is None or n.draining:
+            return _PARKED_BUCKET
+        u = n.resources_available.utilization(n.resources_total)
+        if u >= 1.0:
+            return _FULL_BUCKET
+        return min(int(u * _NBUCKETS), _NBUCKETS - 1)
+
+    def rebucket(self, n: NodeEntry):
+        b = self._bucket_of(n)
+        old = n._bucket
+        if b == old:
+            return
+        if old is not None:
+            self._buckets[old].pop(n.node_id, None)
+        self._buckets[b][n.node_id] = n
+        object.__setattr__(n, "_bucket", b)
+        if old is None or b < old:
+            # capacity appeared (node joined / unparked / freed into a
+            # lower-utilization bucket)
+            self._capacity_epoch += 1
+        if b == _PARKED_BUCKET or old == _PARKED_BUCKET:
+            # liveness changed: cached feasibility may now be wrong
+            self._feasible_cache.clear()
+
+    def note_available_change(self, n: NodeEntry, old_rs, new_rs):
+        """resources_available was assigned: rebucket, and bump the
+        capacity epoch on any per-resource INCREASE even when the bucket
+        index doesn't move (a 1-CPU return on a large node stays in the
+        same ~1.6% bucket but can turn a cached no-fit into a fit)."""
+        self.rebucket(n)
+        if old_rs is None:
+            self._capacity_epoch += 1
+            return
+        old_fp = old_rs._fp
+        for k, v in new_rs._fp.items():
+            if v > old_fp.get(k, 0):
+                self._capacity_epoch += 1
+                return
+
+    # -- queries ---------------------------------------------------------
+    def is_feasible(self, demand: ResourceSet) -> bool:
+        key = tuple(sorted(demand._fp.items()))
+        hit = self._feasible_cache.get(key)
+        if hit is None:
+            hit = any(
+                n.alive and n.resources_total.covers(demand)
+                for n in self.gcs.nodes.values()
+            )
+            self._feasible_cache[key] = hit
+        return hit
 
     def pick_node(
         self, demand: ResourceSet, strategy: Dict[str, Any]
@@ -192,35 +297,50 @@ class Scheduler:
             elif node:
                 return None  # hard affinity: wait for that node
             # unknown node id with hard affinity -> handled by caller
-        candidates = [
-            n
-            for n in self.gcs.nodes.values()
-            # conn=None: checkpoint-restored node whose raylet has not
-            # re-attached yet — known, but not schedulable
-            if n.alive and n.conn is not None and not n.draining
-            and n.resources_available.covers(demand)
-        ]
-        if not candidates:
+        # no-fit fast path (default/spread only — node_affinity restricts
+        # the candidate set and is a cheap single lookup anyway)
+        key = tuple(sorted(demand._fp.items()))
+        if self._nofit.get(key) == self._capacity_epoch:
             return None
         if stype == "spread":
-            # least-utilized first
-            return min(
-                candidates,
-                key=lambda n: n.resources_available.utilization(n.resources_total),
-            )
-        # default: hybrid binpack — prefer the most-utilized node that still
-        # fits while below the spread threshold, so small tasks pack and big
-        # clusters don't fragment (ray: hybrid_scheduling_policy.cc in spirit)
-        thresh = cfg.sched_spread_threshold
-        packed = [
-            n
-            for n in candidates
-            if n.resources_available.utilization(n.resources_total) < thresh
-        ]
-        pool = packed or candidates
-        return max(
-            pool, key=lambda n: n.resources_available.utilization(n.resources_total)
+            # least-utilized first (bucket-granular); the "full" bucket
+            # still gets scanned last — a node can be max-utilized in one
+            # resource yet cover a demand on another
+            node = self._first_covering(demand, range(0, _FULL_BUCKET + 1))
+            if node is None:
+                self._note_nofit(key)
+            return node
+        # default: hybrid binpack — prefer the most-utilized node that
+        # still fits while below the spread threshold, so small tasks pack
+        # and big clusters don't fragment (ray: hybrid_scheduling_policy.cc
+        # in spirit); above-threshold nodes next, max-utilized last
+        thresh_b = min(
+            int(cfg.sched_spread_threshold * _NBUCKETS), _NBUCKETS
         )
+        node = self._first_covering(demand, range(thresh_b - 1, -1, -1))
+        if node is None:
+            node = self._first_covering(
+                demand, range(_NBUCKETS - 1, thresh_b - 1, -1)
+            )
+        if node is None:
+            node = self._first_covering(
+                demand, (_FULL_BUCKET,)
+            )
+        if node is None:
+            self._note_nofit(key)
+        return node
+
+    def _note_nofit(self, key):
+        if len(self._nofit) > 4096:
+            self._nofit.clear()
+        self._nofit[key] = self._capacity_epoch
+
+    def _first_covering(self, demand, bucket_order):
+        for b in bucket_order:
+            for n in self._buckets[b].values():
+                if n.resources_available.covers(demand):
+                    return n
+        return None
 
 
 # --------------------------------------------------------------------------
@@ -518,7 +638,7 @@ class GcsServer:
         """
         now = time.monotonic()
         for nid, n in st["nodes"].items():
-            self.nodes[nid] = NodeEntry(
+            self.nodes[nid] = entry = NodeEntry(
                 node_id=nid,
                 address=n["address"],
                 resources_total=ResourceSet(n["resources"]),
@@ -528,6 +648,7 @@ class GcsServer:
                 alive=True,
                 last_heartbeat=now,
             )
+            self.scheduler.index_node(entry)
         self.actors.update(st["actors"])
         self.named_actors.update(st["named_actors"])
         self.jobs.update(st["jobs"])
@@ -928,6 +1049,7 @@ class GcsServer:
             if nid == node_id and old_conn is not conn:
                 del self._conn_node[old_conn]
         self.nodes[node_id] = entry
+        self.scheduler.index_node(entry)
         self._conn_node[conn] = node_id
         await self.publish(
             "nodes",
@@ -1808,7 +1930,7 @@ class GcsServer:
         if strategy.get("type") == "placement_group":
             return await self._request_pg_lease(conn, p, demand, strategy)
         actor_id = ActorID(p["actor_id"]) if p.get("actor_id") else None
-        if not self.scheduler.feasible_nodes(demand):
+        if not self.scheduler.is_feasible(demand):
             raise rpc.RpcError(
                 f"infeasible resource request {demand.to_dict()}: no node in the "
                 f"cluster can ever satisfy it (cluster: "
@@ -1839,7 +1961,13 @@ class GcsServer:
                 continue
             if not node.resources_available.covers(demand):
                 continue  # stale pick; loop re-evaluates
-            return await self._grant_lease(node, demand, conn, p)
+            granted = await self._grant_lease(node, demand, conn, p)
+            # chain the drain: kicks wake at most a window of waiters, so
+            # a large capacity release (PG removal, node join) relies on
+            # each resulting grant re-kicking to keep freed slots filling
+            if self.scheduler.pending:
+                self._kick_pending()
+            return granted
 
     async def _grant_lease(
         self, node: NodeEntry, demand: ResourceSet, conn, p, pg_ref=None
@@ -1992,8 +2120,30 @@ class GcsServer:
             if not self._try_place_pg(pg):
                 still_pgs.append(pg_id)
         self._pending_pgs = still_pgs
-        still: List[PendingLease] = []
-        for req in self.scheduler.pending:
+        # Bounded scan: each pass pops at most `sched_kick_scan_window`
+        # non-placeable requests and wakes at most `window` placeable
+        # ones.  The wake bound matters at depth: capacity is only
+        # debited when a woken coroutine actually grants, so during this
+        # synchronous loop pick_node keeps seeing the same free capacity
+        # — unbounded, one freed CPU against a 100k-deep queue would wake
+        # ALL 100k waiters (thundering herd, O(backlog) per freed lease).
+        # Scanned-but-unplaceable requests ROTATE TO THE TAIL: strict
+        # FIFO would let 64 unplaceable requests at the head permanently
+        # shadow a placeable one behind them; rotation round-robins the
+        # whole queue across kicks instead (lease grant order is not a
+        # FIFO contract — and the client-side LEASE_PENDING re-request
+        # after sched_max_pending_lease_s is the liveness backstop for
+        # any request the rotation visits rarely).  Under-wake after a
+        # large capacity release is absorbed by grant-chaining: every
+        # successful grant re-kicks while the queue is non-empty.
+        pending = self.scheduler.pending
+        budget = len(pending)
+        fails = 0
+        wakes = 0
+        window = cfg.sched_kick_scan_window
+        while pending and budget > 0 and fails < window and wakes < window:
+            budget -= 1
+            req = pending.popleft()
             if req.fut.done():
                 continue
             if req.client_conn.closed:
@@ -2002,9 +2152,10 @@ class GcsServer:
             node = self.scheduler.pick_node(req.demand, req.strategy)
             if node is not None:
                 req.fut.set_result(True)  # waker only; requester re-picks
+                wakes += 1
             else:
-                still.append(req)
-        self.scheduler.pending = still
+                fails += 1
+                pending.append(req)  # rotate to tail
 
     # ---- actors --------------------------------------------------------
     async def rpc_register_actor(self, conn, p):

@@ -189,6 +189,31 @@ class DeploymentResponse:
             self._done = True
             self._router.done(self._replica)
 
+    def _settle_when_resolved(self):
+        """Release the upstream replica's in-flight slot only when its
+        result actually lands, not at chain time — the pow-2 router's
+        load signal must keep counting a still-executing request
+        (chaining hands the wait to the downstream task's arg
+        resolution, so nobody else will fetch this ref)."""
+        if self._done:
+            return
+        try:
+            from ray_tpu.core.runtime import get_runtime
+
+            rt = get_runtime()
+
+            async def waiter():
+                try:
+                    await rt.await_ref(self._ref)
+                except Exception:
+                    pass
+                finally:
+                    self._settle()
+
+            rt._spawn(waiter())
+        except Exception:
+            self._settle()  # never leak the in-flight count
+
     def __await__(self):
         """`await handle.remote(...)` inside an async deployment — the
         composition idiom (reference: DeploymentResponse.__await__)."""
@@ -353,7 +378,7 @@ class DeploymentHandle:
             def chain(v):
                 if isinstance(v, DeploymentResponse):
                     ref = v.ref  # ensures dispatched
-                    v._settle()
+                    v._settle_when_resolved()
                     return ref
                 if isinstance(v, list):
                     return [chain(x) for x in v]

@@ -1,0 +1,276 @@
+"""Scheduler scale harness: N virtual nodes hammering one GCS.
+
+The role of the reference's release-scale suites
+(ray: release/benchmarks/distributed/test_many_tasks.py, many_actors,
+many_pgs — published envelope: 2,000 nodes / 40k actors / 10k live
+tasks / 1M queued) adapted to the protocol layer: stub raylets are
+asyncio connections, not processes, because the envelope under test is
+the central scheduler's event loop, not worker spawn.  Used by
+tests/test_scheduler_scale.py (tiered envelope proof) and bench.py
+(driver-captured rows).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.common.ids import ActorID, NodeID, WorkerID
+from ray_tpu.core import rpc
+
+
+class StubRaylet:
+    """One virtual node: registers with the GCS and grants fake workers."""
+
+    def __init__(self, gcs_address: str, idx: int, cpus: float = 16.0):
+        self.gcs_address = gcs_address
+        self.idx = idx
+        self.cpus = cpus
+        self.node_id = NodeID.random()
+        self.conn = None
+        self._worker_seq = 0
+
+    async def start(self):
+        self.conn = await rpc.connect(
+            self.gcs_address, self._handle, name=f"stub-raylet-{self.idx}"
+        )
+        await self.conn.call("register_node", {
+            "node_id": self.node_id.binary(),
+            "address": f"10.{self.idx // 65536}.{(self.idx // 256) % 256}"
+                       f".{self.idx % 256}:7000",
+            "resources": {"CPU": self.cpus, "memory": 64e9},
+            "labels": {"stub": "1"},
+        })
+
+    async def _handle(self, conn, method, p):
+        if method == "lease_worker":
+            self._worker_seq += 1
+            return {
+                "worker_id": WorkerID.random().binary(),
+                "worker_addr": f"10.1.0.{self.idx}:{9000 + self._worker_seq}",
+            }
+        if method in ("release_worker", "drain_node", "delete_objects"):
+            return True
+        if method == "ping":
+            return True
+        raise rpc.RpcError(f"stub raylet: unexpected {method!r}")
+
+    async def heartbeat_loop(self, period_s: float = 2.0):
+        while True:
+            await asyncio.sleep(period_s)
+            try:
+                await self.conn.notify(
+                    "heartbeat", {"node_id": self.node_id.binary()}
+                )
+            except Exception:
+                return
+
+
+class GcsCpuMeter:
+    """CPU seconds of the GCS process from /proc/<pid>/stat (utime+stime)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._t0 = self._read()
+        self._w0 = time.monotonic()
+
+    def _read(self) -> float:
+        try:
+            with open(f"/proc/{self.pid}/stat") as f:
+                parts = f.read().rsplit(") ", 1)[1].split()
+            # fields 14/15 (1-based) are utime/stime, here offset by the
+            # two fields consumed before the split
+            utime, stime = int(parts[11]), int(parts[12])
+            return (utime + stime) / os.sysconf("SC_CLK_TCK")
+        except Exception:
+            return 0.0
+
+    def sample(self) -> Dict[str, float]:
+        cpu = self._read() - self._t0
+        wall = time.monotonic() - self._w0
+        return {
+            "cpu_s": round(cpu, 2),
+            "wall_s": round(wall, 2),
+            "cpu_frac": round(cpu / wall, 3) if wall > 0 else 0.0,
+        }
+
+
+async def start_fleet(address: str, n_nodes: int, wave: int = 50,
+                      heartbeats: bool = True):
+    stubs = [StubRaylet(address, i) for i in range(n_nodes)]
+    hb_tasks = []
+    loop = asyncio.get_running_loop()
+    for i in range(0, n_nodes, wave):
+        batch = stubs[i:i + wave]
+        await asyncio.gather(*(s.start() for s in batch))
+        if heartbeats:
+            # heartbeats start per-wave: registering a large fleet takes
+            # longer than node_death_timeout_s on a small host, and the
+            # first waves must not be declared dead while later waves
+            # are still connecting
+            hb_tasks.extend(
+                loop.create_task(s.heartbeat_loop()) for s in batch
+            )
+    return stubs, hb_tasks
+
+
+async def stop_fleet(stubs, hb_tasks):
+    for t in hb_tasks:
+        t.cancel()
+    for s in stubs:
+        try:
+            await s.conn.close()
+        except Exception:
+            pass
+
+
+async def _lease_with_retry(client, resources, timeout=600.0):
+    """request_lease with the runtime's LEASE_PENDING contract: a queued
+    request is woken-or-expired within sched_max_pending_lease_s and the
+    client re-requests (core/runtime.py does exactly this), so a deep
+    backlog never strands a caller."""
+    while True:
+        try:
+            return await client.call("request_lease", {
+                "resources": dict(resources),
+                "strategy": {},
+            }, timeout=timeout)
+        except rpc.RpcError as e:
+            if "LEASE_PENDING" not in str(e):
+                raise
+
+
+async def lease_churn(clients: List, n_leases: int, concurrency: int,
+                      resources: Optional[dict] = None):
+    """n_leases request→return cycles spread over the client conns;
+    returns (sorted latencies, wall seconds)."""
+    resources = resources or {"CPU": 1.0}
+    latencies: List[float] = []
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one(i):
+        client = clients[i % len(clients)]
+        async with sem:
+            t0 = time.perf_counter()
+            grant = await _lease_with_retry(client, resources)
+            latencies.append(time.perf_counter() - t0)
+            await client.call("return_lease", {"lease_id": grant["lease_id"]})
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(i) for i in range(n_leases)))
+    wall = time.perf_counter() - t0
+    latencies.sort()
+    return latencies, wall
+
+
+async def queued_task_backlog(clients: List, n_tasks: int):
+    """Submit n_tasks lease requests AT ONCE (far beyond capacity) so the
+    scheduler carries a queue ~(n_tasks - cluster slots) deep, then drain
+    it by returning every grant as it lands.  Returns wall seconds."""
+    done = 0
+    t0 = time.perf_counter()
+
+    async def one(i):
+        nonlocal done
+        client = clients[i % len(clients)]
+        grant = await _lease_with_retry(client, {"CPU": 1.0}, timeout=1800)
+        await client.call("return_lease", {"lease_id": grant["lease_id"]})
+        done += 1
+
+    await asyncio.gather(*(one(i) for i in range(n_tasks)))
+    wall = time.perf_counter() - t0
+    assert done == n_tasks
+    return wall
+
+
+async def actor_lifecycle_storm(clients: List, n_actors: int,
+                                concurrency: int):
+    """register_actor → request_lease → actor_started for n_actors, then
+    kill them all — the GCS actor FSM at fleet scale.  Returns
+    (register_wall, kill_wall)."""
+    sem = asyncio.Semaphore(concurrency)
+    actor_ids: List[bytes] = []
+
+    async def create(i):
+        client = clients[i % len(clients)]
+        async with sem:
+            aid = ActorID.random()
+            await client.call("register_actor", {
+                "actor_id": aid.binary(),
+                "resources": {"CPU": 0.01},
+                "strategy": {},
+                "creation_spec": None,
+                "job_id": None,
+            })
+            grant = await _lease_with_retry(client, {"CPU": 0.01})
+            await client.call("actor_started", {
+                "actor_id": aid.binary(),
+                "worker_addr": grant["worker_addr"],
+                "node_id": grant["node_id"],  # hex, as granted
+                "lease_id": grant["lease_id"],
+            })
+            actor_ids.append(aid.binary())
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(create(i) for i in range(n_actors)))
+    reg_wall = time.perf_counter() - t0
+
+    async def kill(i):
+        client = clients[i % len(clients)]
+        async with sem:
+            await client.call("kill_actor", {
+                "actor_id": actor_ids[i], "no_restart": True,
+            })
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(kill(i) for i in range(len(actor_ids))))
+    kill_wall = time.perf_counter() - t0
+    return reg_wall, kill_wall
+
+
+async def pg_storm(clients: List, n_pgs: int, bundles_per_pg: int,
+                   concurrency: int):
+    """n_pgs placement groups held CONCURRENTLY (atomic multi-bundle
+    placement), then removed.  Returns (create_wall, remove_wall)."""
+    sem = asyncio.Semaphore(concurrency)
+    pg_ids = [os.urandom(16) for _ in range(n_pgs)]
+
+    async def create(i):
+        client = clients[i % len(clients)]
+        async with sem:
+            await client.call("create_placement_group", {
+                "pg_id": pg_ids[i],
+                "bundles": [{"CPU": 1.0}] * bundles_per_pg,
+                "strategy": "SPREAD",
+                "job_id": None,
+            }, timeout=600)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(create(i) for i in range(n_pgs)))
+    create_wall = time.perf_counter() - t0
+
+    async def remove(i):
+        client = clients[i % len(clients)]
+        async with sem:
+            await client.call("remove_placement_group", {"pg_id": pg_ids[i]})
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(remove(i) for i in range(n_pgs)))
+    remove_wall = time.perf_counter() - t0
+    return create_wall, remove_wall
+
+
+async def connect_clients(address: str, n: int):
+    return [
+        await rpc.connect(address, name=f"scale-client-{i}") for i in range(n)
+    ]
+
+
+async def close_clients(clients):
+    for c in clients:
+        try:
+            await c.close()
+        except Exception:
+            pass

@@ -149,3 +149,93 @@ def test_100_nodes_2k_lease_churn_latency(gcs_proc):
     assert p95 < 1.0, f"p95 lease latency {p95:.3f}s"
     assert rate > 100, f"lease churn rate {rate:.0f}/s"
     assert pg_wall < 30, f"PG churn too slow: {pg_wall:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: 1,000 nodes / 20k actors / 100k queued tasks / 1k concurrent PGs
+# (10x tier 1; reference published envelope: 2,000 nodes, 40k actors,
+# 1M queued — release/benchmarks/README.md:5-13.)  Enabled by the
+# utilization-bucket scheduler index + windowed pending-queue wakes;
+# before those, this tier was O(backlog) per freed lease and unrunnable.
+# ---------------------------------------------------------------------------
+
+
+def test_1k_nodes_100k_queued_20k_actors_1k_pgs(tmp_path, monkeypatch):
+    from ray_tpu.util import sched_bench as sb
+
+    # All 1000 stub heartbeat loops share this test's ONE asyncio loop
+    # with 100k request coroutines; they can starve past the 10 s death
+    # timeout in ways separate raylet processes never would.  Failure
+    # detection is not the envelope under test here — scheduler
+    # throughput is — so give the GCS a storm-proof timeout.
+    monkeypatch.setenv("RT_NODE_DEATH_TIMEOUT_S", "600")
+    proc, address = node_mod.start_gcs(str(tmp_path))
+    try:
+        meter = sb.GcsCpuMeter(proc.pid)
+
+        async def main():
+            out = {}
+            stubs, hb = await sb.start_fleet(address, 1000)
+            clients = await sb.connect_clients(address, 8)
+
+            # a) steady lease churn at 1k nodes: latency distribution
+            t = time.perf_counter()
+            lats, wall = await sb.lease_churn(
+                clients, 20_000, concurrency=512
+            )
+            out["churn"] = {
+                "p50_ms": lats[len(lats) // 2] * 1e3,
+                "p95_ms": lats[int(len(lats) * 0.95)] * 1e3,
+                "rate": 20_000 / wall,
+            }
+
+            # b) 100k tasks submitted at once: the scheduler carries an
+            # ~84k-deep queue (16k CPU slots) and must drain it fully
+            out["backlog_wall"] = await sb.queued_task_backlog(
+                clients, 100_000
+            )
+
+            # c) 20k actors through the FSM (register→lease→started),
+            # then all killed
+            reg_wall, kill_wall = await sb.actor_lifecycle_storm(
+                clients, 20_000, concurrency=512
+            )
+            out["actor_reg_rate"] = 20_000 / reg_wall
+            out["actor_kill_rate"] = 20_000 / kill_wall
+
+            # d) 1,000 placement groups HELD CONCURRENTLY (4 bundles
+            # each = 4k of 16k CPUs reserved), then removed
+            create_wall, remove_wall = await sb.pg_storm(
+                clients, 1_000, bundles_per_pg=4, concurrency=128
+            )
+            out["pg_create_rate"] = 1_000 / create_wall
+            out["pg_remove_rate"] = 1_000 / remove_wall
+
+            await sb.close_clients(clients)
+            await sb.stop_fleet(stubs, hb)
+            return out
+
+        out = asyncio.run(main())
+        cpu = meter.sample()
+        print(
+            f"\n1k-node tier: churn p50={out['churn']['p50_ms']:.1f}ms "
+            f"p95={out['churn']['p95_ms']:.1f}ms "
+            f"rate={out['churn']['rate']:.0f}/s; "
+            f"100k-task backlog drained in {out['backlog_wall']:.1f}s "
+            f"({100_000 / out['backlog_wall']:.0f}/s); "
+            f"20k actors reg {out['actor_reg_rate']:.0f}/s "
+            f"kill {out['actor_kill_rate']:.0f}/s; "
+            f"1k PGs create {out['pg_create_rate']:.0f}/s "
+            f"remove {out['pg_remove_rate']:.0f}/s; "
+            f"GCS cpu {cpu['cpu_s']}s over {cpu['wall_s']}s wall "
+            f"({cpu['cpu_frac']:.0%})"
+        )
+        # interactivity bounds, generous for a loaded 1-core host
+        assert out["churn"]["p50_ms"] < 500
+        assert out["churn"]["rate"] > 300
+        assert out["backlog_wall"] < 600, "100k-task backlog drain too slow"
+        assert out["actor_reg_rate"] > 300
+        assert out["pg_create_rate"] > 30
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
