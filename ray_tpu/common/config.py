@@ -85,6 +85,11 @@ D("object_chunk_bytes", int, 16 * 1024 * 1024)  # node-to-node transfer chunk
 
 # --- pip runtime envs (reference: runtime_env/pip.py role)
 D("pip_env_install_timeout_s", float, 600.0)
+# conda executable for conda runtime envs ("" = auto: conda/mamba/
+# micromamba on PATH); container runtime for container runtime envs
+# ("" = auto: podman/docker on PATH)
+D("conda_exe", str, "")
+D("container_runtime", str, "")
 
 # --- streaming generator returns (reference: num_returns="streaming")
 D("streaming_backpressure_items", int, 64)  # unacked items before the

@@ -1950,8 +1950,10 @@ class GcsServer:
                         fut, timeout=deadline - time.monotonic()
                     )
                 except asyncio.TimeoutError:
-                    if entry in self.scheduler.pending:
-                        self.scheduler.pending.remove(entry)
+                    # no eager dequeue: membership + remove are O(queue)
+                    # on a deque, and with 100k queued the timeout path
+                    # IS the hot path.  wait_for already cancelled fut;
+                    # _kick_pending lazily drops done/cancelled entries.
                     raise rpc.RpcError(
                         "LEASE_PENDING: waiting for cluster capacity for "
                         f"{demand.to_dict()}"

@@ -423,8 +423,10 @@ class Raylet:
         return out
 
     # ---- worker pool ---------------------------------------------------
+
     def _spawn_worker(self, python_exe: Optional[str] = None,
-                      venv_key: str = "") -> WorkerEntry:
+                      venv_key: str = "",
+                      container: Optional[tuple] = None) -> WorkerEntry:
         worker_id = WorkerID.random()
         env = dict(os.environ)
         env["RT_WORKER_ID"] = worker_id.hex()
@@ -433,10 +435,25 @@ class Raylet:
         env["RT_NODE_ID"] = self.node_id.hex()
         env["RT_STORE_PATH"] = self.store_path
         env["RT_SESSION_DIR"] = self.session_dir
+        if container is not None:
+            # (prefix, image) from _container_spawn_prefix: the worker
+            # runs inside the container; its env arrives via -e flags
+            # (a container does not inherit the raylet's environ)
+            prefix, image = container
+            argv = list(prefix)
+            for k, v in env.items():
+                if k.startswith(("RT_", "JAX_", "XLA_")):
+                    argv += ["-e", f"{k}={v}"]
+            argv += [image, "python", "-m", "ray_tpu.core.worker_main"]
+        else:
+            argv = [
+                python_exe or sys.executable, "-m",
+                "ray_tpu.core.worker_main",
+            ]
         log_path = os.path.join(self.session_dir, f"worker-{worker_id.hex()[:12]}.log")
         logf = open(log_path, "ab")
         proc = subprocess.Popen(
-            [python_exe or sys.executable, "-m", "ray_tpu.core.worker_main"],
+            argv,
             env=env,
             stdout=logf,
             stderr=subprocess.STDOUT,
@@ -446,11 +463,46 @@ class Raylet:
         self.workers[worker_id] = entry
         return entry
 
+    async def _ensure_cached_env(self, kind: str, key: str, build) -> str:
+        """Shared scaffolding for isolated-interpreter runtime envs (pip
+        venvs, conda envs): env dir keyed under session_dir/<kind>/<key>,
+        creation lock-serialized and marker-gated so concurrent leases —
+        and a restarted raylet — reuse one env.  ``build(root, python)``
+        materializes the env (and must call _inject_parent_site itself
+        at the right point); returns the env's python executable."""
+        root = os.path.join(self.session_dir, kind, key)
+        python = os.path.join(root, "bin", "python")
+        marker = os.path.join(root, ".ready")
+        if os.path.exists(marker):
+            return python
+        lock = self._pip_env_locks.setdefault(
+            f"{kind}:{key}", asyncio.Lock()
+        )
+        async with lock:
+            if os.path.exists(marker):
+                return python
+
+            def run():
+                import shutil
+
+                shutil.rmtree(root, ignore_errors=True)
+                os.makedirs(os.path.dirname(root), exist_ok=True)
+                build(root, python)
+                with open(marker, "w") as f:
+                    f.write("ok")
+
+            try:
+                await asyncio.to_thread(run)
+            except Exception as e:
+                raise rpc.RpcError(
+                    f"{kind.rstrip('s').replace('_', ' ')} setup failed: "
+                    f"{e}"
+                ) from e
+            return python
+
     async def _ensure_pip_env(self, rtenv: dict) -> str:
         """Materialize (once) a virtualenv for a pip runtime env; returns
-        its python executable.  Keyed by the requirement list; creation
-        is lock-serialized and marker-gated, so concurrent leases — and a
-        restarted raylet — reuse one env (reference role:
+        its python executable (reference role:
         python/ray/_private/runtime_env/pip.py PipProcessor).  The venv
         uses --system-site-packages so the base image's jax/numpy stay
         importable; isolation comes from the venv's OWN site-packages
@@ -460,65 +512,122 @@ class Raylet:
 
         reqs = list(rtenv["pip"])
         key = hashlib.sha256(_json.dumps(reqs).encode()).hexdigest()[:16]
-        root = os.path.join(self.session_dir, "pip_envs", key)
-        python = os.path.join(root, "bin", "python")
-        marker = os.path.join(root, ".ready")
-        if os.path.exists(marker):
-            return python
-        lock = self._pip_env_locks.setdefault(key, asyncio.Lock())
-        async with lock:
-            if os.path.exists(marker):
-                return python
 
-            def build():
-                import shutil
+        def build(root, python):
+            subprocess.run(
+                [sys.executable, "-m", "venv",
+                 "--system-site-packages", root],
+                check=True, capture_output=True,
+                timeout=cfg.pip_env_install_timeout_s,
+            )
+            # injection BEFORE install: --no-build-isolation source
+            # builds need setuptools from the parent site
+            _inject_parent_site(root)
+            r = subprocess.run(
+                [python, "-m", "pip", "install",
+                 "--no-build-isolation", *reqs],
+                capture_output=True, text=True,
+                timeout=cfg.pip_env_install_timeout_s,
+            )
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"pip install {reqs} failed: {r.stderr[-800:]}"
+                )
 
-                shutil.rmtree(root, ignore_errors=True)
-                os.makedirs(os.path.dirname(root), exist_ok=True)
-                subprocess.run(
-                    [sys.executable, "-m", "venv",
-                     "--system-site-packages", root],
-                    check=True, capture_output=True,
-                    timeout=cfg.pip_env_install_timeout_s,
-                )
-                # When THIS process runs inside a venv (the common
-                # deployment), the child venv's "system site" resolves to
-                # the base interpreter — not to our venv where jax &
-                # friends live.  A .pth appends our site dirs AFTER the
-                # child's own site-packages, so its installed
-                # requirements shadow ours where they overlap.
-                vs = os.path.join(
-                    root, "lib",
-                    f"python{sys.version_info[0]}.{sys.version_info[1]}",
-                    "site-packages",
-                )
-                parents = [
-                    p for p in sys.path if p.endswith("site-packages")
-                ]
-                if parents and os.path.isdir(vs):
-                    with open(os.path.join(vs, "_rt_parent_env.pth"),
-                              "w") as f:
-                        f.write("\n".join(parents) + "\n")
-                r = subprocess.run(
-                    [python, "-m", "pip", "install",
-                     "--no-build-isolation", *reqs],
-                    capture_output=True, text=True,
-                    timeout=cfg.pip_env_install_timeout_s,
-                )
-                if r.returncode != 0:
-                    raise RuntimeError(
-                        f"pip install {reqs} failed: {r.stderr[-800:]}"
-                    )
-                with open(marker, "w") as f:
-                    f.write("ok")
+        return await self._ensure_cached_env("pip_envs", key, build)
 
-            try:
-                await asyncio.to_thread(build)
-            except Exception as e:
-                raise rpc.RpcError(
-                    f"pip runtime env setup failed: {e}"
-                ) from e
-            return python
+    async def _ensure_conda_env(self, rtenv: dict) -> str:
+        """Materialize (once) a conda env for a conda runtime env;
+        returns its python executable.  Keyed by the canonical spec hash
+        (reference role: python/ray/_private/runtime_env/conda.py —
+        env-spec hashing + cached env creation + runtime injection).
+        The conda executable comes from RT_CONDA_EXE or PATH
+        (conda/mamba/micromamba); a node without one rejects the lease
+        with an actionable error."""
+        import hashlib
+        import json as _json
+        import shutil
+
+        spec = rtenv["conda"]
+        exe = cfg.conda_exe or next(
+            (e for e in ("conda", "mamba", "micromamba") if shutil.which(e)),
+            None,
+        )
+        if exe is None or not shutil.which(exe):
+            raise rpc.RpcError(
+                "conda runtime env requested but no conda executable was "
+                "found on this node (looked for RT_CONDA_EXE, conda, "
+                "mamba, micromamba on PATH). Install miniconda/micromamba "
+                "on every node, or use pip=[...] (virtualenv over the "
+                "base image) / container={'image': ...} instead."
+            )
+        key = hashlib.sha256(
+            _json.dumps(spec, sort_keys=True).encode()
+        ).hexdigest()[:16]
+
+        def build(root, python):
+            cmd = [shutil.which(exe), "create", "--yes", "-p", root]
+            for ch in spec.get("channels", []):
+                cmd += ["-c", ch]
+            cmd += spec["dependencies"]
+            r = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=cfg.pip_env_install_timeout_s,
+            )
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"{exe} create failed for {spec['dependencies']}: "
+                    f"{r.stderr[-800:]}"
+                )
+            if not os.path.exists(python):
+                raise RuntimeError(
+                    f"conda env at {root} has no bin/python — add an "
+                    "explicit python dependency to the spec (e.g. "
+                    "'python=3.12')"
+                )
+            _inject_parent_site(root)
+
+        return await self._ensure_cached_env("conda_envs", key, build)
+
+    def _container_spawn_prefix(self, rtenv: dict) -> list:
+        """argv prefix that wraps the worker command in a container
+        (reference role: python/ray/_private/runtime_env/container.py).
+        The session dir, /tmp (spill + runtime-env extracts), and /dev/shm
+        (the object arena) are shared with the host, and the host network
+        is used so the worker's TCP endpoints are directly reachable."""
+        import shutil
+
+        runtime = cfg.container_runtime or next(
+            (r for r in ("podman", "docker") if shutil.which(r)), None
+        )
+        if runtime is None or not shutil.which(runtime):
+            raise rpc.RpcError(
+                "container runtime env requested but no container runtime "
+                "was found on this node (looked for RT_CONTAINER_RUNTIME, "
+                "podman, docker on PATH). Install one, or use pip/conda "
+                "runtime envs instead."
+            )
+        desc = rtenv["container"]
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )  # .../ray_tpu
+        repo_root = os.path.dirname(pkg_root)
+        prefix = [
+            # --init: an init shim as PID1 forwards SIGTERM/SIGKILL to
+            # the worker — without it the in-container python is PID1
+            # (default signal dispositions ignored), the raylet's kill
+            # paths only hit the `docker run` CLIENT, and the container
+            # (plus its leased chips) leaks forever
+            shutil.which(runtime), "run", "--rm", "--init",
+            "--network=host", "--ipc=host",
+            "-v", f"{self.session_dir}:{self.session_dir}",
+            "-v", "/tmp:/tmp",
+            "-v", f"{repo_root}:{repo_root}:ro",
+            "-e", f"PYTHONPATH={repo_root}",
+        ]
+        prefix += desc.get("run_options", [])
+        # image appended by _spawn_worker AFTER the worker's -e env flags
+        return prefix, desc["image"]
 
     async def rpc_worker_ready(self, conn: rpc.Connection, p):
         """A spawned worker reports in with its own server address."""
@@ -616,9 +725,16 @@ class Raylet:
         rtenv_key = rtenv_mod.descriptor_key(rtenv)
         venv_python: Optional[str] = None
         venv_key = ""
+        container: Optional[tuple] = None
         if rtenv and rtenv.get("pip"):
             venv_python = await self._ensure_pip_env(rtenv)
             venv_key = rtenv_key
+        elif rtenv and rtenv.get("conda"):
+            venv_python = await self._ensure_conda_env(rtenv)
+            venv_key = rtenv_key
+        elif rtenv and rtenv.get("container"):
+            container = self._container_spawn_prefix(rtenv)
+            venv_key = rtenv_key  # containerized workers never mix pools
         n_tpu = int(resources.get("TPU", 0))
         if n_tpu <= 0 and resources.get("TPU", 0) > 0:
             n_tpu = 1
@@ -670,7 +786,8 @@ class Raylet:
             pool.extend(mismatched)
         if w is None:
             w = self._spawn_worker(python_exe=venv_python,
-                                   venv_key=venv_key)
+                                   venv_key=venv_key,
+                                   container=container)
             await self._wait_for_worker(w)
             # worker_ready put the fresh worker in the idle pool; it is being
             # handed out right now, so pull it back out
@@ -995,6 +1112,30 @@ class Raylet:
             c = await rpc.connect(address, name=f"raylet->{address}")
             self._peer_conns[address] = c
         return c
+
+
+def _inject_parent_site(root: str) -> None:
+    """Make ray_tpu + the base image's packages importable inside an
+    isolated env at ``root`` (pip venv or conda env): a .pth in each of
+    the env's site-packages appends the ray_tpu package root and this
+    interpreter's site dirs AFTER the env's own site-packages — the
+    env's dependencies shadow ours where they overlap, but workers can
+    always import the runtime (reference: runtime_env/conda.py
+    _inject_ray_to_conda_site; shared here so pip and conda injection
+    semantics can never diverge)."""
+    import glob
+
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    parents = [pkg_parent] + [
+        p for p in sys.path if p.endswith("site-packages")
+    ]
+    for vs in glob.glob(
+        os.path.join(root, "lib", "python*", "site-packages")
+    ):
+        with open(os.path.join(vs, "_rt_parent_env.pth"), "w") as f:
+            f.write("\n".join(parents) + "\n")
 
 
 def _env_key(env: Optional[Dict[str, str]], rtenv_key: str = "") -> tuple:

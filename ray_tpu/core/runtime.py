@@ -848,6 +848,18 @@ class Runtime:
             await asyncio.sleep(0.5)
         return ""
 
+    async def await_ref_completion(self, ref: ObjectRef) -> None:
+        """Wait until the task producing ``ref`` has COMPLETED, without
+        fetching its value — bookkeeping callers (e.g. serve's chained
+        in-flight accounting) must not materialize a possibly-huge
+        result into this process just to observe that it finished."""
+        fut = self.result_futures.get(ref.object_id.binary())
+        if fut is not None:
+            try:
+                await asyncio.shield(fut)
+            except Exception:
+                pass  # errored completion still counts as completed
+
     async def _resolve_one(self, oid: bytes, deadline) -> Any:
         failed_pulls = 0
         while True:

@@ -10,8 +10,11 @@ runtime env) pairs, so reuse never leaks one env into another (the
 reference starts dedicated workers per runtime env for the same reason).
 
 Supported keys: ``env_vars`` (dict), ``working_dir`` (local dir),
-``py_modules`` (list of local dirs/files).  ``pip``/``conda`` isolation
-is rejected explicitly — the deployment image is hermetic by design.
+``py_modules`` (list of local dirs/files), ``pip`` (per-env virtualenv),
+``conda`` (per-env conda env, spec-hashed and cached node-side), and
+``container`` (worker spawned inside a docker/podman container with the
+session dir mounted).  pip/conda/container are mutually exclusive, like
+the reference (ray: _private/runtime_env/{pip,conda,container}.py).
 """
 
 from __future__ import annotations
@@ -70,16 +73,77 @@ def normalize(
     cached = _normalize_cache.get(cache_key)
     if cached is not None:
         return cached
-    unknown = set(env) - {"env_vars", "working_dir", "py_modules", "pip"}
-    if unknown & {"conda"}:
-        raise ValueError(
-            "conda runtime envs are not supported; use pip=[...] (a "
-            "per-env virtualenv over the base image) or ship pure-python "
-            "code via working_dir/py_modules"
-        )
+    unknown = set(env) - {
+        "env_vars", "working_dir", "py_modules", "pip", "conda", "container",
+    }
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
+    isolation = [k for k in ("pip", "conda", "container") if env.get(k)]
+    if len(isolation) > 1:
+        raise ValueError(
+            f"runtime_env keys {isolation} are mutually exclusive: pick "
+            "ONE of pip (virtualenv over the base image), conda (own "
+            "interpreter + native deps), or container (own image)"
+        )
     desc: Dict[str, Any] = {}
+    conda = env.get("conda")
+    if conda is not None:
+        # Canonical spec: {"dependencies": [...], "channels": [...]}.
+        # Accepts a bare list of package specs, a dict, or a path to an
+        # environment.yml (reference: runtime_env/conda.py accepts all
+        # three).  Canonicalized + sorted so the node-side cache key is
+        # stable across equivalent writings.
+        if isinstance(conda, str):
+            if not os.path.isfile(conda):
+                raise ValueError(
+                    f"conda: {conda!r} is not a file; pass a package list, "
+                    "a spec dict, or a path to an environment.yml"
+                )
+            try:
+                import yaml  # vendored with many bases; optional
+
+                with open(conda) as f:
+                    conda = yaml.safe_load(f)
+            except ImportError as e:
+                raise ValueError(
+                    "conda: reading environment.yml needs pyyaml, which "
+                    "this image lacks — pass the spec as a dict or "
+                    "package list instead"
+                ) from e
+        if isinstance(conda, (list, tuple)):
+            conda = {"dependencies": list(conda)}
+        if not isinstance(conda, dict) or not conda.get("dependencies"):
+            raise ValueError(
+                "conda must be a package list, a spec dict with "
+                "'dependencies', or an environment.yml path"
+            )
+        deps = conda["dependencies"]
+        if not all(isinstance(d, str) for d in deps):
+            raise ValueError(
+                "conda dependencies must be plain package specs "
+                "(nested pip: sections are not supported — use the pip "
+                "runtime env for pip packages)"
+            )
+        desc["conda"] = {
+            "dependencies": sorted(deps),
+            "channels": sorted(conda.get("channels", [])),
+        }
+    container = env.get("container")
+    if container is not None:
+        if isinstance(container, str):
+            container = {"image": container}
+        if not isinstance(container, dict) or not container.get("image"):
+            raise ValueError(
+                "container must be an image name or a dict with 'image' "
+                "(+ optional 'run_options': list of extra runtime flags)"
+            )
+        run_opts = container.get("run_options", [])
+        if not all(isinstance(o, str) for o in run_opts):
+            raise ValueError("container run_options must be strings")
+        desc["container"] = {
+            "image": container["image"],
+            "run_options": list(run_opts),
+        }
     pip = env.get("pip")
     if pip:
         # per-env virtualenv (reference: runtime_env/pip.py role): the

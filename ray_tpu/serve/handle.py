@@ -204,7 +204,10 @@ class DeploymentResponse:
 
             async def waiter():
                 try:
-                    await rt.await_ref(self._ref)
+                    # completion only — fetching the value would pull a
+                    # possibly-huge chained intermediate into THIS
+                    # process purely for load accounting
+                    await rt.await_ref_completion(self._ref)
                 except Exception:
                     pass
                 finally:

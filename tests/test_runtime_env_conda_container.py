@@ -1,0 +1,245 @@
+"""conda + container runtime environments.
+
+Mirrors ray: python/ray/_private/runtime_env/{conda,container}.py —
+workers for runtime_env={"conda": [...]} run in a spec-hashed cached
+conda env; runtime_env={"container": {...}} spawns the worker inside a
+container with the session dir mounted.  Neither a real conda nor a
+real container runtime exists in this image, so the happy paths run
+against FAKE executables that implement the exact CLI subset the raylet
+invokes (arg parsing + env materialization are the logic under test);
+rejection paths run against an empty PATH and must produce actionable
+errors.
+"""
+
+import os
+import stat
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime_env import normalize
+
+
+def _write_exe(path: str, body: str):
+    with open(path, "w") as f:
+        f.write(body)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+
+@pytest.fixture(scope="module")
+def fake_bin(tmp_path_factory):
+    """A bin dir holding fake `conda` and `docker` executables.
+
+    fake conda: `conda create --yes -p PREFIX [-c chan]... pkg...` →
+    builds a REAL virtualenv at PREFIX (--system-site-packages, like the
+    pip path) and drops a conda-meta marker naming the requested pkgs —
+    interpreter isolation semantics without the solver.
+
+    fake docker: `docker run [flags] IMAGE cmd...` → parses -e/-v flags,
+    applies the env, records the invocation, and execs cmd on the host —
+    the raylet's arg construction and the worker's in-container
+    bootstrap are what get exercised.
+    """
+    d = tmp_path_factory.mktemp("fakebin")
+    _write_exe(str(d / "conda"), textwrap.dedent(f"""\
+        #!/bin/sh
+        # args: create --yes -p PREFIX [-c CHANNEL]... PKG...
+        [ "$1" = "create" ] || {{ echo "unsupported verb $1" >&2; exit 2; }}
+        shift
+        prefix=""; pkgs=""
+        while [ $# -gt 0 ]; do
+          case "$1" in
+            --yes) ;;
+            -p) prefix="$2"; shift ;;
+            -c) shift ;;
+            *) pkgs="$pkgs $1" ;;
+          esac
+          shift
+        done
+        [ -n "$prefix" ] || {{ echo "no prefix" >&2; exit 2; }}
+        {sys.executable} -m venv --system-site-packages "$prefix" || exit 3
+        mkdir -p "$prefix/conda-meta"
+        echo "$pkgs" > "$prefix/conda-meta/fake_pkgs"
+        """))
+    _write_exe(str(d / "docker"), textwrap.dedent("""\
+        #!/bin/sh
+        # args: run [--rm|--network=..|--ipc=..] [-v SPEC]... [-e K=V]... IMAGE cmd...
+        [ "$1" = "run" ] || { echo "unsupported verb $1" >&2; exit 2; }
+        shift
+        image=""
+        while [ $# -gt 0 ]; do
+          case "$1" in
+            --rm|--init|--network=*|--ipc=*) shift ;;
+            -v) shift 2 ;;
+            -e) export "$2"; shift 2 ;;
+            *) image="$1"; shift; break ;;
+          esac
+        done
+        [ -n "$image" ] || { echo "no image" >&2; exit 2; }
+        echo "$image $*" >> "${FAKE_DOCKER_LOG:-/tmp/fake_docker.log}"
+        exec "$@"
+        """))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def cluster(fake_bin):
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = fake_bin + os.pathsep + old_path
+    os.environ["FAKE_DOCKER_LOG"] = os.path.join(fake_bin, "docker.log")
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+    # scope the fakes to this module: later test modules must not
+    # resolve conda/docker to them
+    os.environ["PATH"] = old_path
+    os.environ.pop("FAKE_DOCKER_LOG", None)
+
+
+class TestNormalize:
+    def test_conda_list_canonicalized(self):
+        d = normalize({"conda": ["numpy", "python=3.12"]}, kv_put=None)
+        assert d["conda"] == {
+            "dependencies": ["numpy", "python=3.12"], "channels": [],
+        }
+
+    def test_conda_dict_with_channels(self):
+        d = normalize(
+            {"conda": {"dependencies": ["b", "a"],
+                       "channels": ["conda-forge"]}},
+            kv_put=None,
+        )
+        assert d["conda"]["dependencies"] == ["a", "b"]
+        assert d["conda"]["channels"] == ["conda-forge"]
+
+    def test_container_str_shorthand(self):
+        d = normalize({"container": "myimg:1"}, kv_put=None)
+        assert d["container"] == {"image": "myimg:1", "run_options": []}
+
+    def test_isolation_keys_mutually_exclusive(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            normalize({"pip": ["x"], "conda": ["y"]}, kv_put=None)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            normalize(
+                {"conda": ["y"], "container": "img"}, kv_put=None
+            )
+
+    def test_bad_conda_spec_rejected(self):
+        with pytest.raises(ValueError, match="dependencies"):
+            normalize({"conda": {}}, kv_put=None)
+        with pytest.raises(ValueError, match="not a file"):
+            normalize({"conda": "/no/such/env.yml"}, kv_put=None)
+
+    def test_bad_container_rejected(self):
+        with pytest.raises(ValueError, match="image"):
+            normalize({"container": {}}, kv_put=None)
+
+
+class TestCondaRuntimeEnv:
+    def test_task_runs_in_conda_env(self, cluster):
+        @ray_tpu.remote
+        def probe():
+            import sys
+
+            # the fake conda built a venv: prefix differs from base, and
+            # the conda-meta marker proves the spec reached `conda create`
+            meta = os.path.join(
+                sys.prefix, "conda-meta", "fake_pkgs"
+            )
+            return (
+                sys.prefix != sys.base_prefix,
+                open(meta).read().strip() if os.path.exists(meta) else "",
+            )
+
+        isolated, pkgs = ray_tpu.get(
+            probe.options(
+                runtime_env={"conda": ["python=3.12", "numpy"]}
+            ).remote(),
+            timeout=600,
+        )
+        assert isolated, "worker did not run in the conda env interpreter"
+        assert "numpy" in pkgs and "python=3.12" in pkgs
+
+    def test_env_cached_across_leases(self, cluster):
+        @ray_tpu.remote
+        def prefix():
+            import sys
+
+            return sys.prefix
+
+        env = {"conda": ["python=3.12"]}
+        p1 = ray_tpu.get(
+            prefix.options(runtime_env=env).remote(), timeout=600
+        )
+        p2 = ray_tpu.get(
+            prefix.options(runtime_env=env).remote(), timeout=600
+        )
+        assert p1 == p2  # spec-hash cache: one env, reused
+        assert "conda_envs" in p1
+
+
+class TestContainerRuntimeEnv:
+    def test_task_runs_via_container_runtime(self, cluster):
+        @ray_tpu.remote
+        def probe():
+            return {
+                "pid": os.getpid(),
+                "saw_container_env": os.environ.get("RT_FAKE_IN_CONTAINER"),
+            }
+
+        out = ray_tpu.get(
+            probe.options(
+                runtime_env={
+                    "container": {
+                        "image": "rt-test-image:latest",
+                        "run_options": ["-e", "RT_FAKE_IN_CONTAINER=1"],
+                    }
+                }
+            ).remote(),
+            timeout=600,
+        )
+        assert out["saw_container_env"] == "1"
+        log = open(os.environ["FAKE_DOCKER_LOG"]).read()
+        assert "rt-test-image:latest" in log
+        assert "ray_tpu.core.worker_main" in log
+
+
+class TestRejectionPaths:
+    def test_conda_missing_executable_actionable(self, tmp_path):
+        # a cluster whose PATH has no conda must reject the lease with
+        # an error that says WHAT to install and the alternatives
+        import subprocess
+        import sys as _sys
+
+        code = textwrap.dedent("""\
+            import os, sys
+            os.environ["PATH"] = "/usr/bin:/bin"
+            os.environ.pop("RT_CONDA_EXE", None)
+            import ray_tpu
+            ray_tpu.init(num_cpus=2, num_tpus=0)
+
+            @ray_tpu.remote
+            def f():
+                return 1
+
+            try:
+                ray_tpu.get(
+                    f.options(runtime_env={"conda": ["numpy"]}).remote(),
+                    timeout=60,
+                )
+                print("NO_ERROR")
+            except Exception as e:
+                print("GOT:", str(e)[:400])
+            ray_tpu.shutdown()
+            """)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [_sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo},
+            cwd="/tmp",
+        )
+        assert "no conda executable" in r.stdout, r.stdout + r.stderr[-500:]
+        assert "miniconda" in r.stdout  # actionable: what to install
