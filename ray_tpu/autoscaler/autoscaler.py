@@ -93,7 +93,13 @@ class Autoscaler:
         launches = self._plan_launches(demands, state)
         for node_type in launches:
             tc = self._type(node_type)
-            self.provider.create_node(node_type, tc.resources, tc.labels)
+            # provider CRUD is blocking by contract (a real cloud API
+            # polls a queued resource to READY for minutes) — it must
+            # never run on the monitor's event loop
+            await asyncio.to_thread(
+                self.provider.create_node, node_type, tc.resources,
+                tc.labels,
+            )
         await self._drain_idle(state)
 
     def _type(self, name: str) -> NodeTypeConfig:
@@ -230,7 +236,7 @@ class Autoscaler:
                     "terminating broken slice %s: host(s) dead",
                     pn.provider_id,
                 )
-                self.provider.terminate_node(pn)
+                await asyncio.to_thread(self.provider.terminate_node, pn)
                 counts[pn.node_type] = counts.get(pn.node_type, 1) - 1
                 for nid in nids:
                     self._idle_since.pop(nid, None)
@@ -255,7 +261,7 @@ class Autoscaler:
                     await self.gcs.call("drain_node", {"node_id": nid})
                 except Exception:
                     logger.exception("drain_node rpc failed")
-            self.provider.terminate_node(pn)
+            await asyncio.to_thread(self.provider.terminate_node, pn)
             counts[pn.node_type] -= 1
             for nid in nids:
                 self._idle_since.pop(nid, None)

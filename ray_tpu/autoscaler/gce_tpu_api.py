@@ -1,0 +1,227 @@
+"""REST client for the GCE TPU VM control plane (tpu.googleapis.com v2).
+
+Role-equivalent of ray: python/ray/autoscaler/_private/gcp/node.py
+(GCPTPUNode) + node_provider.py's resource CRUD, reshaped for the
+slice-granular TpuPodProvider: a slice is provisioned as a QUEUED
+RESOURCE (the capacity-friendly path GCP recommends for pods), becomes
+READY when its underlying node is ACTIVE, and exposes one network
+endpoint per host.
+
+Transport is plain urllib against ``base_url`` (default the public API;
+tests point it at a local fixture server — no egress, byte-for-byte
+request assertions).  Auth is a bearer token from, in order: an
+injected ``token_fn``, the ``RT_GCP_TOKEN`` env var, or the GCE
+metadata server (the on-VM deployment path).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional
+
+from ray_tpu.autoscaler.tpu_provider import GceTpuApi, TpuSlice, slice_shape
+
+_METADATA_TOKEN_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/"
+    "instance/service-accounts/default/token"
+)
+
+
+class GceApiError(RuntimeError):
+    def __init__(self, status: int, method: str, path: str, body: str):
+        self.status = status
+        super().__init__(f"{method} {path} -> HTTP {status}: {body[:500]}")
+
+
+def _metadata_token() -> str:
+    req = urllib.request.Request(
+        _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())["access_token"]
+
+
+class RestGceTpuApi(GceTpuApi):
+    """Thin, deterministic REST client.  Every call is one request; all
+    polling/waiting lives in the provider, so fixtures can assert the
+    exact wire traffic."""
+
+    def __init__(
+        self,
+        project: str,
+        zone: str,
+        runtime_version: str = "tpu-ubuntu2204-base",
+        base_url: str = "https://tpu.googleapis.com",
+        token_fn: Optional[Callable[[], str]] = None,
+        network: str = "default",
+    ):
+        self.project = project
+        self.zone = zone
+        self.runtime_version = runtime_version
+        self.base_url = base_url.rstrip("/")
+        self.network = network
+        self._token_fn = token_fn
+
+    # -- transport -------------------------------------------------------
+    def _token(self) -> str:
+        if self._token_fn is not None:
+            return self._token_fn()
+        env = os.environ.get("RT_GCP_TOKEN")
+        if env:
+            return env
+        return _metadata_token()
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        url = self.base_url + path
+        data = None
+        headers = {"Authorization": f"Bearer {self._token()}"}
+        if body is not None:
+            data = json.dumps(body, sort_keys=True).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            url, data=data, headers=headers, method=method
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            raise GceApiError(
+                e.code, method, path, e.read().decode(errors="replace")
+            ) from None
+        return json.loads(payload) if payload else {}
+
+    # -- paths -----------------------------------------------------------
+    @property
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _qr_path(self, name: str = "") -> str:
+        base = f"/v2/{self._parent}/queuedResources"
+        return f"{base}/{name}" if name else base
+
+    def _node_path(self, name: str = "") -> str:
+        base = f"/v2/{self._parent}/nodes"
+        return f"{base}/{name}" if name else base
+
+    # -- GceTpuApi surface ------------------------------------------------
+    def create_slice(self, name: str, accelerator_type: str) -> TpuSlice:
+        slice_shape(accelerator_type)  # validate before any wire traffic
+        body = {
+            "tpu": {
+                "node_spec": [
+                    {
+                        "parent": self._parent,
+                        "node_id": name,
+                        "node": {
+                            "accelerator_type": accelerator_type,
+                            "runtime_version": self.runtime_version,
+                            "network_config": {
+                                "network": self.network,
+                                "enable_external_ips": False,
+                            },
+                        },
+                    }
+                ]
+            },
+        }
+        self._request(
+            "POST", self._qr_path() + f"?queued_resource_id={name}", body
+        )
+        return TpuSlice(
+            name=name, accelerator_type=accelerator_type, state="CREATING"
+        )
+
+    def get_slice(self, name: str) -> Optional[TpuSlice]:
+        try:
+            qr = self._request("GET", self._qr_path(name))
+        except GceApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        qr_state = (qr.get("state") or {}).get("state", "")
+        if qr_state in ("FAILED", "SUSPENDED"):
+            return TpuSlice(
+                name=name,
+                accelerator_type=self._qr_accel(qr),
+                state="FAILED",
+                meta={"queued_resource_state": qr_state},
+            )
+        if qr_state != "ACTIVE":
+            # WAITING_FOR_RESOURCES / PROVISIONING / ACCEPTED / CREATING
+            return TpuSlice(
+                name=name,
+                accelerator_type=self._qr_accel(qr),
+                state="CREATING",
+                meta={"queued_resource_state": qr_state},
+            )
+        node = self._request("GET", self._node_path(name))
+        endpoints = [
+            f"{ep.get('ipAddress', '')}:{ep.get('port', 8470)}"
+            for ep in node.get("networkEndpoints", [])
+        ]
+        state = "READY" if node.get("state") == "READY" else "CREATING"
+        return TpuSlice(
+            name=name,
+            accelerator_type=node.get(
+                "acceleratorType", self._qr_accel(qr)
+            ),
+            state=state,
+            endpoints=endpoints,
+            meta={"node_state": node.get("state", "")},
+        )
+
+    @staticmethod
+    def _qr_accel(qr: dict) -> str:
+        specs = ((qr.get("tpu") or {}).get("nodeSpec")
+                 or (qr.get("tpu") or {}).get("node_spec") or [])
+        if specs:
+            node = specs[0].get("node", {})
+            return node.get("acceleratorType") or node.get(
+                "accelerator_type", ""
+            )
+        return ""
+
+    def delete_slice(self, name: str) -> None:
+        # node first (ACTIVE queued resources refuse deletion while their
+        # node lives), then the queued resource; 404s are success — the
+        # caller wants it GONE, and retries must be idempotent
+        for method, path in (
+            ("DELETE", self._node_path(name)),
+            ("DELETE", self._qr_path(name)),
+        ):
+            try:
+                self._request(method, path)
+            except GceApiError as e:
+                if e.status != 404:
+                    raise
+
+    def list_slices(self) -> List[TpuSlice]:
+        out: List[TpuSlice] = []
+        page_token = ""
+        while True:
+            path = self._qr_path()
+            if page_token:
+                path += f"?page_token={page_token}"
+            resp = self._request("GET", path)
+            for qr in resp.get("queuedResources", []):
+                name = qr.get("name", "").rsplit("/", 1)[-1]
+                qr_state = (qr.get("state") or {}).get("state", "")
+                state = {
+                    "ACTIVE": "READY",
+                    "FAILED": "FAILED",
+                    "SUSPENDED": "FAILED",
+                }.get(qr_state, "CREATING")
+                out.append(
+                    TpuSlice(
+                        name=name,
+                        accelerator_type=self._qr_accel(qr),
+                        state=state,
+                        meta={"queued_resource_state": qr_state},
+                    )
+                )
+            page_token = resp.get("nextPageToken", "")
+            if not page_token:
+                return out

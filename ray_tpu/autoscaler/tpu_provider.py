@@ -128,14 +128,50 @@ class TpuPodProvider(NodeProvider):
         session_dir: str,
         api: Optional[GceTpuApi] = None,
         cpus_per_host: float = 4.0,
+        slice_ready_timeout_s: float = 1800.0,
+        poll_interval_s: float = 5.0,
     ):
         self.gcs_address = gcs_address
         self.session_dir = session_dir
         self.api = api or FakeGceTpuApi()
         self.cpus_per_host = cpus_per_host
+        self.slice_ready_timeout_s = slice_ready_timeout_s
+        self.poll_interval_s = poll_interval_s
         self._nodes: Dict[str, ProviderNode] = {}
         self._counter = 0
         self._lock = threading.Lock()
+
+    def _wait_ready(self, tpu: TpuSlice) -> TpuSlice:
+        """Poll until the slice is READY (queued resources sit in
+        WAITING_FOR_RESOURCES/PROVISIONING for minutes on the real API;
+        the fake answers READY immediately).  FAILED or timeout tears
+        the queued resource down — a half-born slice must not leak."""
+        import time
+
+        deadline = time.monotonic() + self.slice_ready_timeout_s
+        cur = tpu
+        while cur.state != "READY":
+            if cur.state == "FAILED":
+                self.api.delete_slice(tpu.name)
+                raise RuntimeError(
+                    f"TPU slice {tpu.name} failed to provision: "
+                    f"{cur.meta}"
+                )
+            if time.monotonic() > deadline:
+                self.api.delete_slice(tpu.name)
+                raise TimeoutError(
+                    f"TPU slice {tpu.name} not READY within "
+                    f"{self.slice_ready_timeout_s:.0f}s (last state "
+                    f"{cur.state}, {cur.meta})"
+                )
+            time.sleep(self.poll_interval_s)
+            nxt = self.api.get_slice(tpu.name)
+            if nxt is None:
+                raise RuntimeError(
+                    f"TPU slice {tpu.name} vanished while provisioning"
+                )
+            cur = nxt
+        return cur
 
     def _host_resources(
         self, slice_name: str, worker_id: int, accelerator_type: str
@@ -162,7 +198,7 @@ class TpuPodProvider(NodeProvider):
         with self._lock:
             self._counter += 1
             slice_name = f"rt-{node_type}-{self._counter}"
-        tpu = self.api.create_slice(slice_name, node_type)
+        tpu = self._wait_ready(self.api.create_slice(slice_name, node_type))
         n_hosts, chips, _gen = slice_shape(node_type)
         procs: List[subprocess.Popen] = []
         node_ids: List[str] = []
