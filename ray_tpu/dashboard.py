@@ -37,6 +37,113 @@ logger = logging.getLogger(__name__)
 
 DASHBOARD_NAME = "_rt_dashboard"
 
+#: (route-kind, nav label) for the per-subsystem HTML pages
+_PAGE_KINDS = [
+    ("nodes", "Nodes"),
+    ("actors", "Actors"),
+    ("tasks", "Tasks"),
+    ("workers", "Workers"),
+    ("objects", "Objects"),
+    ("placement_groups", "Placement groups"),
+    ("jobs", "Jobs"),
+    ("metrics", "Metrics"),
+    ("events", "Events"),
+    ("logs", "Logs"),
+]
+
+_PAGE_CSS = """
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:4px 8px;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} pre{background:#fff;border:1px solid #ddd;padding:8px}
+ nav a{margin-right:.8rem} nav a.active{font-weight:bold}
+"""
+
+
+def _render_table(rows, raw: bool = False) -> str:
+    """Server-side twin of the index page's JS table(): union of keys as
+    columns, values escaped (``raw=True`` only for server-built trusted
+    cells like log links)."""
+    import html as _html
+
+    if isinstance(rows, dict):
+        rows = [
+            {"key": k, "value": v} for k, v in rows.items()
+        ]
+    if not rows:
+        return "<i>none</i>"
+    if not isinstance(rows, list) or not isinstance(rows[0], dict):
+        return f"<pre>{_html.escape(json.dumps(rows, default=str, indent=1))}</pre>"
+    cols = []
+    for r in rows:
+        for k in r:
+            if k not in cols:
+                cols.append(k)
+
+    def cell(v):
+        s = json.dumps(v, default=str) if isinstance(
+            v, (dict, list)
+        ) else ("" if v is None else str(v))
+        return s if raw else _html.escape(s)
+
+    out = ["<table><tr>"]
+    out += [f"<th>{_html.escape(str(c))}</th>" for c in cols]
+    out.append("</tr>")
+    for r in rows:
+        out.append("<tr>")
+        out += [f"<td>{cell(r.get(c))}</td>" for c in cols]
+        out.append("</tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
+def _render_page(title: str, active: str, content: str,
+                 api: str = "", client_refresh: bool = False) -> str:
+    """Page skeleton: shared nav + server-rendered content; when ``api``
+    is set and refresh requested, the content re-renders client-side
+    from the same JSON endpoint every 5 s."""
+    import html as _html
+
+    nav = "".join(
+        f'<a href="/{k}"{" class=\"active\"" if k == active else ""}>'
+        f"{label}</a>"
+        for k, label in _PAGE_KINDS
+    )
+    refresh = ""
+    if api and client_refresh:
+        refresh = f"""<script>
+{_TABLE_JS}
+setInterval(async()=>{{
+  try{{const r=await fetch('{api}');
+      document.getElementById('content').innerHTML=table(await r.json());
+  }}catch(e){{}}
+}},5000);
+</script>"""
+    return f"""<!doctype html>
+<html><head><meta charset="utf-8">
+<title>ray_tpu — {_html.escape(title)}</title>
+<style>{_PAGE_CSS}</style></head><body>
+<h1>ray_tpu — {_html.escape(title)}</h1>
+<nav><a href="/">Overview</a>{nav}</nav>
+<div id="content">{content}</div>
+{refresh}</body></html>"""
+
+
+_TABLE_JS = """
+function esc(s){const d=document.createElement('div');d.textContent=s;return d.innerHTML}
+function table(rows){
+  if(rows && !Array.isArray(rows)) rows=Object.entries(rows).map(([key,value])=>({key,value}));
+  if(!rows || !rows.length) return '<i>none</i>';
+  const cols=[...new Set(rows.flatMap(r=>Object.keys(r)))];
+  let h='<table><tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>';
+  for(const r of rows) h+='<tr>'+cols.map(c=>'<td>'+
+    esc(typeof r[c]==='object'&&r[c]!==null?JSON.stringify(r[c]):String(r[c]??''))+'</td>').join('')+'</tr>';
+  return h+'</table>';
+}
+"""
+
+
 _HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
 <style>
@@ -48,6 +155,7 @@ _HTML = """<!doctype html>
  .pill{display:inline-block;padding:0 6px;border-radius:8px;background:#e8f0fe}
 </style></head><body>
 <h1>ray_tpu dashboard</h1>
+<nav>{NAV}</nav>
 <div id="summary"></div>
 <h2>Nodes</h2><div id="nodes"></div>
 <h2>Actors</h2><div id="actors"></div>
@@ -73,6 +181,15 @@ async function refresh(){
 }
 refresh(); setInterval(refresh, 5000);
 </script></body></html>"""
+
+# one source of truth for the page list: the index nav is generated from
+# _PAGE_KINDS exactly like every subsystem page's nav
+_HTML = _HTML.replace(
+    "{NAV}",
+    "".join(
+        f'<a href="/{k}">{label}</a>' for k, label in _PAGE_KINDS
+    ),
+)
 
 
 def render_prometheus(metrics: list) -> str:
@@ -187,6 +304,17 @@ class DashboardActor:
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/logs", self._logs_index)
         app.router.add_get("/api/logs/{name}", self._logs_tail)
+        # per-subsystem HTML pages (reference role: the dashboard UI's
+        # pages — cluster/actors/jobs/..., here server-rendered tables).
+        # /metrics stays the Prometheus endpoint for scrapers; browsers
+        # get the HTML page via Accept-header negotiation there.
+        for kind, label in _PAGE_KINDS:
+            if kind == "metrics":
+                continue
+            app.router.add_get(
+                f"/{kind}", self._make_html_page(kind, label)
+            )
+        app.router.add_get("/logs/{name}", self._log_page)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)
@@ -243,24 +371,20 @@ class DashboardActor:
         return handler
 
     async def _jobs(self, req):
-        from ray_tpu.core.runtime import get_runtime
-
-        def call():
-            rt = get_runtime()
-            return rt._run(rt.gcs.call("list_jobs", {}))
-
-        return self._json(await self._offload(call))
+        return self._json(await self._page_rows("jobs"))
 
     async def _metrics(self, req):
-        from ray_tpu.util import state
-
-        return self._json(await self._offload(state.get_metrics))
+        return self._json(await self._page_rows("metrics"))
 
     async def _metrics_prometheus(self, req):
         """Prometheus text exposition of the GCS metric aggregate
         (reference role: the per-node metrics agent's /metrics endpoint,
         ray: dashboard/modules/reporter — here one scrape target for the
-        cluster, point `prometheus.yml` at /metrics)."""
+        cluster, point `prometheus.yml` at /metrics).  Browsers (Accept:
+        text/html) get the HTML metrics page at the same path; scrapers
+        negotiate text/plain."""
+        if "text/html" in req.headers.get("Accept", ""):
+            return await self._make_html_page("metrics", "Metrics")(req)
         from aiohttp import web
 
         from ray_tpu.util import state
@@ -304,15 +428,7 @@ class DashboardActor:
         return os.environ.get("RT_SESSION_DIR", "/tmp/ray_tpu")
 
     async def _logs_index(self, req):
-        d = self._session_dir()
-        try:
-            files = sorted(
-                f for f in os.listdir(d) if f.endswith(".log")
-            )
-        except FileNotFoundError:
-            files = []
-        return self._json([{"name": f, "size": os.path.getsize(
-            os.path.join(d, f))} for f in files])
+        return self._json(await self._page_rows("logs"))
 
     async def _logs_tail(self, req):
         from aiohttp import web
@@ -330,6 +446,104 @@ class DashboardActor:
             f.seek(max(0, size - 256 * 1024))
             tail = f.read().decode("utf-8", "replace").splitlines()[-lines:]
         return web.Response(text="\n".join(tail), content_type="text/plain")
+
+    # -- HTML pages ------------------------------------------------------
+    # Server-rendered first paint (the data is IN the HTML — no JS
+    # needed to see live state), then a fetch-refresh keeps it current.
+    # Function parity with the reference dashboard's pages
+    # (ray: dashboard/client/src/pages/ — cluster/actors/jobs/...), not
+    # framework parity: tables over the same JSON the API serves.
+
+    async def _page_rows(self, kind: str):
+        """ONE rows provider per subsystem, consumed by both the JSON
+        API handlers and the HTML pages — the two surfaces must never
+        diverge on what the data is."""
+        from ray_tpu.core.runtime import get_runtime
+        from ray_tpu.util import events as events_mod
+        from ray_tpu.util import state
+
+        if kind == "jobs":
+            def call():
+                rt = get_runtime()
+                return rt._run(rt.gcs.call("list_jobs", {}))
+        elif kind == "events":
+            def call():
+                return events_mod.list_events()
+        elif kind == "metrics":
+            def call():
+                return state.get_metrics()
+        elif kind == "logs":
+            d = self._session_dir()
+
+            def call():
+                try:
+                    return [
+                        {
+                            "name": f,
+                            "size": os.path.getsize(os.path.join(d, f)),
+                        }
+                        for f in sorted(os.listdir(d))
+                        if f.endswith(".log")
+                    ]
+                except FileNotFoundError:
+                    return []
+        else:
+            fn = getattr(state, f"list_{kind}")
+
+            def call():
+                return fn()
+        return await self._offload(call)
+
+    def _make_html_page(self, kind: str, title: str):
+        async def handler(req):
+            from aiohttp import web
+
+            try:
+                rows = await self._page_rows(kind)
+            except Exception as e:  # noqa: BLE001 — page must render
+                rows = [{"error": repr(e)}]
+            raw_html = kind == "logs"
+            if raw_html:
+                rows = [
+                    {**r, "name": f'<a href="/logs/{r["name"]}">'
+                                  f'{r["name"]}</a>'}
+                    for r in rows
+                ]
+            page = _render_page(
+                title, kind, _render_table(rows, raw=raw_html),
+                api=f"/api/{kind}",
+                client_refresh=not raw_html,
+            )
+            return web.Response(text=page, content_type="text/html")
+
+        return handler
+
+    async def _log_page(self, req):
+        from aiohttp import web
+
+        import html as _html
+
+        name = req.match_info["name"]
+        if "/" in name or ".." in name or not name.endswith(".log"):
+            return web.Response(status=400, text="bad log name")
+        path = os.path.join(self._session_dir(), name)
+        if not os.path.exists(path):
+            return web.Response(status=404, text="no such log")
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - 256 * 1024))
+            tail = f.read().decode("utf-8", "replace").splitlines()[-500:]
+        body = (
+            f"<pre id=\"log\">{_html.escape(chr(10).join(tail))}</pre>"
+            f"<script>setInterval(async()=>{{"
+            f"const r=await fetch('/api/logs/{name}?lines=500');"
+            f"document.getElementById('log').textContent=await r.text();"
+            f"}},3000)</script>"
+        )
+        return web.Response(
+            text=_render_page(f"log: {name}", "logs", body),
+            content_type="text/html",
+        )
 
 
 def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> str:
