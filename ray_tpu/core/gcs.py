@@ -884,6 +884,21 @@ class GcsServer:
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
                     await self._on_node_death(node.node_id, "heartbeat timeout")
+            # Compact cancelled/abandoned pending-lease entries: kicks
+            # drop them lazily, but kicks are event-driven — a saturated
+            # cluster with clients re-requesting on LEASE_PENDING every
+            # 60 s would otherwise accumulate dead entries without bound.
+            pending = self.scheduler.pending
+            if any(e.fut.done() or e.client_conn.closed for e in pending):
+                keep: deque = deque()
+                for e in pending:
+                    if e.fut.done():
+                        continue
+                    if e.client_conn.closed:
+                        e.fut.cancel()
+                        continue
+                    keep.append(e)
+                self.scheduler.pending = keep
 
     async def _on_node_death(self, node_id: NodeID, reason: str):
         self._mark_dirty()

@@ -49,6 +49,9 @@ class WorkerEntry:
     tpu_chips: tuple = ()
     started_at: float = field(default_factory=time.monotonic)
     leased_at: float = 0.0  # monotonic time of the CURRENT lease grant
+    # containerized workers: `docker/podman kill <name>` argv — SIGKILL
+    # on `proc` (the run CLIENT) never reaches the container
+    container_kill_argv: Optional[list] = None
 
     @property
     def idle(self) -> bool:
@@ -168,6 +171,17 @@ class Raylet:
                 "shutting down", self.node_id,
             )
             for w in self.workers.values():
+                if w.container_kill_argv:
+                    # fire-and-forget: this process is about to _exit and
+                    # a terminated run client strands its container
+                    try:
+                        subprocess.Popen(
+                            w.container_kill_argv,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL,
+                        )
+                    except Exception:
+                        pass
                 w.proc.terminate()
             os._exit(1)
 
@@ -184,10 +198,7 @@ class Raylet:
             try:
                 w.proc.wait(timeout=2)
             except Exception:
-                try:
-                    w.proc.kill()
-                except Exception:
-                    pass
+                self._hard_kill_worker(w)
         if self.gcs:
             await self.gcs.close()
         await self.server.close()
@@ -435,16 +446,21 @@ class Raylet:
         env["RT_NODE_ID"] = self.node_id.hex()
         env["RT_STORE_PATH"] = self.store_path
         env["RT_SESSION_DIR"] = self.session_dir
+        container_kill_argv = None
         if container is not None:
             # (prefix, image) from _container_spawn_prefix: the worker
             # runs inside the container; its env arrives via -e flags
-            # (a container does not inherit the raylet's environ)
+            # (a container does not inherit the raylet's environ).  The
+            # container is NAMED so hard kills can target it — SIGKILL
+            # on the run client detaches without stopping the container.
             prefix, image = container
-            argv = list(prefix)
+            cname = f"rt-worker-{worker_id.hex()[:12]}"
+            argv = list(prefix) + ["--name", cname]
             for k, v in env.items():
                 if k.startswith(("RT_", "JAX_", "XLA_")):
                     argv += ["-e", f"{k}={v}"]
             argv += [image, "python", "-m", "ray_tpu.core.worker_main"]
+            container_kill_argv = [prefix[0], "kill", cname]
         else:
             argv = [
                 python_exe or sys.executable, "-m",
@@ -459,9 +475,29 @@ class Raylet:
             stderr=subprocess.STDOUT,
         )
         logf.close()
-        entry = WorkerEntry(worker_id=worker_id, proc=proc, venv_key=venv_key)
+        entry = WorkerEntry(
+            worker_id=worker_id, proc=proc, venv_key=venv_key,
+            container_kill_argv=container_kill_argv,
+        )
         self.workers[worker_id] = entry
         return entry
+
+    @staticmethod
+    def _hard_kill_worker(w: "WorkerEntry"):
+        """SIGKILL that actually reaches containerized workers: the run
+        client detaches on SIGKILL without stopping the container, so
+        the container is killed by name first."""
+        if w.container_kill_argv:
+            try:
+                subprocess.run(
+                    w.container_kill_argv, capture_output=True, timeout=20
+                )
+            except Exception:
+                pass
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
 
     async def _ensure_cached_env(self, kind: str, key: str, build) -> str:
         """Shared scaffolding for isolated-interpreter runtime envs (pip

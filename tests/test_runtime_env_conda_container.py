@@ -72,7 +72,7 @@ def fake_bin(tmp_path_factory):
         while [ $# -gt 0 ]; do
           case "$1" in
             --rm|--init|--network=*|--ipc=*) shift ;;
-            -v) shift 2 ;;
+            --name|-v) shift 2 ;;
             -e) export "$2"; shift 2 ;;
             *) image="$1"; shift; break ;;
           esac
