@@ -108,6 +108,10 @@ D("sched_spread_threshold", float, 0.5)
 # O(window) instead of O(backlog); grant-chaining re-kicks keep large
 # capacity releases draining
 D("sched_kick_scan_window", int, 64)
+# actor-push flow control: once a connection's unsent transport buffer
+# exceeds this, submissions queue behind the pump's drain() await
+# instead of buffering unboundedly via call_soon
+D("rpc_send_backlog_limit_bytes", int, 1 << 20)
 D("sched_max_pending_lease_s", float, 60.0)
 D("worker_pool_prestart", int, 0)
 D("worker_idle_timeout_s", float, 300.0)

@@ -72,22 +72,27 @@ class BaseID:
 
 
 class JobID(BaseID):
+    __slots__ = ()  # no per-instance dict (ids are hot-path objects)
     KIND = 0x01
 
 
 class NodeID(BaseID):
+    __slots__ = ()  # no per-instance dict (ids are hot-path objects)
     KIND = 0x02
 
 
 class WorkerID(BaseID):
+    __slots__ = ()  # no per-instance dict (ids are hot-path objects)
     KIND = 0x03
 
 
 class ActorID(BaseID):
+    __slots__ = ()  # no per-instance dict (ids are hot-path objects)
     KIND = 0x04
 
 
 class TaskID(BaseID):
+    __slots__ = ()  # no per-instance dict (ids are hot-path objects)
     KIND = 0x05
 
     @classmethod
@@ -97,6 +102,7 @@ class TaskID(BaseID):
 
 
 class ObjectID(BaseID):
+    __slots__ = ()  # no per-instance dict (ids are hot-path objects)
     KIND = 0x06
 
     @classmethod
@@ -116,4 +122,5 @@ class ObjectID(BaseID):
 
 
 class PlacementGroupID(BaseID):
+    __slots__ = ()  # no per-instance dict (ids are hot-path objects)
     KIND = 0x07

@@ -486,11 +486,15 @@ class Raylet:
     def _hard_kill_worker(w: "WorkerEntry"):
         """SIGKILL that actually reaches containerized workers: the run
         client detaches on SIGKILL without stopping the container, so
-        the container is killed by name first."""
+        the container is killed by name first.  Fire-and-forget — this
+        runs inside async close(); blocking on a wedged container
+        runtime daemon would stall the event loop per worker."""
         if w.container_kill_argv:
             try:
-                subprocess.run(
-                    w.container_kill_argv, capture_output=True, timeout=20
+                subprocess.Popen(
+                    w.container_kill_argv,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
                 )
             except Exception:
                 pass

@@ -93,16 +93,22 @@ class Connection:
     # -- sending ---------------------------------------------------------
     async def _send(self, msg) -> None:
         bufs = _dump(msg)
-        header = bytearray(_U32.pack(len(bufs)))
-        for b in bufs:
-            header += _U32.pack(len(b) if isinstance(b, bytes) else b.nbytes)
         async with self._send_lock:
             if self._closed:
                 raise ConnectionLost(f"connection {self.name} is closed")
-            self.writer.write(bytes(header))
-            for b in bufs:
-                self.writer.write(b)
+            self._write_frames(bufs)
             await self.writer.drain()
+
+    def _write_frames(self, bufs):
+        """Synchronous frame write (header + buffers, no await between
+        writes — frames never interleave).  ONE encoder for _send and
+        call_soon; wire-format changes live here only."""
+        header = bytearray(_U32.pack(len(bufs)))
+        for b in bufs:
+            header += _U32.pack(len(b) if isinstance(b, bytes) else b.nbytes)
+        self.writer.write(bytes(header))
+        for b in bufs:
+            self.writer.write(b)
 
     async def call(self, method: str, payload: Any = None, timeout: float = None):
         """timeout=None → config default; timeout<0 → wait forever."""
@@ -118,6 +124,35 @@ class Connection:
             return await asyncio.wait_for(fut, timeout=timeout)
         finally:
             self._pending.pop(msg_id, None)
+
+    def call_soon(self, method: str, payload: Any = None) -> "asyncio.Future":
+        """Fire a request WITHOUT awaiting transport drain or the reply;
+        returns the reply future (completed by the recv loop, failed with
+        ConnectionLost on shutdown).  The hot-path primitive for high-rate
+        callers (actor pushes): no per-call coroutine/Task, no wait_for
+        timer — attach a done-callback instead.  Loop-only.  NB: skipping
+        drain() skips asyncio's write flow control — transport.write
+        buffers unboundedly — so callers MUST police `send_backlog` and
+        fall back to an awaiting path (conn.drain) past their budget."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        msg_id = next(self._msg_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        self._write_frames(_dump((REQUEST, msg_id, method, payload)))
+        return fut
+
+    @property
+    def send_backlog(self) -> int:
+        """Bytes sitting unsent in the transport's write buffer."""
+        try:
+            return self.writer.transport.get_write_buffer_size()
+        except Exception:
+            return 0
+
+    async def drain(self):
+        """Await transport flow control (pauses while the peer is slow)."""
+        await self.writer.drain()
 
     async def notify(self, method: str, payload: Any = None) -> None:
         await self._send((NOTIFY, 0, method, payload))
@@ -152,7 +187,10 @@ class Connection:
                         self._handle_notify(method, payload)
                     )
                 else:
-                    fut = self._pending.get(msg_id)
+                    # pop: call() also pops in its finally (harmless
+                    # no-op then); call_soon() futures are only removed
+                    # here or at shutdown
+                    fut = self._pending.pop(msg_id, None)
                     if fut is not None and not fut.done():
                         if kind == RESPONSE_OK:
                             fut.set_result(payload)

@@ -80,7 +80,7 @@ class Lease:
     broken: bool = False
 
 
-@dataclass
+@dataclass(slots=True)
 class PendingTask:
     spec: dict
     return_ids: List[bytes]
@@ -121,6 +121,9 @@ class SchedClassState:
 # --------------------------------------------------------------------------
 # Runtime
 # --------------------------------------------------------------------------
+
+
+_PENDING_RESULT = object()  # lazy marker: locally-pending result, no async waiter yet
 
 
 class Runtime:
@@ -168,8 +171,10 @@ class Runtime:
         # run_coroutine_threadsafe round trip (see _try_sync_get).  The
         # lock serializes caller-thread register/drop (the io-loop signal
         # path pops atomically and never takes it).
-        self._sync_waiters: Dict[bytes, list] = {}
+        # oid -> Event (single waiter) or list of Events (contended)
+        self._sync_waiters: Dict[bytes, Any] = {}
         self._sync_reg_lock = threading.Lock()
+        self._sync_get_tls = threading.local()  # reusable wait Event
         self._shared: set = set()  # oids known to be in shm + registered
         self._escaped: set = set()  # refs passed on before their task finished
 
@@ -574,8 +579,14 @@ class Runtime:
         # fast path can't serve (shm-stored, remote, reconstruction) drops
         # the remainder onto the full async path.
         out = []
+        # reusable per-thread wait Event: a thread waits on one oid at a
+        # time and always deregisters before moving on, so pure
+        # memory-store hits never allocate and windowed gets share one
+        ev = getattr(self._sync_get_tls, "ev", None)
+        if ev is None:
+            ev = self._sync_get_tls.ev = threading.Event()
         for r in refs:
-            v = self._try_sync_get(r.object_id.binary(), deadline)
+            v = self._try_sync_get(r.object_id.binary(), deadline, ev)
             if v is _SYNC_MISS:
                 break
             out.append(v)
@@ -588,14 +599,15 @@ class Runtime:
             ))
         return out[0] if single else out
 
-    def _try_sync_get(self, oid: bytes, deadline):
+    def _try_sync_get(self, oid: bytes, deadline, ev=None):
         """Resolve a locally-produced inline task result without touching
         the io loop.  Lock-free: correctness rides on the reply applier's
         write order (value into memory_store BEFORE the result future is
         popped and waiters are signalled) plus a re-check after waiter
         registration, so a completion racing the registration can never
         strand the caller.  Returns _SYNC_MISS for anything that needs the
-        shm store or a remote pull."""
+        shm store or a remote pull.  ``ev`` is an optional reusable wait
+        Event (a windowed get would otherwise allocate one per ref)."""
         while True:
             if oid in self.memory_store:
                 value = self.memory_store[oid]
@@ -604,9 +616,20 @@ class Runtime:
                 return value
             if oid not in self.result_futures:
                 return _SYNC_MISS
-            ev = threading.Event()
+            if ev is None:
+                ev = threading.Event()
+            else:
+                ev.clear()
             with self._sync_reg_lock:
-                self._sync_waiters.setdefault(oid, []).append(ev)
+                # single-waiter fast shape: the Event itself; upgraded
+                # to a list only under contention on one oid
+                cur = self._sync_waiters.get(oid)
+                if cur is None:
+                    self._sync_waiters[oid] = ev
+                elif isinstance(cur, list):
+                    cur.append(ev)
+                else:
+                    self._sync_waiters[oid] = [cur, ev]
             # re-check: the reply may have been applied between the checks
             # above and the registration, in which case its signal pass
             # could have missed our event
@@ -627,23 +650,29 @@ class Runtime:
     def _drop_sync_waiter(self, oid: bytes, ev):
         with self._sync_reg_lock:
             ws = self._sync_waiters.get(oid)
-            if ws is not None:
+            if ws is ev:
+                # drop the empty entry (it would otherwise leak: the
+                # one-shot signal for this oid may already have fired)
+                self._sync_waiters.pop(oid, None)
+            elif isinstance(ws, list):
                 try:
                     ws.remove(ev)
                 except ValueError:
                     pass
                 if not ws:
-                    # drop the empty entry (it would otherwise leak: the
-                    # one-shot signal for this oid may already have fired)
                     self._sync_waiters.pop(oid, None)
 
     def _signal_sync_waiters(self, oid: bytes):
         ws = self._sync_waiters.pop(oid, None)
-        if ws:
+        if ws is None:
+            return
+        if isinstance(ws, list):
             # snapshot: a timed-out caller may remove() concurrently, and
             # iterating the live list under a remove can skip a waiter
             for ev in list(ws):
                 ev.set()
+        else:
+            ws.set()
 
     # ---- streaming generator returns -----------------------------------
     # Reference: num_returns="streaming" + ObjectRefGenerator
@@ -848,12 +877,21 @@ class Runtime:
             await asyncio.sleep(0.5)
         return ""
 
+    def _result_future(self, oid: bytes):
+        """Loop-only: the real asyncio.Future for a locally-pending
+        result, upgrading the lazy _PENDING_RESULT marker on first async
+        need.  None when the result is not pending here."""
+        fut = self.result_futures.get(oid)
+        if fut is _PENDING_RESULT:
+            fut = self.result_futures[oid] = asyncio.Future(loop=self._loop)
+        return fut
+
     async def await_ref_completion(self, ref: ObjectRef) -> None:
         """Wait until the task producing ``ref`` has COMPLETED, without
         fetching its value — bookkeeping callers (e.g. serve's chained
         in-flight accounting) must not materialize a possibly-huge
         result into this process just to observe that it finished."""
-        fut = self.result_futures.get(ref.object_id.binary())
+        fut = self._result_future(ref.object_id.binary())
         if fut is not None:
             try:
                 await asyncio.shield(fut)
@@ -869,7 +907,7 @@ class Runtime:
                     raise value.exc
                 return value
             # a task from this process produces it → wait for completion
-            fut = self.result_futures.get(oid)
+            fut = self._result_future(oid)
             if fut is not None:
                 remaining = (
                     None
@@ -1012,6 +1050,8 @@ class Runtime:
     def _pack_args(self, args, kwargs) -> list:
         """Top-level refs pass by reference; values serialize (promoting any
         nested refs via the reducer)."""
+        if not args and not kwargs:
+            return ()  # shared empty: no per-call list on no-arg calls
         packed = []
         for a in args:
             if isinstance(a, ObjectRef):
@@ -1144,7 +1184,9 @@ class Runtime:
         # hand off to the io loop without blocking (safe to call from the io
         # thread itself, e.g. async actor methods submitting sub-tasks).
         for oid in return_ids:
-            self.result_futures[oid] = asyncio.Future(loop=self._loop)
+            # lazy: most results are consumed by the sync fast path and
+            # never need an asyncio.Future (one tracked object per call)
+            self.result_futures[oid] = _PENDING_RESULT
         # refs exist BEFORE the enqueue can run: a fast failure path must
         # see a nonzero refcount or it would drop the error sentinel
         refs = [ObjectRef(ObjectID(oid), self.node_id) for oid in return_ids]
@@ -1165,9 +1207,10 @@ class Runtime:
     ):
         """Queue the task once locally-produced ref args have resolved."""
         waits = [
-            self.result_futures[oid]
+            fut
             for oid in dep_oids
-            if oid in self.result_futures and not self.result_futures[oid].done()
+            if (fut := self._result_future(oid)) is not None
+            and not fut.done()
         ]
         if not waits:
             failed = self._failed_dep(dep_oids)
@@ -1312,8 +1355,14 @@ class Runtime:
         )
         try:
             reply = await lease.conn.call("push_task", task.spec, timeout=-1)
-            if isinstance(reply, dict) and reply.get("exec_span"):
-                t0, t1 = reply["exec_span"]
+            span = None
+            if type(reply) is tuple:
+                if len(reply) > 2:  # ("i", payload, t0, t1)
+                    span = (reply[2], reply[3])
+            elif reply.get("exec_span"):
+                span = reply["exec_span"]
+            if span:
+                t0, t1 = span
                 self.record_event(
                     "exec", task.spec["name"],
                     task.spec["task_id"].hex(),
@@ -1366,6 +1415,38 @@ class Runtime:
         self._loop.call_later(grace, _return)
 
     def _apply_task_reply(self, task: PendingTask, reply: dict):
+        if type(reply) is tuple:
+            # compact single-inline-return shape ("i", payload) — the hot
+            # actor-call reply (one tuple on the wire instead of
+            # dict + returns list + item tuple)
+            oid = task.return_ids[0]
+            self._unhold_for_task(task.dep_oids)
+            value = self._serialization.deserialize(reply[1])
+            self.memory_store[oid] = value
+            if oid in self._escaped and oid not in self._shared:
+                try:
+                    self.store.put(oid, reply[1], protect=True)
+                    self._shared.add(oid)
+                    self._spawn(
+                        self.gcs.notify(
+                            "add_object_location",
+                            {
+                                "object_id": oid,
+                                "node_id": bytes.fromhex(self.node_id),
+                                "size": len(reply[1]),
+                            },
+                        )
+                    )
+                except ObjectExistsError:
+                    self._shared.add(oid)
+            self._cancel_requested.discard(oid)
+            fut = self.result_futures.pop(oid, None)
+            if (fut is not None and fut is not _PENDING_RESULT
+                    and not fut.done()):
+                fut.set_result(True)
+            self._signal_sync_waiters(oid)
+            self._maybe_release_after_reply(oid)
+            return
         if reply["status"] == "error":
             self._fail_task(task, self._serialization.deserialize(reply["error"]))
             return
@@ -1418,7 +1499,8 @@ class Runtime:
                 pass  # resolvable via store/pull path
             self._cancel_requested.discard(oid)
             fut = self.result_futures.pop(oid, None)
-            if fut is not None and not fut.done():
+            if (fut is not None and fut is not _PENDING_RESULT
+                    and not fut.done()):
                 fut.set_result(True)
             self._signal_sync_waiters(oid)
             self._maybe_release_after_reply(oid)
@@ -1439,7 +1521,8 @@ class Runtime:
             self._cancel_requested.discard(oid)
             self.memory_store[oid] = _RaiseOnGet(exc)
             fut = self.result_futures.pop(oid, None)
-            if fut is not None and not fut.done():
+            if (fut is not None and fut is not _PENDING_RESULT
+                    and not fut.done()):
                 fut.set_result(True)
             self._signal_sync_waiters(oid)
             self._maybe_release_after_reply(oid)
@@ -1635,7 +1718,7 @@ class Runtime:
         return_ids = [
             ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
         ]
-        dep_oids = [
+        dep_oids = () if not spec["args"] else [
             item[1] if item[0] == "ref" else item[2]
             for item in spec["args"]
             if item[0] in ("ref", "kwref")
@@ -1649,7 +1732,7 @@ class Runtime:
             self._call_on_loop(self._enqueue_actor_task, task)
             return ObjectRefGenerator(task_id.binary())
         for oid in return_ids:
-            self.result_futures[oid] = asyncio.Future(loop=self._loop)
+            self.result_futures[oid] = _PENDING_RESULT
         refs = [ObjectRef(ObjectID(oid)) for oid in return_ids]
         self._call_on_loop(self._enqueue_actor_task, task)
         return refs
@@ -1676,15 +1759,17 @@ class Runtime:
             and st.conn is not None
             and not st.conn.closed
             and not st.queue
+            # a stalled peer's write buffer must push new calls onto the
+            # queue so the PUMP (which awaits drain) provides the flow
+            # control call_soon skips
+            and st.conn.send_backlog < cfg.rpc_send_backlog_limit_bytes
         ):
             if not self._consume_cancel_flag(task):
                 task.spec["seq"] = st.wire_seq
                 task.spec["seq_epoch"] = st.epoch
                 st.wire_seq += 1
                 st.inflight[task.sub_idx] = task
-                self._loop.create_task(
-                    self._push_actor_call(aid, st, st.conn, task)
-                )
+                self._dispatch_actor_push(aid, st, st.conn, task)
             return
         st.queue.append(task)
         st.wake.set()
@@ -1738,9 +1823,20 @@ class Runtime:
                     t.spec["seq_epoch"] = st.epoch
                     st.wire_seq += 1
                     st.inflight[t.sub_idx] = t
-                    self._loop.create_task(
-                        self._push_actor_call(aid, st, st.conn, t)
-                    )
+                    self._dispatch_actor_push(aid, st, st.conn, t)
+                    if (
+                        st.conn is not None
+                        and st.conn.send_backlog
+                        > cfg.rpc_send_backlog_limit_bytes
+                    ):
+                        # flow control: call_soon skipped drain(), so the
+                        # pump awaits it — a stalled actor must apply
+                        # backpressure to submitters, not buffer every
+                        # serialized call in the transport until OOM
+                        try:
+                            await st.conn.drain()
+                        except (rpc.ConnectionLost, OSError):
+                            break  # loss path re-queues via st.inflight
                 st.wake.clear()
                 if st.inflight:
                     # woken by new submissions, a connection break, or the
@@ -1771,36 +1867,74 @@ class Runtime:
                         st.pump_running = False
                         return
 
-    async def _push_actor_call(
+    def _dispatch_actor_push(
         self, aid: bytes, st: ActorClientState, conn, task: PendingTask
     ):
+        """Fire the push and attach the reply callback — NO per-call
+        coroutine/Task (the old awaiting-coroutine shape cost a Task
+        object + frame per call on the submission hot path)."""
         self._inflight_dispatch[task.return_ids[0]] = (
             task.spec["task_id"], conn,
         )
         try:
-            reply = await conn.call("push_actor_task", task.spec, timeout=-1)
-            st.inflight.pop(task.sub_idx, None)
-            if not st.inflight and st.draining:
-                # wake ONLY a pump parked mid-drain on this event; waking
-                # the idle 60s park costs a task resume + fresh timer per
-                # call, which dominated the serial sync-call path
-                st.wake.set()
-            self._apply_task_reply(task, reply)
+            fut = conn.call_soon("push_actor_task", task.spec)
         except (rpc.ConnectionLost, OSError):
             # Leave the task in st.inflight; the pump reconnects and
-            # re-pushes.  Only signal if WE carry the current connection —
-            # a stale coroutine observing an old conn's loss after the pump
-            # already reconnected must not clobber the fresh one.
+            # re-pushes.  Only signal if WE carry the current connection.
+            # Clean the dispatch entry (the callback path's finally does
+            # this) — a stale entry would make cancel() target a dead
+            # conn instead of flagging the re-push for drop-on-arrival.
+            cur = self._inflight_dispatch.get(task.return_ids[0])
+            if cur is not None and cur[1] is conn:
+                self._inflight_dispatch.pop(task.return_ids[0], None)
             if st.conn is conn:
                 st.conn = None
                 st.wake.set()
-        except rpc.RpcError as e:
-            st.inflight.pop(task.sub_idx, None)
-            if not st.inflight and st.draining:
-                st.wake.set()
-            self._fail_task(task, TaskError(
-                "ActorCallError", str(e), "", task.spec["method"]
-            ))
+            return
+        fut.add_done_callback(
+            lambda f: self._on_push_reply(st, conn, task, f)
+        )
+
+    def _on_push_reply(
+        self, st: ActorClientState, conn, task: PendingTask, fut
+    ):
+        try:
+            exc = None if fut.cancelled() else fut.exception()
+            if fut.cancelled():
+                exc = rpc.ConnectionLost("push future cancelled")
+            if exc is None:
+                st.inflight.pop(task.sub_idx, None)
+                if not st.inflight and st.draining:
+                    # wake ONLY a pump parked mid-drain on this event;
+                    # waking the idle 60s park costs a task resume +
+                    # fresh timer per call, which dominated the serial
+                    # sync-call path
+                    st.wake.set()
+                self._apply_task_reply(task, fut.result())
+            elif isinstance(exc, (rpc.ConnectionLost, OSError)):
+                # ConnectionLost subclasses RpcError: checked FIRST.
+                # Leave the task in st.inflight; the pump reconnects and
+                # re-pushes.  Only signal if WE carry the current
+                # connection — a stale callback observing an old conn's
+                # loss after the pump already reconnected must not
+                # clobber the fresh one.
+                if st.conn is conn:
+                    st.conn = None
+                    st.wake.set()
+            elif isinstance(exc, rpc.RpcError):
+                st.inflight.pop(task.sub_idx, None)
+                if not st.inflight and st.draining:
+                    st.wake.set()
+                self._fail_task(task, TaskError(
+                    "ActorCallError", str(exc), "", task.spec["method"]
+                ))
+            else:
+                st.inflight.pop(task.sub_idx, None)
+                if not st.inflight and st.draining:
+                    st.wake.set()
+                self._fail_task(task, TaskError(
+                    "ActorCallError", repr(exc), "", task.spec["method"]
+                ))
         finally:
             cur = self._inflight_dispatch.get(task.return_ids[0])
             if cur is not None and cur[1] is conn:
@@ -2037,7 +2171,7 @@ class Runtime:
             for roid in entry["return_ids"]:
                 if roid not in self.result_futures:
                     self.memory_store.pop(roid, None)
-                    self.result_futures[roid] = asyncio.Future(loop=self._loop)
+                    self.result_futures[roid] = _PENDING_RESULT
             self._enqueue_task(
                 entry["class_key"], task, dict(entry["resources"]),
                 entry["strategy"],

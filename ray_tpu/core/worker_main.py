@@ -172,6 +172,8 @@ class WorkerServer:
             t0 = time.time()
             result = fn(*args, **kwargs)
             reply = self._exec_pack(spec, result)
+            if type(reply) is tuple:  # compact ("i", payload) fast shape
+                return (reply[0], reply[1], t0, time.time())
             reply["exec_span"] = (t0, time.time())
             return reply
         except TaskCancelledError as e:
@@ -292,16 +294,28 @@ class WorkerServer:
         })
         state["sent"] = idx + 1
 
-    def _exec_pack(self, spec, result) -> dict:
+    def _exec_pack(self, spec, result):
         n = spec["num_returns"]
         if n == 1:
-            values = [result]
-        else:
-            values = list(result)
-            if len(values) != n:
-                raise ValueError(
-                    f"task declared num_returns={n} but returned {len(values)}"
-                )
+            # hot path: single return, inline-sized → compact tuple reply
+            # ("i", payload); the caller's _apply_task_reply fast-branch
+            # consumes it (dict replies remain for every other shape)
+            s, nested = self.rt._serialize_tracked(result)
+            if s.total_bytes <= cfg.inline_object_max_bytes:
+                return ("i", s.to_bytes())
+            from ray_tpu.common.ids import ObjectID, TaskID
+
+            oid = ObjectID.for_task_return(
+                TaskID(spec["task_id"]), 0
+            ).binary()
+            self.rt._write_to_store(oid, s)
+            self.rt._register_edges(oid, nested)
+            return {"status": "ok", "returns": [("stored", s.total_bytes)]}
+        values = list(result)
+        if len(values) != n:
+            raise ValueError(
+                f"task declared num_returns={n} but returned {len(values)}"
+            )
         from ray_tpu.common.ids import ObjectID, TaskID
 
         task_id = TaskID(spec["task_id"])
