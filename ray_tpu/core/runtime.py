@@ -46,6 +46,7 @@ from ray_tpu.core.errors import (
     WorkerCrashedError,
 )
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -1163,6 +1164,14 @@ class Runtime:
         ]
         pending = PendingTask(spec, return_ids, max_retries, dep_oids=dep_oids)
         self.record_event("submit", spec["name"], task_id.hex())
+        if tracing.enabled():
+            # W3C trace context rides the spec; the worker's execute
+            # span parents under THIS submit span (reference:
+            # _ray_trace_ctx in tracing_helper.py)
+            with tracing.span(
+                f"submit {spec['name']}", task_id=task_id.hex()
+            ):
+                spec["trace_ctx"] = tracing.inject()
         # ref args stay pinned while the task is in flight, even if the
         # caller drops its own refs (reference: task-argument references,
         # reference_count.h)
@@ -1711,6 +1720,12 @@ class Runtime:
             "caller_id": self.worker_id.binary(),
             # seq/seq_epoch are assigned at push time by the actor pump
         }
+        if tracing.enabled():
+            with tracing.span(
+                f"submit {method_name}", task_id=task_id.hex(),
+                actor_id=actor_id.hex(),
+            ):
+                spec["trace_ctx"] = tracing.inject()
         if streaming:
             spec["streaming"] = True
         if concurrency_group:

@@ -170,7 +170,8 @@ class WorkerServer:
         }
         try:
             t0 = time.time()
-            result = fn(*args, **kwargs)
+            with _maybe_execute_span(spec):
+                result = fn(*args, **kwargs)
             reply = self._exec_pack(spec, result)
             if type(reply) is tuple:  # compact ("i", payload) fast shape
                 return (reply[0], reply[1], t0, time.time())
@@ -584,7 +585,8 @@ class WorkerServer:
                             "start_time": time.time(),
                         }
                         try:
-                            result = await method(*args, **kwargs)
+                            with _maybe_execute_span(spec):
+                                result = await method(*args, **kwargs)
                             reply = self._exec_pack(spec, result)
                         except Exception as e:
                             reply = self._error_reply(e, spec)
@@ -699,7 +701,8 @@ class WorkerServer:
             if unpacked is None:  # ObjectRef args: resolve on the io loop
                 unpacked = self.rt._run(self.rt.unpack_args(spec["args"]))
             args, kwargs = unpacked
-            result = method(*args, **kwargs)
+            with _maybe_execute_span(spec):
+                result = method(*args, **kwargs)
             return self._exec_pack(spec, result)
         except TaskCancelledError as e:
             return self._error_reply(e, spec)
@@ -711,6 +714,21 @@ class WorkerServer:
             self._running_task_threads.pop(tid, None)
             self._running_tasks.pop(tid, None)
             self._cancelled.discard(tid)
+
+
+def _maybe_execute_span(spec):
+    """Execute-side span parented under the submitter's context (the
+    TaskSpec's trace_ctx carrier); a no-op context when tracing is off
+    or the caller sent no context."""
+    from ray_tpu.util import tracing
+
+    if tracing.enabled() and spec.get("trace_ctx"):
+        return tracing.span(
+            f"execute {spec.get('method') or spec.get('name') or 'task'}",
+            carrier=spec["trace_ctx"],
+            task_id=spec["task_id"].hex(),
+        )
+    return contextlib.nullcontext()
 
 
 def _exit_soon():

@@ -161,14 +161,48 @@ def _apply_ops(block: Block, ops: List[_Op]) -> Block:
 
 
 class Dataset:
-    def __init__(self, block_refs: List[Any], ops: Optional[List[_Op]] = None):
+    def __init__(self, block_refs: List[Any], ops: Optional[List[_Op]] = None,
+                 exec_opts: Optional[dict] = None):
         self._input_refs = block_refs
         self._ops: List[_Op] = ops or []
         self._materialized: Optional[List[Any]] = None  # refs post-ops
+        # per-operator execution budget (ray: backpressure_policy/ +
+        # per-op resource requests): {"num_cpus", "memory", "window"};
+        # carried through map chains, reset at shuffle boundaries (each
+        # operator configures its own stage)
+        self._exec_opts: dict = dict(exec_opts or {})
 
     # -- plan building ---------------------------------------------------
     def _chain(self, op: _Op) -> "Dataset":
-        return Dataset(self._input_refs, self._ops + [op])
+        return Dataset(self._input_refs, self._ops + [op], self._exec_opts)
+
+    def with_resources(
+        self,
+        *,
+        num_cpus: Optional[float] = None,
+        memory: Optional[float] = None,
+        window: Optional[int] = None,
+    ) -> "Dataset":
+        """Per-operator resource budget for this dataset's fused stage
+        (reference role: per-op resource requests + the pluggable
+        backpressure policies of data/_internal/execution/
+        backpressure_policy/).  ``num_cpus``/``memory`` shape each stage
+        task's scheduling demand; ``window`` caps this operator's
+        in-flight block production independently of the global
+        RT_DATA_STREAMING_WINDOW — a heavy stage (model inference) can
+        be throttled to 2 blocks while light stages stream wide.
+        Budgets carry through chained maps and reset at shuffle
+        boundaries."""
+        opts = dict(self._exec_opts)
+        if num_cpus is not None:
+            opts["num_cpus"] = num_cpus
+        if memory is not None:
+            opts["memory"] = memory
+        if window is not None:
+            if window < 1:
+                raise ValueError("window must be >= 1")
+            opts["window"] = window
+        return Dataset(self._input_refs, list(self._ops), opts)
 
     def map_batches(
         self,
@@ -279,7 +313,7 @@ class Dataset:
                 )
                 for s in self._input_refs
             ]
-            return Dataset(pushed)
+            return Dataset(pushed, exec_opts=self._exec_opts)
         return self.map_batches(
             lambda t: t.select(cols), batch_format="pyarrow"
         )
@@ -315,6 +349,13 @@ class Dataset:
             block = src() if isinstance(src, ReadTask) else src
             return _apply_ops(block, ops)
 
+        kw = {
+            k: self._exec_opts[k]
+            for k in ("num_cpus", "memory")
+            if self._exec_opts.get(k) is not None
+        }
+        if kw:
+            run_stage = run_stage.options(**kw)
         return run_stage.remote(ops, src)
 
     def iter_block_refs(self) -> Iterator[Any]:
@@ -331,7 +372,9 @@ class Dataset:
 
         from ray_tpu.common.config import cfg
 
-        window = max(1, cfg.data_streaming_window)
+        window = max(
+            1, self._exec_opts.get("window") or cfg.data_streaming_window
+        )
         pending: Any = deque()
         srcs = iter(self._input_refs)
         for src in srcs:
@@ -525,6 +568,142 @@ class Dataset:
             refs.extend(o._execute())
         return Dataset(refs)
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned column concatenation of two equal-length datasets
+        (ray: python/ray/data/dataset.py:2215 Dataset.zip).  The right
+        side's blocks are re-sliced to the left side's block boundaries,
+        so each output block is produced by ONE task reading its left
+        block plus the covering right-side ranges — no driver
+        concatenation.  Colliding column names get a "_1" suffix, like
+        the reference."""
+        refs_a = self._execute()
+        refs_b = other._execute()
+        counts_a = self._block_counts(refs_a)
+        counts_b = self._block_counts(refs_b)
+        if builtins.sum(counts_a) != builtins.sum(counts_b):
+            raise ValueError(
+                f"zip requires equal row counts: "
+                f"{builtins.sum(counts_a)} vs {builtins.sum(counts_b)}"
+            )
+        off_b = np.concatenate([[0], np.cumsum(counts_b)])
+
+        @ray_tpu.remote
+        def zip_blocks(a_block, spans, *b_blocks):
+            pieces = [
+                b.slice(start, stop - start)
+                for b, (start, stop) in zip(b_blocks, spans)
+            ]
+            right = concat_blocks(pieces)
+            out = a_block
+            taken = set(a_block.column_names)
+            for name, col in zip(right.column_names, right.columns):
+                out_name = name if name not in taken else f"{name}_1"
+                taken.add(out_name)
+                out = out.append_column(out_name, col)
+            return out
+
+        out_refs = []
+        row = 0
+        for a_ref, n_rows in zip(refs_a, counts_a):
+            lo, hi = row, row + n_rows
+            spans, parts = [], []
+            # right-side blocks overlapping [lo, hi)
+            j0 = int(np.searchsorted(off_b, lo, side="right")) - 1
+            j = max(0, j0)
+            while j < len(refs_b) and off_b[j] < hi:
+                s = max(lo, int(off_b[j])) - int(off_b[j])
+                e = min(hi, int(off_b[j + 1])) - int(off_b[j])
+                if e > s:
+                    spans.append((s, e))
+                    parts.append(refs_b[j])
+                j += 1
+            if not spans:
+                # zero-row left block: a 0-row right slice keeps the
+                # right SCHEMA in the output (a schemaless empty would
+                # make sibling blocks inconsistent downstream)
+                spans, parts = [(0, 0)], [refs_b[0]]
+            out_refs.append(zip_blocks.remote(a_ref, spans, *parts))
+            row = hi
+        return Dataset(out_refs)
+
+    def join(
+        self,
+        other: "Dataset",
+        on: Union[str, List[str]],
+        how: str = "inner",
+        *,
+        num_partitions: Optional[int] = None,
+    ) -> "Dataset":
+        """Distributed hash join (ray: Dataset.join).  Both sides
+        hash-partition on the key (process-stable crc32, the groupby
+        scatter), then each partition joins via pyarrow's native
+        Table.join — n independent tasks, no driver concatenation."""
+        join_type = {
+            "inner": "inner",
+            "left": "left outer",
+            "right": "right outer",
+            "outer": "full outer",
+            "semi": "left semi",
+            "anti": "left anti",
+        }.get(how)
+        if join_type is None:
+            raise ValueError(
+                f"unknown join how={how!r}; one of inner/left/right/"
+                f"outer/semi/anti"
+            )
+        keys = [on] if isinstance(on, str) else list(on)
+        refs_a = self._execute()
+        refs_b = other._execute()
+        if not refs_a:
+            if join_type in (
+                "inner", "left semi", "left anti", "left outer",
+            ):
+                return Dataset([])
+            raise ValueError(
+                f"{how} join with an empty left side is not supported "
+                "(the output needs the left schema)"
+            )
+        if not refs_b:
+            if join_type in ("inner", "left semi"):
+                return Dataset([])
+            if join_type == "left anti":
+                return Dataset(list(refs_a))  # nothing to subtract
+            raise ValueError(
+                f"{how} join with an empty right side is not supported "
+                "(the output needs the right schema)"
+            )
+        n = num_partitions or max(len(refs_a), len(refs_b), 1)
+        key0 = keys[0]
+
+        @ray_tpu.remote
+        def scatter(block):
+            pieces = GroupedData._hash_scatter(block, key0, n)
+            return tuple(pieces) if n > 1 else pieces[0]
+
+        @ray_tpu.remote
+        def join_part(n_left, *parts):
+            left = concat_blocks(list(parts[:n_left]))
+            right = concat_blocks(list(parts[n_left:]))
+            return left.join(right, keys=keys, join_type=join_type)
+
+        def scatter_side(refs):
+            outs = []
+            for r in refs:
+                o = scatter.options(num_returns=n).remote(r)
+                outs.append(o if n > 1 else [o])
+            return outs
+
+        parts_a = scatter_side(refs_a)
+        parts_b = scatter_side(refs_b)
+        return Dataset([
+            join_part.remote(
+                len(parts_a),
+                *[pa_[j] for pa_ in parts_a],
+                *[pb_[j] for pb_ in parts_b],
+            )
+            for j in range(n)
+        ])
+
     def limit(self, n: int) -> "Dataset":
         taken, out = 0, []
         for ref in self.iter_block_refs():
@@ -587,7 +766,10 @@ class Dataset:
         out: List[List[Any]] = [[] for _ in range(n)]
         for i, src in enumerate(self._input_refs):
             out[i % n].append(src)
-        return [Dataset(srcs, ops=list(self._ops)) for srcs in out]
+        return [
+            Dataset(srcs, ops=list(self._ops), exec_opts=self._exec_opts)
+            for srcs in out
+        ]
 
     def streaming_split(
         self, n: int, *, equal: bool = False, locality_hints=None

@@ -87,7 +87,8 @@ def gpt2_from_pp(pp_params: Params) -> Params:
 
 
 def gpt2_pp_train_step(
-    config, mesh: Mesh, optimizer, *, n_micro: int
+    config, mesh: Mesh, optimizer, *, n_micro: int,
+    _check_vma: bool = False,
 ):
     """Pipelined GPT-2 train step over the mesh's pp axis.
 
@@ -121,7 +122,8 @@ def gpt2_pp_train_step(
         return -(tl - lse).mean()
 
     return tailed_pipeline_train_step(
-        stage_fn, prelude, loss_tail, optimizer, mesh, n_micro=n_micro
+        stage_fn, prelude, loss_tail, optimizer, mesh, n_micro=n_micro,
+        _check_vma=_check_vma,
     )
 
 
@@ -141,7 +143,8 @@ def llama_from_pp(pp_params: Params) -> Params:
 
 
 def llama_pp_train_step(
-    config, mesh: Mesh, optimizer, *, n_micro: int
+    config, mesh: Mesh, optimizer, *, n_micro: int,
+    _check_vma: bool = False,
 ):
     """Pipelined Llama train step (GQA blocks, RMSNorm tail, tied or
     untied head) over the mesh's pp axis."""
@@ -174,5 +177,6 @@ def llama_pp_train_step(
         return -(tl - lse).mean()
 
     return tailed_pipeline_train_step(
-        stage_fn, prelude, loss_tail, optimizer, mesh, n_micro=n_micro
+        stage_fn, prelude, loss_tail, optimizer, mesh, n_micro=n_micro,
+        _check_vma=_check_vma,
     )

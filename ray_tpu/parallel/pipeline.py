@@ -173,6 +173,7 @@ def tailed_pipeline_train_step(
     mesh: Mesh,
     *,
     n_micro: int,
+    _check_vma: bool = False,
 ):
     """Pipeline step for models with non-stage params (embeddings, final
     norm, lm head) — the shape of a real transformer, composed with the
@@ -216,15 +217,19 @@ def tailed_pipeline_train_step(
         )
         # check_vma=False: with manual-over-pp only, the vma type checker
         # feeds the backward pass an HLO 'copy' binop that aborts XLA's
-        # CPU backend (jax 0.9); the pipeline's own pcasts already make
-        # the carry types consistent
+        # CPU backend (jax 0.9, "Invalid binary instruction opcode
+        # copy"); the pipeline's own pcasts already make the carry types
+        # consistent.  tests/test_pipeline.py's canary runs this exact
+        # path with _check_vma=True and fails LOUDLY the day a jax
+        # upgrade fixes the crash, so the checker opt-out cannot
+        # silently outlive the bug it works around.
         return jax.shard_map(
             inner,
             mesh=mesh,
             in_specs=in_specs,
             out_specs=P(),
             axis_names=frozenset({PP_AXIS}),
-            check_vma=False,
+            check_vma=_check_vma,
         )(params, x, y)
 
     @partial(jax.jit, donate_argnums=(0, 1))

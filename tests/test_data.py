@@ -348,3 +348,121 @@ class TestActorPoolMapBatches:
 
         ds = rd.range(64).repartition(4).map_batches(Echo, concurrency=2)
         assert sorted(r["id"] for r in ds.take_all()) == list(range(64))
+
+
+class TestZipJoinBudgets:
+    """zip / join / per-op resource budgets (ray: dataset.py:2215 zip,
+    Dataset.join, data/_internal/execution/backpressure_policy/)."""
+
+    def test_zip_realigns_blocks(self, cluster):
+        import ray_tpu.data as rd
+
+        a = rd.from_items([{"x": i} for i in range(10)]).repartition(3)
+        b = rd.from_items([{"y": i * 2} for i in range(10)]).repartition(4)
+        z = a.zip(b)
+        rows = sorted(z.take_all(), key=lambda r: r["x"])
+        assert [r["y"] for r in rows] == [i * 2 for i in range(10)]
+
+    def test_zip_name_collision_suffix(self, cluster):
+        import ray_tpu.data as rd
+
+        a = rd.from_items([{"x": 1}])
+        b = rd.from_items([{"x": 9}])
+        row = a.zip(b).take_all()[0]
+        assert row == {"x": 1, "x_1": 9}
+
+    def test_zip_length_mismatch_rejected(self, cluster):
+        import ray_tpu.data as rd
+
+        with pytest.raises(ValueError, match="equal row counts"):
+            rd.range(5).zip(rd.range(6))
+
+    def test_inner_join(self, cluster):
+        import ray_tpu.data as rd
+
+        users = rd.from_items(
+            [{"uid": i, "name": f"u{i}"} for i in range(8)]
+        ).repartition(3)
+        orders = rd.from_items(
+            [{"uid": i % 4, "amount": 10 * i} for i in range(12)]
+        ).repartition(2)
+        j = users.join(orders, on="uid")
+        rows = j.take_all()
+        assert len(rows) == 12  # every order matches one of uids 0-3
+        assert all(r["name"] == f"u{r['uid']}" for r in rows)
+
+    def test_left_outer_join(self, cluster):
+        import ray_tpu.data as rd
+
+        left = rd.from_items([{"k": i, "a": i} for i in range(4)])
+        right = rd.from_items([{"k": 0, "b": 7}, {"k": 2, "b": 9}])
+        rows = sorted(
+            left.join(right, on="k", how="left").take_all(),
+            key=lambda r: r["k"],
+        )
+        assert [r.get("b") for r in rows] == [7, None, 9, None]
+
+    def test_bad_join_how_rejected(self, cluster):
+        import ray_tpu.data as rd
+
+        with pytest.raises(ValueError, match="unknown join"):
+            rd.range(3).join(rd.range(3), on="id", how="cross")
+
+    def test_with_resources_budget_applies(self, cluster):
+        import ray_tpu.data as rd
+
+        # a 2-CPU budget per stage on a 4-CPU cluster: at most 2 stage
+        # tasks run concurrently — observable via a concurrency probe
+        @ray_tpu.remote
+        class Gauge:
+            def __init__(self):
+                self.cur = self.peak = 0
+
+            def enter(self):
+                self.cur += 1
+                self.peak = max(self.peak, self.cur)
+
+            def exit(self):
+                self.cur -= 1
+
+            def peak_seen(self):
+                return self.peak
+
+        g = Gauge.remote()
+
+        def probe(batch):
+            import time as _t
+
+            ray_tpu.get(g.enter.remote(), timeout=60)
+            _t.sleep(0.3)
+            ray_tpu.get(g.exit.remote(), timeout=60)
+            return batch
+
+        ds = (
+            rd.range(8)
+            .repartition(8)
+            .map_batches(probe)
+            .with_resources(num_cpus=2.0)
+        )
+        ds.materialize()
+        assert ray_tpu.get(g.peak_seen.remote(), timeout=60) <= 2
+
+    def test_with_resources_window_caps_streaming(self, cluster):
+        import ray_tpu.data as rd
+
+        ds = rd.range(20).repartition(10).with_resources(window=2)
+        # windowed streaming still yields every block, in order
+        total = 0
+        for ref in ds.iter_block_refs():
+            total += ray_tpu.get(ref, timeout=120).num_rows
+        assert total == 20
+
+    def test_budget_carries_through_map_chain(self, cluster):
+        import ray_tpu.data as rd
+
+        ds = rd.range(4).with_resources(window=3).map(
+            lambda r: {"id": r["id"] + 1}
+        )
+        assert ds._exec_opts["window"] == 3
+        # shuffle boundary resets the per-operator budget
+        assert ds.repartition(2)._exec_opts == {}

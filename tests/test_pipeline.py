@@ -126,3 +126,74 @@ class TestPipeline:
     def test_too_few_devices_raises(self):
         with pytest.raises(ValueError, match="devices"):
             make_pp_mesh(1000)
+
+
+class TestCheckVmaWorkaround:
+    def test_check_vma_false_canary(self):
+        """parallel/pipeline.py's tailed_pipeline_train_step disables
+        shard_map's vma type checker: with the checker ON, the
+        manual-over-pp backward pass feeds XLA's CPU backend an HLO
+        'copy' binop that hard-ABORTS the process (jax 0.9, "Invalid
+        binary instruction opcode copy" + SIGABRT — hence the
+        subprocess).  This canary drives the EXACT production path
+        (gpt2_pp_train_step) with the checker re-enabled:
+
+        - today the subprocess must die (the workaround is still
+          required; the green pipeline tests above prove the step works
+          with the checker off);
+        - when a jax upgrade makes this PASS, this test FAILS loudly —
+          flip _check_vma's default in tailed_pipeline_train_step and
+          delete this canary (a silently-obsolete correctness-checker
+          opt-out is worse than a red test)."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import dataclasses
+import numpy as np, jax.numpy as jnp, optax
+from ray_tpu.models import gpt2, pp
+from ray_tpu.parallel import mesh as mesh_mod
+cfg = dataclasses.replace(gpt2.GPTConfig.tiny(), max_seq_len=16)
+params = gpt2.init(jax.random.key(0), cfg)
+mesh = mesh_mod.make_mesh(mesh_mod.MeshConfig(dp=-1, pp=2))
+opt = optax.sgd(0.1)
+pp_params = jax.tree.map(jnp.copy, pp.gpt2_to_pp(params, 2))
+opt_state = opt.init(pp_params)
+step = pp.gpt2_pp_train_step(cfg, mesh, opt, n_micro=2, _check_vma=True)
+toks = np.random.default_rng(0).integers(
+    0, cfg.vocab_size, (2, 2, 17)).astype(np.int32)
+_, _, loss = step(pp_params, opt_state, toks[..., :-1], toks[..., 1:])
+jax.block_until_ready(loss)
+print("VMA_OK", float(loss))
+"""
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=600, env={**os.environ, "PYTHONPATH": repo},
+        )
+        if "VMA_OK" in r.stdout:
+            pytest.fail(
+                "check_vma=True now works for the manual-over-pp "
+                "backward pass: the jax/XLA bug is fixed — flip the "
+                "_check_vma default in parallel/pipeline.py "
+                "tailed_pipeline_train_step and delete this canary."
+            )
+        # must be THE known abort (SIGABRT from XLA's opcode check), not
+        # an unrelated harness breakage — an ImportError exiting 1 would
+        # otherwise leave this canary green while guarding nothing
+        known_abort = (
+            r.returncode < 0
+            or r.returncode == 134  # 128 + SIGABRT via shells
+            or "Invalid binary instruction opcode" in r.stderr
+        )
+        assert known_abort, (
+            f"canary subprocess failed for an UNEXPECTED reason "
+            f"(rc={r.returncode}) — fix the canary harness:\n"
+            f"{r.stderr[-800:]}"
+        )

@@ -1,0 +1,135 @@
+"""Distributed tracing: spans around submit/execute, W3C context in the
+TaskSpec, cluster-wide aggregation via GCS events.
+
+(reference: python/ray/util/tracing/tracing_helper.py — _ray_trace_ctx
+propagation + submit/execute span wrappers; here the OpenTelemetry API
+is bridged when an SDK provider exists and a built-in recorder serves
+otherwise, since the image ships no OTel SDK.)
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import events, tracing
+
+
+@pytest.fixture(scope="module")
+def traced_cluster():
+    os.environ["RT_TRACING_ENABLED"] = "1"  # workers inherit
+    tracing.enable()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+    tracing.disable()
+    os.environ.pop("RT_TRACING_ENABLED", None)
+
+
+def _span_events():
+    return [
+        e for e in events.list_events()
+        if e.get("source") == "tracing"
+    ]
+
+
+class TestTracing:
+    def test_carrier_is_w3c_traceparent(self):
+        c = tracing.inject()
+        ver, trace_id, span_id, flags = c["traceparent"].split("-")
+        assert ver == "00" and flags == "01"
+        assert len(trace_id) == 32 and len(span_id) == 16
+
+    def test_task_execute_parents_under_submit(self, traced_cluster):
+        tracing.clear()
+
+        @ray_tpu.remote
+        def traced_add(x):
+            return x + 1
+
+        assert ray_tpu.get(traced_add.remote(1), timeout=60) == 2
+        local = tracing.spans()
+        submit = [s for s in local if s["name"].startswith("submit")]
+        assert submit, local
+        trace_id = submit[-1]["trace_id"]
+        # the worker-side execute span lands in the GCS event ring with
+        # the SAME trace id, parented under the submit span
+        deadline = time.monotonic() + 30
+        execs = []
+        while time.monotonic() < deadline and not execs:
+            execs = [
+                e for e in _span_events()
+                if e.get("trace_id") == trace_id
+                and e.get("name", "").startswith("execute")
+            ]
+            time.sleep(0.2)
+        assert execs, "no execute span exported"
+        f = execs[0]
+        assert f["parent_id"] == submit[-1]["span_id"]
+        assert f["pid"] != os.getpid()  # actually ran in the worker
+
+    def test_actor_call_chain_keeps_one_trace(self, traced_cluster):
+        tracing.clear()
+
+        @ray_tpu.remote
+        def inner():
+            return os.getpid()
+
+        @ray_tpu.remote
+        class Outer:
+            def call_inner(self):
+                # nested submit INSIDE the actor: its span must parent
+                # under this actor's execute span (same trace)
+                return ray_tpu.get(inner.remote(), timeout=60)
+
+        o = Outer.remote()
+        with tracing.span("driver-root"):
+            ray_tpu.get(o.call_inner.remote(), timeout=60)
+        root = tracing.spans()[-1]
+        assert root["name"] == "driver-root"
+        trace_id = root["trace_id"]
+        deadline = time.monotonic() + 30
+        names = set()
+        while time.monotonic() < deadline:
+            names = {
+                e.get("name", "")
+                for e in _span_events()
+                if e.get("trace_id") == trace_id
+            }
+            if any(
+                n.startswith("execute") and n.endswith("inner")
+                and "call_inner" not in n
+                for n in names
+            ) and any(n.startswith("execute call_inner") for n in names):
+                break
+            time.sleep(0.2)
+        assert any(n.startswith("execute call_inner") for n in names), names
+        # plain tasks carry their qualified name; the nested task's
+        # execute span is in the SAME trace
+        assert any(
+            n.startswith("execute") and n.endswith("inner")
+            and "call_inner" not in n
+            for n in names
+        ), names
+
+    def test_disabled_tracing_adds_nothing(self, traced_cluster):
+        tracing.disable()
+        try:
+            tracing.clear()
+
+            @ray_tpu.remote
+            def untraced():
+                return 1
+
+            ray_tpu.get(untraced.remote(), timeout=60)
+            assert tracing.spans() == []
+        finally:
+            tracing.enable()
+
+    def test_span_records_error_attribute(self):
+        with pytest.raises(ValueError):
+            with tracing.span("boom"):
+                raise ValueError("x")
+        s = tracing.spans()[-1]
+        assert s["name"] == "boom" and s["attributes"]["error"] == "ValueError"
