@@ -21,6 +21,7 @@ import hashlib
 import logging
 import os
 import threading
+import weakref
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -207,6 +208,8 @@ class Runtime:
 
         # function cache (worker side)
         self._fn_cache: Dict[bytes, Any] = {}
+        self._fn_hash_memo: Dict[int, bytes] = {}  # id(fn) -> hash
+        self._fn_hash_weak = weakref.WeakValueDictionary()
 
         # ---- distributed refcounting (reference analogue:
         # core_worker/reference_count.h:61, collapsed to a GCS-tracked
@@ -1026,6 +1029,22 @@ class Runtime:
 
     # ---- task submission ----------------------------------------------
     def fn_hash_and_register(self, fn) -> bytes:
+        # Memoized per function OBJECT: cloudpickling the (identical)
+        # function on every submit cost ~90µs/call — the whole hash
+        # exists so the function ships once.  Semantics note (same as
+        # the reference's once-per-export function shipping): the code
+        # and its captured state are SNAPSHOTTED at a function object's
+        # first submit; mutating a captured cell between submits of the
+        # same object is not re-shipped.  A NEW function object (fresh
+        # lambda/def) always re-pickles.
+        #
+        # The identity check rides a WeakValueDictionary: a dead
+        # function's entry vanishes, so a recycled id() can never alias
+        # a DIFFERENT function to a stale hash, and per-submit lambdas
+        # (with whatever their closures capture) are not pinned alive.
+        alive = self._fn_hash_weak.get(id(fn))
+        if alive is fn:
+            return self._fn_hash_memo[id(fn)]
         blob = cloudpickle.dumps(fn)
         h = hashlib.blake2b(blob, digest_size=16).digest()
         if h not in self._fn_cache:
@@ -1036,6 +1055,14 @@ class Runtime:
                     {"key": f"fn:{h.hex()}", "value": blob, "overwrite": False},
                 )
             )
+        if len(self._fn_hash_memo) > 4096:
+            self._fn_hash_memo.clear()
+            self._fn_hash_weak.clear()
+        try:
+            self._fn_hash_weak[id(fn)] = fn
+        except TypeError:
+            return h  # not weakref-able: skip memoization
+        self._fn_hash_memo[id(fn)] = h
         return h
 
     async def resolve_fn(self, fn_hash: bytes):
@@ -1363,7 +1390,15 @@ class Runtime:
             task.spec["task_id"], lease.conn,
         )
         try:
-            reply = await lease.conn.call("push_task", task.spec, timeout=-1)
+            # call_soon: no wait_for timer / pending-pop bookkeeping per
+            # task (same no-timeout semantics the old timeout=-1 had).
+            # Its skipped write flow control is restored here: past the
+            # backlog budget, await drain so large pipelined arg payloads
+            # pause at the high-water mark instead of buffering unbounded
+            fut = lease.conn.call_soon("push_task", task.spec)
+            if lease.conn.send_backlog > cfg.rpc_send_backlog_limit_bytes:
+                await lease.conn.drain()
+            reply = await fut
             span = None
             if type(reply) is tuple:
                 if len(reply) > 2:  # ("i", payload, t0, t1)

@@ -141,21 +141,96 @@ class WorkerServer:
     async def handle_push_task(self, spec, conn=None) -> dict:
         try:
             fn = await self.rt.resolve_fn(spec["fn_hash"])
-            args, kwargs = await self.rt.unpack_args(spec["args"])
         except Exception as e:
             return self._error_reply(e, spec)
-        if spec.get("streaming"):
-            return await self._run_streaming(conn, spec, fn, args, kwargs,
-                                             self._exec)
-        if inspect.iscoroutinefunction(fn):
+        if spec.get("streaming") or inspect.iscoroutinefunction(fn):
             try:
-                result = await fn(*args, **kwargs)
+                args, kwargs = await self.rt.unpack_args(spec["args"])
+            except Exception as e:
+                return self._error_reply(e, spec)
+            if spec.get("streaming"):
+                return await self._run_streaming(
+                    conn, spec, fn, args, kwargs, self._exec
+                )
+            try:
+                with _maybe_execute_span(spec):
+                    result = await fn(*args, **kwargs)
                 return self._exec_pack(spec, result)
             except Exception as e:
                 return self._error_reply(e, spec)
-        return await asyncio.get_running_loop().run_in_executor(
-            self._exec, self._execute_sync, fn, args, kwargs, spec
-        )
+        # sync function: proven-fast fns run inline on the io loop (the
+        # executor is ONE thread, so execution is serial either way and
+        # inline only skips its two context switches — the same
+        # promote/demote contract as actor methods)
+        key = "task:" + spec["fn_hash"].hex()
+        reply = self._maybe_execute_task_inline(fn, key, spec)
+        if reply is not None:
+            return reply
+        try:
+            args, kwargs = await self.rt.unpack_args(spec["args"])
+        except Exception as e:
+            return self._error_reply(e, spec)
+        self._sync_exec_inflight += 1
+        t0 = time.perf_counter()
+        try:
+            reply = await asyncio.get_running_loop().run_in_executor(
+                self._exec, self._execute_sync, fn, args, kwargs, spec
+            )
+        finally:
+            self._sync_exec_inflight -= 1
+        # executor timing includes queue wait: under contention the
+        # streak resets, exactly when staying on the pool is right
+        self._note_method_time(key, time.perf_counter() - t0)
+        return reply
+
+    def _maybe_execute_task_inline(self, fn, key: str, spec):
+        """Plain-task twin of _maybe_execute_inline: run a proven-fast
+        sync function directly on the io loop.  Same safety conditions —
+        nothing on the executor (serial semantics preserved), ref-free
+        args, sub-2ms streak; same tail-risk bound (one slow run demotes
+        permanently past 50 ms)."""
+        if self._sync_exec_inflight:
+            return None
+        st = self._method_stats.get(key)
+        if st is None or st[1] or st[0] < self._INLINE_AFTER:
+            return None
+        try:
+            unpacked = self.rt.unpack_args_sync(spec["args"])
+        except Exception as e:
+            # a bad ARG (undeserializable payload) is the caller's error,
+            # not a worker crash — letting it escape would surface as
+            # RESPONSE_ERR and tear the healthy lease down
+            return self._error_reply(e, spec)
+        if unpacked is None:
+            return None
+        tid = spec["task_id"]
+        if tid in self._cancelled:
+            self._cancelled.discard(tid)
+            return self._error_reply(TaskCancelledError("cancelled"), spec)
+        t0_wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            args, kwargs = unpacked
+            with _maybe_execute_span(spec):
+                result = fn(*args, **kwargs)
+            reply = self._exec_pack(spec, result)
+            # exec span for the timeline, both reply shapes (promoted
+            # fns must not vanish from dashboards)
+            if type(reply) is tuple:
+                reply = (reply[0], reply[1], t0_wall, time.time())
+            else:
+                reply["exec_span"] = (t0_wall, time.time())
+        except TaskCancelledError as e:
+            reply = self._error_reply(e, spec)
+        except BaseException as e:
+            reply = self._error_reply(
+                e if isinstance(e, Exception) else RuntimeError(repr(e)),
+                spec,
+            )
+        finally:
+            self._cancelled.discard(tid)
+        self._note_method_time(key, time.perf_counter() - t0)
+        return reply
 
     def _execute_sync(self, fn, args, kwargs, spec) -> dict:
         tid = spec["task_id"]

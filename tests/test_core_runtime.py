@@ -230,6 +230,29 @@ class TestActors:
             ray_tpu.get(bad, timeout=60)
         assert ray_tpu.get(good, timeout=60) == 1
 
+    def test_promoted_task_bad_arg_is_task_error_not_crash(self, cluster):
+        # after a function is promoted to inline execution (10 fast
+        # runs), an argument that fails to DESERIALIZE on the worker
+        # must surface as the caller's TaskError — not escape the
+        # handler, break the lease, and masquerade as a worker crash
+        def _boom_on_load():
+            raise RuntimeError("payload refuses to deserialize")
+
+        class Boom:
+            def __reduce__(self):
+                return (_boom_on_load, ())
+
+        @ray_tpu.remote
+        def echo(x=1):
+            return x
+
+        for _ in range(15):  # promote past the inline streak threshold
+            ray_tpu.get(echo.remote(), timeout=60)
+        with pytest.raises(TaskError):
+            ray_tpu.get(echo.remote(Boom()), timeout=60)
+        # the worker and its lease survived
+        assert ray_tpu.get(echo.remote(7), timeout=60) == 7
+
     def test_backpressured_burst_completes_in_order(self, cluster):
         # large-arg burst against one actor: frames exceed the transport
         # high-water immediately, so the pump's drain() flow control
