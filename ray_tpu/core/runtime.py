@@ -208,8 +208,9 @@ class Runtime:
 
         # function cache (worker side)
         self._fn_cache: Dict[bytes, Any] = {}
-        self._fn_hash_memo: Dict[int, bytes] = {}  # id(fn) -> hash
-        self._fn_hash_weak = weakref.WeakValueDictionary()
+        # id(fn) -> (weakref(fn), hash): submit-path memo (see
+        # fn_hash_and_register)
+        self._fn_hash_memo: Dict[int, tuple] = {}
 
         # ---- distributed refcounting (reference analogue:
         # core_worker/reference_count.h:61, collapsed to a GCS-tracked
@@ -1042,9 +1043,12 @@ class Runtime:
         # function's entry vanishes, so a recycled id() can never alias
         # a DIFFERENT function to a stale hash, and per-submit lambdas
         # (with whatever their closures capture) are not pinned alive.
-        alive = self._fn_hash_weak.get(id(fn))
-        if alive is fn:
-            return self._fn_hash_memo[id(fn)]
+        entry = self._fn_hash_memo.get(id(fn))
+        if entry is not None and entry[0]() is fn:
+            # single-entry read: (weakref, hash) read atomically, so a
+            # concurrent submit clearing the memo can't strand us between
+            # an identity check and a separate hash lookup
+            return entry[1]
         blob = cloudpickle.dumps(fn)
         h = hashlib.blake2b(blob, digest_size=16).digest()
         if h not in self._fn_cache:
@@ -1056,13 +1060,11 @@ class Runtime:
                 )
             )
         if len(self._fn_hash_memo) > 4096:
-            self._fn_hash_memo.clear()
-            self._fn_hash_weak.clear()
+            self._fn_hash_memo.clear()  # also reaps dead-weakref entries
         try:
-            self._fn_hash_weak[id(fn)] = fn
+            self._fn_hash_memo[id(fn)] = (weakref.ref(fn), h)
         except TypeError:
-            return h  # not weakref-able: skip memoization
-        self._fn_hash_memo[id(fn)] = h
+            pass  # not weakref-able: skip memoization
         return h
 
     async def resolve_fn(self, fn_hash: bytes):
@@ -1416,7 +1418,11 @@ class Runtime:
                     start=t0, dur=t1 - t0,
                 )
             self._apply_task_reply(task, reply)
-        except (rpc.ConnectionLost, rpc.RpcError) as e:
+        except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+            # OSError included: the backlog drain() raises raw socket
+            # errors (ConnectionResetError) on a mid-write worker death —
+            # they must break the lease and retry/fail like any loss, not
+            # kill the dispatch task silently
             lease.broken = True
             if task.retries_left > 0:
                 task.retries_left -= 1

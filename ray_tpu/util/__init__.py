@@ -15,3 +15,4 @@ from ray_tpu.util.scheduling_strategies import (  # noqa: F401
     PlacementGroupSchedulingStrategy,
     SpreadSchedulingStrategy,
 )
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401

@@ -1,0 +1,145 @@
+"""ActorPool + distributed Queue (ray: util/actor_pool.py, util/queue.py)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Doubler:
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def double(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        return 2 * x
+
+
+class TestActorPool:
+    def test_map_preserves_order(self, cluster):
+        pool = ActorPool([Doubler.remote() for _ in range(3)])
+        assert list(pool.map(
+            lambda a, v: a.double.remote(v), range(8)
+        )) == [2 * i for i in range(8)]
+
+    def test_map_unordered_yields_all(self, cluster):
+        pool = ActorPool(
+            [Doubler.remote(delay=0.05), Doubler.remote()]
+        )
+        out = list(pool.map_unordered(
+            lambda a, v: a.double.remote(v), range(6)
+        ))
+        assert sorted(out) == [2 * i for i in range(6)]
+
+    def test_submit_get_next_cycle(self, cluster):
+        pool = ActorPool([Doubler.remote()])
+        pool.submit(lambda a, v: a.double.remote(v), 10)
+        assert not pool.has_free()
+        assert pool.has_next()
+        assert pool.get_next(timeout=60) == 20
+        assert pool.has_free() and not pool.has_next()
+
+    def test_push_pop_idle(self, cluster):
+        a1, a2 = Doubler.remote(), Doubler.remote()
+        pool = ActorPool([a1])
+        pool.push(a2)
+        assert pool.pop_idle() is not None
+        assert pool.pop_idle() is not None
+        assert pool.pop_idle() is None
+
+    def test_reuses_actors_for_state(self, cluster):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self, _):
+                self.n += 1
+                return self.n
+
+        pool = ActorPool([Counter.remote()])
+        out = list(pool.map(lambda a, v: a.bump.remote(v), range(5)))
+        assert out == [1, 2, 3, 4, 5]  # ONE actor served every value
+
+
+class TestQueue:
+    def test_fifo_put_get(self, cluster):
+        q = Queue()
+        for i in range(5):
+            q.put(i)
+        assert [q.get(timeout=30) for _ in range(5)] == list(range(5))
+        q.shutdown()
+
+    def test_nowait_and_exceptions(self, cluster):
+        q = Queue(maxsize=2)
+        q.put_nowait(1)
+        q.put_nowait(2)
+        with pytest.raises(Full):
+            q.put_nowait(3)
+        assert q.full()
+        assert q.get_nowait() == 1
+        assert q.get_nowait() == 2
+        with pytest.raises(Empty):
+            q.get_nowait()
+        assert q.empty()
+        q.shutdown()
+
+    def test_blocking_get_waits_for_producer(self, cluster):
+        q = Queue()
+        got = []
+
+        def consumer():
+            got.append(q.get(timeout=30))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.3)
+        q.put("late")
+        t.join(timeout=30)
+        assert got == ["late"]
+        q.shutdown()
+
+    def test_get_timeout_raises_empty(self, cluster):
+        q = Queue()
+        t0 = time.monotonic()
+        with pytest.raises(Empty):
+            q.get(timeout=0.5)
+        assert time.monotonic() - t0 < 10
+        q.shutdown()
+
+    def test_batches_are_atomic(self, cluster):
+        q = Queue(maxsize=3)
+        q.put_nowait_batch([1, 2])
+        with pytest.raises(Full):
+            q.put_nowait_batch([3, 4])  # all-or-nothing
+        q.put_nowait_batch([3])
+        assert q.get_nowait_batch(3) == [1, 2, 3]
+        with pytest.raises(Empty):
+            q.get_nowait_batch(1)
+        q.shutdown()
+
+    def test_queue_handle_travels_to_tasks(self, cluster):
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(q, n):
+            for i in range(n):
+                q.put(i)
+            return n
+
+        ray_tpu.get(producer.remote(q, 4), timeout=60)
+        assert sorted(q.get(timeout=30) for _ in range(4)) == [0, 1, 2, 3]
+        q.shutdown()
