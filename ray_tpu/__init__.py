@@ -40,4 +40,21 @@ def __getattr__(name):
         from ray_tpu.core import api
 
         return getattr(api, name)
+    if name in (
+        "RayTpuError",
+        "TaskError",
+        "WorkerCrashedError",
+        "ActorError",
+        "ActorDiedError",
+        "ObjectLostError",
+        "GetTimeoutError",
+        "TaskCancelledError",
+        "RuntimeEnvSetupError",
+        "NodeDiedError",
+    ):
+        # error types at the package top level, like ray.exceptions'
+        # re-exports (ray: python/ray/exceptions.py)
+        from ray_tpu.core import errors
+
+        return getattr(errors, name)
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

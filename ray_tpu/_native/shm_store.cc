@@ -459,6 +459,10 @@ int32_t claim_client_slot(Store* s) {
 
 extern "C" {
 
+// Per-client ledger capacity (shared by pins and unsealed creates) so
+// Python callers can gauge headroom without duplicating the constant.
+uint64_t rt_store_max_pins() { return kMaxPinsPerClient; }
+
 // Minimum arena size such that metadata plus a useful data region fit.
 uint64_t rt_store_min_size() {
   uint64_t meta = round_up(sizeof(Header), kAlign) +

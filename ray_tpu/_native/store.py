@@ -131,6 +131,8 @@ def _get_lib():
                 lib.rt_store_reap.argtypes = [ctypes.c_void_p]
                 lib.rt_store_min_size.restype = ctypes.c_uint64
                 lib.rt_store_min_size.argtypes = []
+                lib.rt_store_max_pins.restype = ctypes.c_uint64
+                lib.rt_store_max_pins.argtypes = []
                 _lib = lib
     return _lib
 
@@ -149,7 +151,7 @@ def _check_id(object_id: bytes) -> bytes:
 class PinnedBuffer:
     """Zero-copy view of a sealed object; unpins on release/del."""
 
-    __slots__ = ("store", "object_id", "view", "_released", "__weakref__")
+    __slots__ = ("store", "object_id", "view", "_released")
 
     def __init__(self, store: "ShmStore", object_id: bytes, view: memoryview):
         self.store = store
@@ -208,9 +210,21 @@ class ShmStore:
             os.close(fd)
         self._mv = memoryview(self._mm)
         self._closed = False
-        import weakref
-
-        self._live_pins = weakref.WeakSet()
+        # pins outstanding in THIS client (zero-copy get() views the user
+        # still holds).  The C ledger caps pins+creates at
+        # kMaxPinsPerClient=1024; callers consult pin_headroom() to fall
+        # back to copy-out gets before the ledger fills.  The lock fences
+        # pin finalizers (any thread) against close()'s detach — unpin on
+        # a detached handle would be use-after-free.
+        self._pins_outstanding = 0
+        # RLock, not Lock: critical sections allocate (int boxing, ctypes
+        # marshalling), any allocation can trigger cyclic GC, and a
+        # collected cycle can finalize a PinnedBuffer whose __del__ ->
+        # _unpin re-enters this lock on the SAME thread.  Re-entrant
+        # sections are interleave-safe (counter updates are complete
+        # statements; after close() sets _closed the C call is skipped).
+        self._pin_lock = threading.RLock()
+        self._max_pins = int(self._lib.rt_store_max_pins())
         self._created_views: dict = {}  # object_id -> writable view until seal
         # First-touch page faults dominate large writes into fresh arena
         # regions (~0.7 GB/s trap-per-page vs ~6 GB/s on resident pages).
@@ -301,8 +315,20 @@ class ShmStore:
             raise StoreError(f"get failed: {_rc_name(rc)}")
         view = self._mv[off.value : off.value + size.value]
         pin = PinnedBuffer(self, object_id, view)
-        self._live_pins.add(pin)
+        with self._pin_lock:
+            self._pins_outstanding += 1
         return pin
+
+    def pin_headroom(self) -> int:
+        """Ledger slots left before pins would starve creates.  The C
+        ledger is shared by held pins AND unsealed creates
+        (rt_store_max_pins slots per client), so both count."""
+        with self._pin_lock:
+            return (
+                self._max_pins
+                - self._pins_outstanding
+                - len(self._created_views)
+            )
 
     def contains(self, object_id: bytes) -> bool:
         object_id = _check_id(object_id)
@@ -314,8 +340,12 @@ class ShmStore:
         return rc == RT_OK
 
     def _unpin(self, object_id: bytes) -> None:
-        if not self._closed:
-            self._lib.rt_store_unpin(self._h, object_id)
+        # under the lock: a finalizer-thread unpin racing close() must
+        # not reach the C handle after rt_store_detach munmaps it
+        with self._pin_lock:
+            self._pins_outstanding -= 1
+            if not self._closed:
+                self._lib.rt_store_unpin(self._h, object_id)
 
     # -- admin -----------------------------------------------------------
     @property
@@ -367,18 +397,27 @@ class ShmStore:
     def close(self) -> None:
         if self._closed:
             return
-        # Force-release outstanding pins and unsealed create views so the
-        # mmap can close; the C side additionally reclaims everything via
-        # the client ledger on detach.
-        for pin in list(self._live_pins):
-            pin.release()
+        # Outstanding pins back zero-copy get() views the USER still
+        # holds — do not force-release them; their owners' GC will (and
+        # after _closed is set, their _unpin becomes a no-op).  Plasma
+        # has the same contract: buffers read after client disconnect
+        # are valid only until another attached client reuses the range
+        # (a standalone shutdown tears the whole store down, so the
+        # common case stays safe).
         for v in self._created_views.values():
             v.release()
         self._created_views.clear()
-        self._mv.release()
-        self._mm.close()
-        self._lib.rt_store_detach(self._h)
-        self._closed = True
+        try:
+            self._mv.release()
+            self._mm.close()
+        except BufferError:
+            # live zero-copy views export the map; it must outlive them.
+            # Leave it to process teardown — detaching the client ledger
+            # below is what releases store-side state.
+            pass
+        with self._pin_lock:
+            self._closed = True
+            self._lib.rt_store_detach(self._h)
 
     def destroy(self) -> None:
         self.close()

@@ -81,6 +81,9 @@ D("timeline_max_events", int, 10_000)
 D("object_store_bytes", int, 0)  # 0 = auto (30% of /dev/shm free, capped)
 D("object_store_auto_cap_bytes", int, 8 * 1024 * 1024 * 1024)
 D("inline_object_max_bytes", int, 100 * 1024)  # small results ride the RPC reply
+# get() of a shm object this large deserializes zero-copy off the arena
+# (pinned, read-only views) instead of copying out (plasma mmap-read role)
+D("zerocopy_get_min_bytes", int, 1024 * 1024)
 D("object_chunk_bytes", int, 16 * 1024 * 1024)  # node-to-node transfer chunk
 
 # --- pip runtime envs (reference: runtime_env/pip.py role)

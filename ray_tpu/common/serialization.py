@@ -224,7 +224,19 @@ class SerializationContext:
         pickler.dump(obj)
         return SerializedObject(meta_io.getvalue(), buffers)
 
-    def deserialize(self, data: memoryview | bytes) -> Any:
+    def deserialize(
+        self, data: memoryview | bytes, owner: Any = None
+    ) -> Any:
+        """Reconstruct an object; out-of-band buffers come back as views
+        into ``data``.
+
+        With ``owner`` set (the shm-store zero-copy path), every
+        out-of-band buffer is wrapped in an :class:`_OwnedBuffer` that
+        keeps ``owner`` (a PinnedBuffer) alive through the consumer's
+        base chain — e.g. an ndarray's ``.base`` — so the store cannot
+        evict or reuse the range while any deserialized view survives
+        (ray: plasma client pins mapped objects until the last Buffer
+        is destructed, plasma/client.cc)."""
         mv = memoryview(data)
         off = 0
         (meta_len,) = _HEADER.unpack_from(mv, off)
@@ -233,14 +245,38 @@ class SerializationContext:
         off += meta_len
         (nbufs,) = _HEADER.unpack_from(mv, off)
         off += _HEADER.size
-        buffers = []
+        buffers: List[Any] = []
         for _ in range(nbufs):
             (blen,) = _BUFHDR.unpack_from(mv, off)
             off += _BUFHDR.size
-            buffers.append(mv[off : off + blen])
+            b = mv[off : off + blen]
+            buffers.append(b if owner is None else _OwnedBuffer(b, owner))
             off += blen
         return pickle.loads(bytes(meta) if isinstance(meta, memoryview) else meta,
                             buffers=buffers)
+
+
+class _OwnedBuffer:
+    """A buffer-protocol view that keeps an owner object alive.
+
+    memoryview slices reference the bottom exporter (the arena mmap),
+    not the pin that blocks eviction — so zero-copy deserialization
+    routes buffers through this wrapper instead (PEP 688 ``__buffer__``,
+    Python ≥3.12).  A consumer such as ``np.frombuffer`` records the
+    wrapper as ``.base``, chaining the pin to the array's lifetime.
+    """
+
+    __slots__ = ("_view", "_owner")
+
+    def __init__(self, view: memoryview, owner: Any):
+        self._view = view
+        self._owner = owner
+
+    def __buffer__(self, flags):
+        return memoryview(self._view)
+
+    def __len__(self):
+        return self._view.nbytes
 
 
 _default_context: Optional[SerializationContext] = None

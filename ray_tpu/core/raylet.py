@@ -1134,7 +1134,14 @@ class Raylet:
 
     async def rpc_delete_objects(self, conn: rpc.Connection, p):
         for oid in p["object_ids"]:
-            self.store.delete(oid)
+            if not self.store.delete(oid):
+                # a reader still pins it (zero-copy get in some process):
+                # the delete is refused, and nothing ever retries it.
+                # Clear the primary bit so the entry becomes ordinary LRU
+                # prey the moment the last pin drops — a freed object
+                # must not stay resident as an undeletable protected
+                # primary for the life of the node.
+                self.store.protect(oid, on=False)
             self._drop_spill_file(oid)
         return True
 

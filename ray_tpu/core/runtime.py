@@ -162,6 +162,7 @@ class Runtime:
         self._thread.start()
 
         self.store = ShmStore(store_path)
+        self._zerocopy_threshold = cfg.zerocopy_get_min_bytes
         self.gcs: Optional[rpc.Connection] = None
         self.raylet: Optional[rpc.Connection] = None
 
@@ -978,7 +979,26 @@ class Runtime:
         pin = self.store.get(oid)
         if pin is None:
             return None, False
+        if (
+            pin.view.nbytes >= self._zerocopy_threshold
+            and self.store.pin_headroom() > 64
+        ):
+            # Zero-copy: deserialize straight off the arena; the pin's
+            # lifetime rides the returned object's buffer-base chain
+            # (serialization._OwnedBuffer), exactly plasma's mmap-read
+            # semantics.  Read-only so a caller can't scribble on shm.
+            # The pin is deliberately NOT released here — it unpins when
+            # the last deserialized view is garbage-collected.
+            return (
+                self._serialization.deserialize(
+                    pin.view.toreadonly(), owner=pin
+                ),
+                True,
+            )
         try:
+            # small objects (and pin-ledger pressure — many large results
+            # already held zero-copy): a copy is cheaper than holding a
+            # pin that blocks LRU eviction for the value's whole lifetime
             value = self._serialization.deserialize(bytes(pin.view))
         finally:
             pin.release()
@@ -1401,28 +1421,14 @@ class Runtime:
             if lease.conn.send_backlog > cfg.rpc_send_backlog_limit_bytes:
                 await lease.conn.drain()
             reply = await fut
-            span = None
-            if type(reply) is tuple:
-                if len(reply) > 2:  # ("i", payload, t0, t1)
-                    span = (reply[2], reply[3])
-            elif reply.get("exec_span"):
-                span = reply["exec_span"]
-            if span:
-                t0, t1 = span
-                self.record_event(
-                    "exec", task.spec["name"],
-                    task.spec["task_id"].hex(),
-                    worker=lease.worker_id.hex()
-                    if hasattr(lease.worker_id, "hex")
-                    else str(lease.worker_id),
-                    start=t0, dur=t1 - t0,
-                )
-            self._apply_task_reply(task, reply)
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
             # OSError included: the backlog drain() raises raw socket
             # errors (ConnectionResetError) on a mid-write worker death —
             # they must break the lease and retry/fail like any loss, not
-            # kill the dispatch task silently
+            # kill the dispatch task silently.  The catch covers ONLY
+            # the wire I/O: once a reply is in hand the task has
+            # executed, and a local failure applying it must not
+            # re-queue a task whose side effects already happened.
             lease.broken = True
             if task.retries_left > 0:
                 task.retries_left -= 1
@@ -1435,6 +1441,35 @@ class Runtime:
                         f"worker died while running {task.spec['name']}: "
                         f"{e}{detail}"
                     ),
+                )
+        else:
+            try:
+                span = None
+                if type(reply) is tuple:
+                    if len(reply) > 2:  # ("i", payload, t0, t1)
+                        span = (reply[2], reply[3])
+                elif reply.get("exec_span"):
+                    span = reply["exec_span"]
+                if span:
+                    t0, t1 = span
+                    self.record_event(
+                        "exec", task.spec["name"],
+                        task.spec["task_id"].hex(),
+                        worker=lease.worker_id.hex()
+                        if hasattr(lease.worker_id, "hex")
+                        else str(lease.worker_id),
+                        start=t0, dur=t1 - t0,
+                    )
+                self._apply_task_reply(task, reply)
+            except Exception as e:  # noqa: BLE001
+                # the task RAN; a local failure applying its reply (e.g.
+                # result deserialization needs a worker-only module) must
+                # fail the ObjectRef, not re-queue the side effects and
+                # not leave the caller hanging on a never-resolved ref
+                self._fail_task(
+                    task, TaskError.from_exception(
+                        e, f"applying reply of {task.spec['name']}"
+                    )
                 )
         finally:
             self._inflight_dispatch.pop(task.return_ids[0], None)

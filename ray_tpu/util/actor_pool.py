@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 import ray_tpu
+from ray_tpu.core.errors import GetTimeoutError
 
 
 class ActorPool:
@@ -47,21 +48,43 @@ class ActorPool:
         return bool(self._idle)
 
     def has_next(self) -> bool:
-        return self._next_return_index < self._next_task_index
+        return bool(self._index_to_future)
+
+    def _advance_cursor(self) -> None:
+        """Skip indices already consumed by get_next_unordered so the
+        ordered cursor always rests on a live (or future) index."""
+        while (
+            self._next_return_index < self._next_task_index
+            and self._next_return_index not in self._index_to_future
+        ):
+            self._next_return_index += 1
+
+    def _consume(self, idx: int, ref: Any) -> None:
+        """Retire a finished submission: drop both map entries, free the
+        actor, and re-align the ordered cursor."""
+        self._index_to_future.pop(idx, None)
+        _, actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        self._advance_cursor()
 
     # -- retrieval -------------------------------------------------------
     def get_next(self, timeout: Optional[float] = None) -> Any:
-        """Next result in SUBMISSION order."""
+        """Next result in SUBMISSION order.  On timeout the pool state is
+        untouched (the task keeps running, the actor stays busy) — call
+        again to keep waiting, matching the reference's ActorPool."""
+        self._advance_cursor()
         if not self.has_next():
             raise StopIteration("no pending results")
         idx = self._next_return_index
-        ref = self._index_to_future.pop(idx)
-        self._next_return_index += 1
+        ref = self._index_to_future[idx]
         try:
             value = ray_tpu.get(ref, timeout=timeout)
-        finally:
-            _, actor = self._future_to_actor.pop(ref)
-            self._idle.append(actor)
+        except GetTimeoutError:
+            raise  # still running: nothing consumed, actor still busy
+        except Exception:
+            self._consume(idx, ref)  # task errored: done, actor is free
+            raise
+        self._consume(idx, ref)
         return value
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
@@ -72,19 +95,14 @@ class ActorPool:
             list(self._future_to_actor), num_returns=1, timeout=timeout
         )
         if not ready:
-            raise TimeoutError("no result within timeout")
+            # same type get_next raises, so one handler covers both paths
+            raise GetTimeoutError("no result within timeout")
         ref = ready[0]
-        idx, actor = self._future_to_actor.pop(ref)
-        self._index_to_future.pop(idx, None)
-        # unordered consumption must not starve get_next: advance the
-        # ordered cursor past indices already consumed unordered
-        while (
-            self._next_return_index < self._next_task_index
-            and self._next_return_index not in self._index_to_future
-        ):
-            self._next_return_index += 1
-        self._idle.append(actor)
-        return ray_tpu.get(ref)
+        idx = self._future_to_actor[ref][0]
+        try:
+            return ray_tpu.get(ref)
+        finally:
+            self._consume(idx, ref)
 
     # -- bulk ------------------------------------------------------------
     def map(self, fn: Callable[[Any, Any], Any],

@@ -146,5 +146,17 @@ class Queue:
     def full(self) -> bool:
         return bool(self.maxsize) and self.qsize() >= self.maxsize
 
-    def shutdown(self, force: bool = False) -> None:
+    def shutdown(self, force: bool = False,
+                 grace_period_s: float = 30.0) -> None:
+        """Terminate the queue actor.  ``force=False`` first waits (up to
+        ``grace_period_s``) for a barrier call to clear the actor's
+        mailbox, so work already received executes before the kill;
+        ``force=True`` kills immediately, failing in-flight calls."""
+        if not force:
+            try:
+                ray_tpu.get(
+                    self.actor.qsize.remote(), timeout=grace_period_s
+                )
+            except Exception:
+                pass  # wedged or already dead: fall through to the kill
         ray_tpu.kill(self.actor)

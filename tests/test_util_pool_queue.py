@@ -75,6 +75,40 @@ class TestActorPool:
         assert out == [1, 2, 3, 4, 5]  # ONE actor served every value
 
 
+class TestActorPoolEdgeCases:
+    def test_unordered_then_ordered_mix(self, cluster):
+        """Draining some results unordered must not corrupt the ordered
+        cursor (has_next staying true / KeyError on get_next)."""
+        slow = Doubler.remote(0.8)
+        fast = Doubler.remote(0.0)
+        pool = ActorPool([slow, fast])
+        # idx 0 lands on 'fast' (pop from the right), idx 1 on 'slow'
+        pool.submit(lambda a, v: a.double.remote(v), 10)
+        pool.submit(lambda a, v: a.double.remote(v), 20)
+        first = pool.get_next_unordered(timeout=60)  # the fast one: 20
+        assert first == 20
+        assert pool.get_next(timeout=60) == 40  # ordered pick of idx 1
+        assert not pool.has_next()
+        with pytest.raises(StopIteration):
+            pool.get_next()
+        # pool still usable afterwards
+        pool.submit(lambda a, v: a.double.remote(v), 7)
+        assert pool.get_next(timeout=60) == 14
+
+    def test_get_next_timeout_keeps_state(self, cluster):
+        """A timed-out get_next must not discard the result or mark the
+        busy actor idle (reference ActorPool leaves state intact)."""
+        a = Doubler.remote(1.5)
+        pool = ActorPool([a])
+        pool.submit(lambda ac, v: ac.double.remote(v), 3)
+        with pytest.raises(ray_tpu.GetTimeoutError):
+            pool.get_next(timeout=0.1)
+        assert pool.has_next()
+        assert not pool.has_free()  # actor still busy, not reusable
+        assert pool.get_next(timeout=60) == 6  # result not lost
+        assert pool.has_free()
+
+
 class TestQueue:
     def test_fifo_put_get(self, cluster):
         q = Queue()

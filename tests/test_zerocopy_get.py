@@ -1,0 +1,94 @@
+"""Zero-copy shm get (plasma mmap-read role: ray object_manager/plasma/
+client.cc — get returns a pinned zero-copy buffer; arrays are read-only
+views until released).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.common.config import cfg
+
+
+@pytest.fixture
+def zc_cluster(monkeypatch):
+    monkeypatch.setenv("RT_ZEROCOPY_GET_MIN_BYTES", "1024")
+    cfg.reset()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    cfg.reset()
+
+
+def test_zero_copy_view_is_readonly_and_pinned(zc_cluster):
+    big = np.arange(4096, dtype=np.int64)
+    out = ray_tpu.get(ray_tpu.put(big))
+    assert np.array_equal(out, big)
+    assert not out.flags.writeable
+    with pytest.raises(ValueError):
+        out[0] = 1
+    # the base chain ends in the pin-owning wrapper, not a bytes copy
+    from ray_tpu.common.serialization import _OwnedBuffer
+
+    base = out
+    while getattr(base, "base", None) is not None:
+        base = base.base
+    assert isinstance(base, _OwnedBuffer)
+
+
+def test_pin_ledger_fallback_to_copy(zc_cluster):
+    """Holding more zero-copy results than the C pin ledger allows must
+    degrade to copy-out gets, not fail puts with TOO_MANY_PINS."""
+    held = []
+    for i in range(1100):
+        ref = ray_tpu.put(np.full(512, i, dtype=np.int64))  # 4 KB
+        held.append(ray_tpu.get(ref))
+        del ref
+    assert all(int(v[0]) == i for i, v in enumerate(held))
+    # late values came from the copy path (writable backing bytes are
+    # still readonly views — both paths produce readonly arrays), but a
+    # fresh put/get must still work with the ledger near-full
+    out = ray_tpu.get(ray_tpu.put(np.ones(512)))
+    assert out.sum() == 512
+
+
+def test_freed_while_pinned_becomes_evictable(zc_cluster):
+    """Deleting a freed object whose zero-copy view is still held must
+    unprotect it so the arena reclaims it after the view dies — not
+    leave it resident as an undeletable protected primary forever."""
+    import gc
+    import time
+
+    from ray_tpu.core import runtime as rt_mod
+
+    store = rt_mod._global_runtime.store
+
+    ref = ray_tpu.put(np.ones(1 << 20, dtype=np.uint8))
+    oid = ref.object_id.binary()
+    val = ray_tpu.get(ref)  # zero-copy: holds a pin on the entry
+    del ref  # refcount frees the object while the pin is live
+    gc.collect()
+    time.sleep(3.0)  # let the GCS free -> raylet delete (refused:
+    # pinned -> unprotect) land while the pin is still held
+    del val
+    gc.collect()  # last pin drops; entry now sealed + unpinned
+    time.sleep(0.2)
+    # if the bug were present the entry would now be protected+unpinned
+    # => a spill candidate forever; fixed behavior: unprotected => plain
+    # LRU prey, absent from the spillable list while still resident
+    assert store.contains(oid), "entry should still be resident (no pressure)"
+    assert oid not in {i for i, _ in store.list_spillable()}, (
+        "freed-while-pinned entry kept its protected bit: it would leak "
+        "as an undeletable protected primary"
+    )
+
+
+def test_values_survive_shutdown(monkeypatch):
+    monkeypatch.setenv("RT_ZEROCOPY_GET_MIN_BYTES", "1024")
+    cfg.reset()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    out = ray_tpu.get(ray_tpu.put(np.arange(8192, dtype=np.int64)))
+    ray_tpu.shutdown()
+    cfg.reset()
+    # the arena map outlives close() while views are exported
+    assert int(out[8191]) == 8191
