@@ -1,0 +1,271 @@
+"""KubeRayProvider + RestKubeApi against a stateful fake k8s API server.
+
+The real API server is unreachable from CI, so the client runs against
+a local HTTP server that (a) asserts auth + merge-patch headers on
+every request, (b) applies merge patches to an in-memory RtCluster CR,
+and (c) plays the OPERATOR: after each patch it reconciles pods to the
+declared replicas, honoring workersToDelete (reference analogue:
+batching_node_provider tests + the kuberay operator contract).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    NodeTypeConfig,
+)
+from ray_tpu.autoscaler.k8s_provider import (
+    GROUP,
+    KubeApiError,
+    KubeRayProvider,
+    RestKubeApi,
+    cr_path,
+)
+
+NS, NAME = "ml", "rtc"
+TOKEN = "sa-token-xyz"
+
+
+class FakeKube:
+    """In-memory RtCluster + pods, operator-reconciled."""
+
+    def __init__(self):
+        self.cr = {
+            "apiVersion": f"{GROUP}/v1",
+            "kind": "RtCluster",
+            "metadata": {"name": NAME, "namespace": NS},
+            "spec": {
+                "workerGroups": [
+                    {"name": "v5e-4", "replicas": 0, "workersToDelete": []},
+                    {"name": "cpu-small", "replicas": 1,
+                     "workersToDelete": []},
+                ]
+            },
+        }
+        self.pods = {}  # name -> pod dict
+        self._counter = 0
+        self.reconcile()
+
+    def merge_patch(self, body):
+        spec = body.get("spec", {})
+        if "workerGroups" in spec:
+            self.cr["spec"]["workerGroups"] = spec["workerGroups"]
+        self.reconcile()
+
+    def reconcile(self):
+        """The operator: delete named pods, then match replicas."""
+        for g in self.cr["spec"]["workerGroups"]:
+            for name in list(g.get("workersToDelete") or []):
+                if name in self.pods:
+                    del self.pods[name]
+                g["workersToDelete"].remove(name)
+            live = [
+                p for p in self.pods.values()
+                if p["metadata"]["labels"][f"{GROUP}/group"] == g["name"]
+            ]
+            want = int(g.get("replicas", 0))
+            while len(live) > want:  # unnamed scale-down: newest first
+                victim = live.pop()
+                del self.pods[victim["metadata"]["name"]]
+            while len(live) < want:
+                self._counter += 1
+                name = f"{NAME}-{g['name']}-{self._counter}"
+                pod = {
+                    "metadata": {
+                        "name": name,
+                        "labels": {
+                            f"{GROUP}/cluster": NAME,
+                            f"{GROUP}/group": g["name"],
+                        },
+                        "annotations": {
+                            f"{GROUP}/node-id": f"nid{self._counter:04d}"
+                        },
+                    },
+                    "status": {"phase": "Running"},
+                }
+                self.pods[name] = pod
+                live.append(pod)
+
+
+@pytest.fixture
+def kube_server():
+    state = FakeKube()
+    requests = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, status, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _check_auth(self):
+            assert self.headers["Authorization"] == f"Bearer {TOKEN}", (
+                "missing/bad bearer token"
+            )
+
+        def do_GET(self):
+            self._check_auth()
+            requests.append(("GET", self.path))
+            if self.path == cr_path(NS, NAME):
+                return self._reply(200, state.cr)
+            if self.path.startswith(f"/api/v1/namespaces/{NS}/pods"):
+                assert "labelSelector=" in self.path
+                return self._reply(200, {"items": list(state.pods.values())})
+            return self._reply(404, {"message": "not found"})
+
+        def do_PATCH(self):
+            self._check_auth()
+            assert (
+                self.headers["Content-Type"]
+                == "application/merge-patch+json"
+            ), "PATCH must be a JSON merge patch"
+            n = int(self.headers["Content-Length"])
+            raw = self.rfile.read(n)
+            body = json.loads(raw)
+            # record an independent copy: merge_patch adopts `body` and
+            # the operator mutates it (clearing workersToDelete)
+            requests.append(("PATCH", self.path, json.loads(raw)))
+            if self.path != cr_path(NS, NAME):
+                return self._reply(404, {"message": "not found"})
+            state.merge_patch(body)
+            return self._reply(200, state.cr)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield state, requests, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+@pytest.fixture
+def provider(kube_server):
+    state, requests, url = kube_server
+    api = RestKubeApi(base_url=url, token_fn=lambda: TOKEN)
+    return KubeRayProvider(api, NS, NAME), state, requests
+
+
+def test_initial_state_reports_existing_pods(provider):
+    prov, state, _ = provider
+    nodes = prov.non_terminated_nodes()
+    assert len(nodes) == 1  # cpu-small min replica, operator-made
+    assert nodes[0].node_type == "cpu-small"
+    assert nodes[0].node_id_hex == "nid0001"
+
+
+def test_scale_up_via_replicas_patch(provider):
+    prov, state, requests = provider
+    pn = prov.create_node("v5e-4", {"TPU": 4}, {})
+    assert pn.meta.get("pending")
+    # exactly one declarative write happened, and it set replicas=1
+    patches = [r for r in requests if r[0] == "PATCH"]
+    assert len(patches) == 1
+    groups = patches[0][2]["spec"]["workerGroups"]
+    assert {g["name"]: g["replicas"] for g in groups} == {
+        "v5e-4": 1, "cpu-small": 1,
+    }
+    nodes = prov.non_terminated_nodes()
+    v5 = [n for n in nodes if n.node_type == "v5e-4"]
+    assert len(v5) == 1 and not v5[0].meta.get("pending")
+
+
+def test_scale_down_names_the_victim(provider):
+    prov, state, requests = provider
+    prov.create_node("v5e-4", {"TPU": 4}, {})
+    prov.create_node("v5e-4", {"TPU": 4}, {})
+    nodes = [
+        n for n in prov.non_terminated_nodes() if n.node_type == "v5e-4"
+    ]
+    assert len(nodes) == 2
+    victim = nodes[0]
+    prov.terminate_node(victim)
+    # the patch named the pod AND dropped replicas in one write
+    last = [r for r in requests if r[0] == "PATCH"][-1]
+    g = next(
+        g for g in last[2]["spec"]["workerGroups"] if g["name"] == "v5e-4"
+    )
+    assert g["replicas"] == 1
+    assert g["workersToDelete"] == [victim.provider_id]
+    survivors = [
+        n.provider_id
+        for n in prov.non_terminated_nodes()
+        if n.node_type == "v5e-4"
+    ]
+    assert survivors == [nodes[1].provider_id]  # the OTHER pod survived
+
+
+def test_pending_placeholders_count_as_supply(kube_server):
+    state, requests, url = kube_server
+
+    class LazyOperator(FakeKube):
+        pass
+
+    # freeze the operator: patches apply but no pods manifest
+    state.reconcile = lambda: None
+    api = RestKubeApi(base_url=url, token_fn=lambda: TOKEN)
+    prov = KubeRayProvider(api, NS, NAME)
+    prov.create_node("v5e-4", {"TPU": 4}, {})
+    nodes = [
+        n for n in prov.non_terminated_nodes() if n.node_type == "v5e-4"
+    ]
+    assert len(nodes) == 1 and nodes[0].meta.get("pending")
+
+
+def test_unknown_group_and_bad_path(provider):
+    prov, state, _ = provider
+    with pytest.raises(KeyError):
+        prov.create_node("no-such-group", {}, {})
+    api = prov.api
+    with pytest.raises(KubeApiError) as ei:
+        api.get("/apis/ray-tpu.io/v1/namespaces/ml/rtclusters/other")
+    assert ei.value.status == 404
+
+
+def test_autoscaler_drives_k8s_provider(provider):
+    """The generic reconcile loop scales an RtCluster from GCS demand:
+    unmet demand -> replicas patch; pods appear; supply is counted."""
+    import asyncio
+
+    prov, state, _ = provider
+
+    class StubGcs:
+        async def call(self, m, p):
+            return {
+                "nodes": [],
+                "pending_leases": [{"demand": {"TPU": 4.0}}],
+                "pending_pg_bundles": [],
+            }
+
+    a = Autoscaler(
+        "unused",
+        prov,
+        AutoscalerConfig(
+            node_types=[
+                NodeTypeConfig("v5e-4", {"CPU": 4, "TPU": 4}, 0, 4),
+                NodeTypeConfig("cpu-small", {"CPU": 4}, 1, 4),
+            ]
+        ),
+    )
+    a.gcs = StubGcs()
+    asyncio.run(a.reconcile())
+    pods = [
+        n for n in prov.non_terminated_nodes() if n.node_type == "v5e-4"
+    ]
+    assert len(pods) == 1  # demand satisfied with one slice pod
+    # second pass: pending/live supply absorbs the same demand — no
+    # duplicate launch
+    asyncio.run(a.reconcile())
+    pods = [
+        n for n in prov.non_terminated_nodes() if n.node_type == "v5e-4"
+    ]
+    assert len(pods) == 1
