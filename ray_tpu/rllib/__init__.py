@@ -28,8 +28,11 @@ from ray_tpu.rllib.offline import (  # noqa: F401
     BC,
     BCConfig,
     JsonEpisodeReader,
+    TransitionReader,
     record_episodes,
 )
+from ray_tpu.rllib.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rllib.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae  # noqa: F401
 from ray_tpu.rllib import connectors  # noqa: F401
 

@@ -1,0 +1,179 @@
+"""CQL: conservative Q-learning from offline transitions.
+
+Role-equivalent of ray: rllib/algorithms/cql/ (CQLConfig, CQL,
+cql_learner's conservative loss) in its DISCRETE form on the jax
+stack: a double-DQN TD backup over the offline transition dataset plus
+the conservative regularizer alpha * E[logsumexp_a Q(s,a) - Q(s,a_data)],
+which pushes down out-of-distribution action values so the greedy
+policy stays inside the dataset's support.  (The reference builds CQL
+on SAC for continuous control; the regularizer — the algorithm's
+substance — is identical.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.rllib import core
+from ray_tpu.rllib.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    build_module_config,
+    probe_env_spaces,
+)
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner_group import Learner
+from ray_tpu.rllib.offline import TransitionReader
+
+
+@dataclasses.dataclass
+class CQLConfig(AlgorithmConfig):
+    lr: float = 3e-4
+    gamma: float = 0.99
+    cql_alpha: float = 1.0       # conservative-penalty weight
+    double_q: bool = True
+    target_update_freq: int = 100  # gradient steps between target syncs
+    train_batch_size: int = 256
+    updates_per_iteration: int = 100
+    hidden: tuple = (64, 64)
+    input_paths: Optional[Sequence[str]] = None
+    evaluation_num_steps: int = 200
+
+    def offline_data(self, input_paths) -> "CQLConfig":
+        return dataclasses.replace(self, input_paths=input_paths)
+
+
+class CQLLearner(Learner):
+    """TD + conservative penalty; target params ride inside the batch
+    (the dqn.py convention, so the jitted loss stays pure)."""
+
+    def __init__(self, config: CQLConfig, module_config):
+        import jax
+        import optax
+
+        self.config = config
+        self.module_config = module_config
+        self._fwd = core.get_forward(module_config)
+        self.params = core.module_init(
+            jax.random.key(config.seed), module_config
+        )
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.grad_steps = 0
+        self._init_jit()
+
+    def _loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        q_all, _ = self._fwd(params, batch["obs"])
+        a = batch["actions"][:, None].astype(jnp.int32)
+        q_data = jnp.take_along_axis(q_all, a, axis=1)[:, 0]
+
+        q_next_t, _ = self._fwd(batch["target_params"], batch["next_obs"])
+        if c.double_q:
+            q_next_online, _ = self._fwd(params, batch["next_obs"])
+            best = jnp.argmax(q_next_online, axis=-1)
+        else:
+            best = jnp.argmax(q_next_t, axis=-1)
+        q_next = jnp.take_along_axis(q_next_t, best[:, None], axis=1)[:, 0]
+        target = jax.lax.stop_gradient(
+            batch["rewards"] + c.gamma * (1.0 - batch["dones"]) * q_next
+        )
+        td = q_data - target
+        td_loss = jnp.where(
+            jnp.abs(td) < 1.0, 0.5 * td ** 2, jnp.abs(td) - 0.5
+        ).mean()  # huber
+
+        # the conservative term: soft-max over ALL actions minus the
+        # dataset action's value — OOD actions get pushed down
+        cql_term = (
+            jax.scipy.special.logsumexp(q_all, axis=-1) - q_data
+        ).mean()
+        loss = td_loss + c.cql_alpha * cql_term
+        return loss, {
+            "td_loss": td_loss,
+            "cql_loss": cql_term,
+            "total_loss": loss,
+            "q_data_mean": q_data.mean(),
+        }
+
+    def update(self, batch) -> Dict[str, float]:
+        import jax
+
+        stats = super().update(
+            dict(batch, target_params=self.target_params)
+        )
+        self.grad_steps += 1
+        if self.grad_steps % self.config.target_update_freq == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return stats
+
+
+class CQL(Algorithm):
+    def _setup(self, config: CQLConfig):
+        assert config.input_paths, "CQLConfig.offline_data(paths) is required"
+        spaces = probe_env_spaces(config.env, config.env_to_module)
+        self.module_config = build_module_config(config, spaces)
+        self.reader = TransitionReader(
+            config.input_paths, gamma=config.gamma,
+            env_to_module_fn=config.env_to_module,
+        )
+        self.learner = CQLLearner(config, self.module_config)
+        self.env_runner_group = EnvRunnerGroup(
+            config.env,
+            self.module_config,
+            num_runners=max(1, config.num_env_runners),
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed,
+            env_to_module_fn=config.env_to_module,
+        )
+        self._np_rng = np.random.default_rng(config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.monotonic()
+        losses: List[float] = []
+        for _ in range(c.updates_per_iteration):
+            batch = self.reader.sample(c.train_batch_size, self._np_rng)
+            stats = self.learner.update(batch)
+            losses.append(float(stats["total_loss"]))
+        learn_time = time.monotonic() - t0
+        # greedy rollout of the learned Q policy (epsilon 0)
+        self.env_runner_group.sync_weights(self.learner.params)
+        frags = self.env_runner_group.sample(
+            c.evaluation_num_steps, epsilon=0.0
+        )
+        ep_returns = np.concatenate(
+            [f["episode_returns"] for f in frags]
+        ) if frags else np.zeros(0)
+        self._record_returns(ep_returns)
+        return {
+            "total_loss": float(np.mean(losses)),
+            "num_offline_samples": len(self.reader),
+            "learn_time_s": learn_time,
+            "episodes_this_iter": len(ep_returns),
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "params": self.learner.params,
+            "target_params": self.learner.target_params,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.learner.params = state["params"]
+        self.learner.target_params = state["target_params"]
+        self.env_runner_group.sync_weights(self.learner.params)
+
+    def stop(self) -> None:
+        self.env_runner_group.stop()
+
+
+CQLConfig.algo_class = CQL

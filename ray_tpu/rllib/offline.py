@@ -71,16 +71,32 @@ def record_episodes(
     }
 
 
+def _iter_episodes(paths, env_to_module_fn=None):
+    """Yield (obs_array, actions, rewards) per JSONL episode, replaying
+    a FRESH connector pipeline per episode when given — exactly the
+    transform an online EnvRunner would apply, so offline learners see
+    the same input distribution the learned policy will see live.
+    Shared by both readers (episode-shaped and transition-shaped)."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    for p in paths:
+        with open(str(p)) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                ep = json.loads(line)
+                ep_obs = np.asarray(ep["obs"], np.float32)
+                if env_to_module_fn is not None:
+                    pipeline = env_to_module_fn()
+                    ep_obs = np.concatenate(
+                        [pipeline(step[None, ...]) for step in ep_obs]
+                    )
+                yield ep_obs, ep["actions"], ep.get("rewards", [])
+
+
 class JsonEpisodeReader:
     """Read JSONL episode files into flat (obs, action) arrays
-    (ray: rllib/offline/json_reader.py JsonReader).
-
-    `env_to_module_fn` (a connector Pipeline factory) replays each
-    episode through a FRESH pipeline instance, one step at a time —
-    exactly the transform an online EnvRunner would apply — so a
-    BC learner trained on this data sees the same input distribution
-    the cloned policy will see at evaluation time.
-    """
+    (ray: rllib/offline/json_reader.py JsonReader)."""
 
     def __init__(self, paths: Sequence[str], env_to_module_fn=None):
         if isinstance(paths, (str, os.PathLike)):
@@ -90,22 +106,13 @@ class JsonEpisodeReader:
         self.num_episodes = 0
         self.mean_return = 0.0
         total_ret = 0.0
-        for p in self.paths:
-            with open(p) as f:
-                for line in f:
-                    if not line.strip():
-                        continue
-                    ep = json.loads(line)
-                    ep_obs = np.asarray(ep["obs"], np.float32)
-                    if env_to_module_fn is not None:
-                        pipeline = env_to_module_fn()
-                        ep_obs = np.concatenate(
-                            [pipeline(step[None, ...]) for step in ep_obs]
-                        )
-                    obs.append(ep_obs)
-                    acts.extend(ep["actions"])
-                    total_ret += sum(ep.get("rewards", []))
-                    self.num_episodes += 1
+        for ep_obs, actions, rewards in _iter_episodes(
+            self.paths, env_to_module_fn
+        ):
+            obs.append(ep_obs)
+            acts.extend(actions)
+            total_ret += sum(rewards)
+            self.num_episodes += 1
         if not obs:
             raise ValueError(f"no episodes found in {self.paths}")
         self.obs = np.concatenate(obs).astype(np.float32)
@@ -121,6 +128,63 @@ class JsonEpisodeReader:
         for i in range(0, len(idx) - batch_size + 1, batch_size):
             sel = idx[i:i + batch_size]
             yield {"obs": self.obs[sel], "actions": self.actions[sel]}
+
+
+class TransitionReader:
+    """Read JSONL episodes into flat (s, a, r, s', done, return-to-go)
+    transition arrays — the sample shape value-based offline learners
+    (CQL) and advantage-weighted ones (MARWIL) train on (ray:
+    rllib/offline/json_reader.py transition batches role).
+
+    ``next_obs`` of an episode's last step repeats its own obs with
+    done=1 — the done mask kills the bootstrap, so the value never
+    matters.  ``returns`` are discounted returns-to-go per step.
+    """
+
+    def __init__(self, paths: Sequence[str], gamma: float = 0.99,
+                 env_to_module_fn=None):
+        obs_l, act_l, rew_l, nxt_l, done_l, ret_l = [], [], [], [], [], []
+        self.num_episodes = 0
+        for o, actions, rewards in _iter_episodes(paths, env_to_module_fn):
+            r = np.asarray(rewards, np.float32)
+            T = len(r)
+            ret = np.zeros(T, np.float32)
+            acc = 0.0
+            for t in range(T - 1, -1, -1):
+                acc = r[t] + gamma * acc
+                ret[t] = acc
+            done = np.zeros(T, np.float32)
+            done[-1] = 1.0
+            obs_l.append(o)
+            nxt_l.append(np.concatenate([o[1:], o[-1:]]))
+            act_l.extend(actions)
+            rew_l.append(r)
+            done_l.append(done)
+            ret_l.append(ret)
+            self.num_episodes += 1
+        if not obs_l:
+            raise ValueError(f"no episodes found in {paths!r}")
+        self.obs = np.concatenate(obs_l)
+        self.actions = np.asarray(act_l, np.int32)
+        self.rewards = np.concatenate(rew_l)
+        self.next_obs = np.concatenate(nxt_l)
+        self.dones = np.concatenate(done_l)
+        self.returns = np.concatenate(ret_l)
+
+    def __len__(self) -> int:
+        return len(self.actions)
+
+    def sample(self, batch_size: int, rng: np.random.Generator,
+               ) -> Dict[str, np.ndarray]:
+        sel = rng.integers(0, len(self.actions), size=batch_size)
+        return {
+            "obs": self.obs[sel],
+            "actions": self.actions[sel],
+            "rewards": self.rewards[sel],
+            "next_obs": self.next_obs[sel],
+            "dones": self.dones[sel],
+            "returns": self.returns[sel],
+        }
 
 
 # ---------------------------------------------------------------------------
