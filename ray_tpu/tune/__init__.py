@@ -14,6 +14,7 @@ from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401

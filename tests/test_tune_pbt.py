@@ -12,7 +12,13 @@ import pytest
 import ray_tpu
 from ray_tpu import tune
 from ray_tpu.train import session as train_session
-from ray_tpu.tune.schedulers import CONTINUE, RESTART, PopulationBasedTraining
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    PB2,
+    RESTART,
+    PopulationBasedTraining,
+    _gp_posterior,
+)
 
 
 class _FakeTrial:
@@ -149,3 +155,105 @@ class TestPBTEndToEnd:
         # iterations give 8 perturbation windows, so a loaded host that
         # reorders early reports still exploits well before the end)
         assert scores[0] > 10, scores
+
+
+class TestPB2:
+    """PB2: GP-UCB explore (ray: tune/schedulers/pb2.py role)."""
+
+    def _pb2(self, **kw):
+        kw.setdefault("metric", "score")
+        kw.setdefault("mode", "max")
+        kw.setdefault("perturbation_interval", 1)
+        kw.setdefault("hyperparam_bounds", {"lr": (0.0, 1.0)})
+        kw.setdefault("seed", 0)
+        return PB2(**kw)
+
+    def test_gp_posterior_recovers_optimum(self):
+        """UCB argmax over a GP fit to y = 1 - (x - 0.6)^2 lands near
+        0.6 — the numerics the scheduler rides on."""
+        rng = np.random.default_rng(0)
+        X = rng.random((40, 1))
+        y = 1.0 - (X[:, 0] - 0.6) ** 2 + rng.normal(0, 0.01, 40)
+        Xq = np.linspace(0, 1, 201)[:, None]
+        mu, sigma = _gp_posterior(X, (y - y.mean()) / y.std(), Xq)
+        best = float(Xq[int(np.argmax(mu + 0.1 * sigma)), 0])
+        assert abs(best - 0.6) < 0.1, best
+        assert sigma.shape == mu.shape and np.all(sigma >= 0)
+
+    def test_cold_start_resamples_within_bounds(self):
+        pb2 = self._pb2()
+        trials = [
+            _FakeTrial(i, {"lr": 0.9}, checkpoint=f"ck{i}")
+            for i in "abcd"
+        ]
+        pb2.set_trials(trials)
+        out = pb2._explore({"lr": 0.9})
+        assert 0.0 <= out["lr"] <= 1.0
+
+    def test_explore_moves_toward_observed_optimum(self):
+        """Feed the population's reports where improvement peaks at
+        lr=0.5: the GP explore must propose lr near 0.5, not a random
+        or x1.2-perturbed value."""
+        pb2 = self._pb2(perturbation_interval=100)  # collect only
+        lrs = [0.05, 0.3, 0.5, 0.7, 0.95]
+        trials = [
+            _FakeTrial(f"t{i}", {"lr": lr}, checkpoint=f"ck{i}")
+            for i, lr in enumerate(lrs)
+        ]
+        pb2.set_trials(trials)
+        for step in range(1, 9):
+            for t in trials:
+                lr = t.config["lr"]
+                gain = 1.0 - 4.0 * (lr - 0.5) ** 2  # best at 0.5
+                pb2.on_trial_result(
+                    t.trial_id,
+                    {"score": step * gain, "training_iteration": step},
+                )
+        picks = [pb2._explore({"lr": 0.9})["lr"] for _ in range(5)]
+        assert all(0.0 <= p <= 1.0 for p in picks)
+        assert np.mean([abs(p - 0.5) for p in picks]) < 0.2, picks
+
+    def test_int_hyperparams_stay_int(self):
+        pb2 = self._pb2(hyperparam_bounds={"batch": (8.0, 128.0)})
+        out = pb2._explore({"batch": 32})
+        assert isinstance(out["batch"], int)
+        assert 8 <= out["batch"] <= 128
+
+    def test_bottom_trial_exploits_with_gp_explore(self):
+        pb2 = self._pb2()
+        trials = [
+            _FakeTrial("good", {"lr": 0.5}, checkpoint="good_ck"),
+            _FakeTrial("mid1", {"lr": 0.3}, checkpoint="m1"),
+            _FakeTrial("mid2", {"lr": 0.7}, checkpoint="m2"),
+            _FakeTrial("bad", {"lr": 0.99}, checkpoint="bad_ck"),
+        ]
+        pb2.set_trials(trials)
+        for tid, s in (("good", 100), ("mid1", 50), ("mid2", 40)):
+            pb2.on_trial_result(tid, {"score": s, "training_iteration": 1})
+        decision = pb2.on_trial_result(
+            "bad", {"score": 1, "training_iteration": 1}
+        )
+        assert decision == RESTART
+        assert trials[3].checkpoint == "good_ck"
+        assert 0.0 <= trials[3].config["lr"] <= 1.0
+
+    def test_restart_resets_gp_observation_chain(self):
+        """The score jump after exploiting a donor checkpoint must not
+        be recorded as an improvement for the trial's OLD hyperparams."""
+        pb2 = self._pb2()
+        trials = [
+            _FakeTrial("good", {"lr": 0.5}, checkpoint="good_ck"),
+            _FakeTrial("mid1", {"lr": 0.3}, checkpoint="m1"),
+            _FakeTrial("mid2", {"lr": 0.7}, checkpoint="m2"),
+            _FakeTrial("bad", {"lr": 0.99}, checkpoint="bad_ck"),
+        ]
+        pb2.set_trials(trials)
+        for tid, s in (("good", 100), ("mid1", 50), ("mid2", 40)):
+            pb2.on_trial_result(tid, {"score": s, "training_iteration": 1})
+        d = pb2.on_trial_result("bad", {"score": 1, "training_iteration": 1})
+        assert d == RESTART
+        n_before = len(pb2._y)
+        # post-restart report: huge jump from the cloned weights
+        pb2.on_trial_result("bad", {"score": 95, "training_iteration": 2})
+        # no improvement row was attributed to the old lr=0.99
+        assert len(pb2._y) == n_before
