@@ -841,9 +841,16 @@ class GcsServer:
         loop.create_task(self._cleanup_conn(conn))
 
     async def _cleanup_conn(self, conn: rpc.Connection):
-        # release leases held by a disconnected submitter
-        for lease_id in list(self._conn_leases.pop(conn, ())):
-            await self._release_lease(lease_id)
+        # Release leases held by a disconnected submitter.  kick=False +
+        # one kick at the end: a dead driver can hold tens of thousands
+        # of leases (scale tests hold 32k), and a kick per release is
+        # O(leases × kick) of synchronous event-loop work that starves
+        # every other RPC for minutes.
+        held = list(self._conn_leases.pop(conn, ()))
+        for lease_id in held:
+            await self._release_lease(lease_id, kick=False)
+        if held:
+            self._kick_pending()
         # node connection lost -> node death, unless the raylet already
         # re-registered over a NEWER connection (half-open TCP: the stale
         # server-side socket can outlive the replacement)
@@ -1814,6 +1821,26 @@ class GcsServer:
                         )
         return list(agg.values())
 
+    async def rpc_scheduler_stats(self, conn, p):
+        """O(1) control-plane counters (queue depth, leases, nodes,
+        actors, PGs) — the cheap probe for dashboards and scale tests;
+        get_autoscaler_state serializes the full pending list and is
+        O(queue), unusable at 1M queued."""
+        return {
+            "pending_leases": len(self.scheduler.pending),
+            "leases": len(self.leases),
+            "nodes": len(self.nodes),
+            "nodes_alive": sum(
+                1 for n in self.nodes.values()
+                if n.alive and n.conn is not None
+            ),
+            "actors": len(self.actors),
+            "placement_groups": sum(
+                1 for pg in self.placement_groups.values()
+                if pg.state != PG_REMOVED
+            ),
+        }
+
     async def rpc_get_autoscaler_state(self, conn, p):
         """Demand/usage view for the autoscaler's reconcile loop (ray:
         autoscaler/v2 GetClusterResourceState — scheduler.py:624)."""
@@ -2084,7 +2111,8 @@ class GcsServer:
         await self._release_lease(p["lease_id"], broken=p.get("broken", False))
         return True
 
-    async def _release_lease(self, lease_id: int, broken: bool = False):
+    async def _release_lease(self, lease_id: int, broken: bool = False,
+                             kick: bool = True):
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return
@@ -2122,7 +2150,8 @@ class GcsServer:
                 )
             except Exception:
                 pass
-        self._kick_pending()
+        if kick:
+            self._kick_pending()
 
     def _kick_pending(self):
         """Re-try queued placement groups and lease requests after

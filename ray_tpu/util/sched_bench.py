@@ -185,6 +185,115 @@ async def queued_task_backlog(clients: List, n_tasks: int):
     return wall
 
 
+async def queued_backlog_hold(address: str, clients: List, n_tasks: int,
+                              drain_n: int, submit_wave: int = 50_000):
+    """The 1M-queued-tasks envelope shape (reference: '1,000,000 queued
+    tasks supported on one node', release/benchmarks/README.md:30):
+    submit ``n_tasks`` lease requests far beyond capacity, verify the
+    scheduler HOLDS the backlog (depth via the O(1) scheduler_stats
+    probe) and stays interactive, drain ``drain_n`` grants measuring
+    the rate, then abandon the rest the way a dead driver would —
+    CLOSING the submitting connections, so the GCS releases held
+    leases and compacts the dead pending entries.  The passed clients
+    are closed and unusable afterwards; callers reconnect.
+
+    Returns (submit_wall_s, peak_depth, drain_wall_s, abandon_wall_s).
+    """
+    returned = 0
+    fill_done = asyncio.Event()  # holders park here until the drain phase
+    drained = asyncio.Event()
+    tasks: List[asyncio.Task] = []
+    loop = asyncio.get_running_loop()
+
+    async def one(i):
+        nonlocal returned
+        client = clients[i % len(clients)]
+        grant = await _lease_with_retry(client, {"CPU": 1.0}, timeout=7200)
+        # HOLD the grant during the fill phase: if grants recycled
+        # immediately, the whole backlog would drain concurrently with
+        # submission and the queue would never actually be ~1M deep
+        if not fill_done.is_set():
+            await fill_done.wait()
+        await client.call("return_lease", {"lease_id": grant["lease_id"]})
+        returned += 1
+        if returned >= drain_n:
+            drained.set()
+
+    # an independent probe conn: it must survive the abandon below
+    probe = await rpc.connect(address, name="backlog-probe")
+    peak_depth = 0
+
+    # Waves are PACED by observed ingest: an unpaced 1M-message flood
+    # swamps the GCS event loop's ready queue and even an O(1) stats
+    # probe waits out the whole backlog (observed: probe timeout at
+    # 120 s).  Submitting the next wave only once ~90% of what was sent
+    # is visible in the scheduler keeps the control plane responsive
+    # throughout — which is itself part of what this envelope proves.
+    t0 = time.perf_counter()
+    for start in range(0, n_tasks, submit_wave):
+        n_wave = min(submit_wave, n_tasks - start)
+        tasks.extend(
+            loop.create_task(one(start + j)) for j in range(n_wave)
+        )
+        submitted = start + n_wave
+        while True:
+            st = await probe.call("scheduler_stats", {}, timeout=600)
+            peak_depth = max(peak_depth, st["pending_leases"])
+            if st["pending_leases"] + st["leases"] >= submitted * 0.9:
+                break
+            await asyncio.sleep(1.0)
+    # settle: the 0.9 pacing exit counts ~capacity held leases, so the
+    # queue can still be forming; wait until ingest plateaus so
+    # peak_depth reflects the true held backlog (~n_tasks - capacity)
+    prev = -1
+    settle_deadline = time.monotonic() + 300
+    while time.monotonic() < settle_deadline:
+        st = await probe.call("scheduler_stats", {}, timeout=600)
+        peak_depth = max(peak_depth, st["pending_leases"])
+        depth = st["pending_leases"]
+        if depth >= n_tasks * 0.97 or depth == prev:
+            break
+        prev = depth
+        await asyncio.sleep(2.0)
+    submit_wall = time.perf_counter() - t0
+
+    # drain phase: holders release, freed capacity flows to the queue
+    t0 = time.perf_counter()
+    fill_done.set()
+    await drained.wait()
+    drain_wall = time.perf_counter() - t0
+
+    # abandon the undrained majority: cancel callers and close their
+    # connections (the dead-driver path — pending entries with closed
+    # conns compact; held grants release via _conn_leases), then wait
+    # until the queue is actually gone so the next storm starts clean
+    t0 = time.perf_counter()
+    for t in tasks:
+        if not t.done():
+            t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    await close_clients(clients)
+    # Best-effort recovery wait: tearing down ~1M abandoned requests
+    # wakes ~1M parked coroutines in BOTH processes on this one core —
+    # minutes of pure teardown.  Probe timeouts here are expected load
+    # signal, not failure; the caller's next storm (fresh connections)
+    # is the functional proof of recovery.
+    while time.perf_counter() - t0 < 900:
+        try:
+            st = await probe.call("scheduler_stats", {}, timeout=120)
+        except Exception:
+            if probe.closed:
+                break
+            await asyncio.sleep(5.0)
+            continue
+        if st["pending_leases"] < 1000 and st["leases"] < 1000:
+            break
+        await asyncio.sleep(2.0)
+    abandon_wall = time.perf_counter() - t0
+    await probe.close()
+    return submit_wall, peak_depth, drain_wall, abandon_wall
+
+
 async def actor_lifecycle_storm(clients: List, n_actors: int,
                                 concurrency: int):
     """register_actor → request_lease → actor_started for n_actors, then

@@ -112,6 +112,13 @@ def test_100_nodes_2k_lease_churn_latency(gcs_proc):
         await asyncio.gather(*(one_cycle(i) for i in range(N_LEASES)))
         wall = time.perf_counter() - t0
 
+        # O(1) stats probe (dashboards + deep-queue scale tests use it
+        # where get_autoscaler_state's O(queue) reply is unusable)
+        st = await client.call("scheduler_stats", {})
+        assert st["nodes"] == N_NODES and st["nodes_alive"] == N_NODES
+        assert st["pending_leases"] == 0  # churn fully drained
+        assert st["leases"] == 0
+
         # placement-group churn across the full node set
         pg_t0 = time.perf_counter()
         for i in range(100):
@@ -158,6 +165,91 @@ def test_100_nodes_2k_lease_churn_latency(gcs_proc):
 # utilization-bucket scheduler index + windowed pending-queue wakes;
 # before those, this tier was O(backlog) per freed lease and unrunnable.
 # ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(
+    os.environ.get("RT_SCALE_TIER3") != "1",
+    reason="tier 3 (reference's full published envelope: 2,000 nodes / "
+    "40k actors / 1M queued) runs ~10-20 min on a 1-core host; "
+    "set RT_SCALE_TIER3=1 — numbers recorded in BENCH.md",
+)
+def test_2k_nodes_1m_queued_40k_actors(tmp_path, monkeypatch):
+    """Reference envelope parity: 2,000 nodes, 1M queued tasks held +
+    partially drained, 40k actors through the FSM
+    (release/benchmarks/README.md:5-13)."""
+    from ray_tpu.util import sched_bench as sb
+
+    monkeypatch.setenv("RT_NODE_DEATH_TIMEOUT_S", "3600")
+    # queued entries must HOLD (not expire into client retries) for the
+    # backlog to be genuinely 1M deep on the server
+    monkeypatch.setenv("RT_SCHED_MAX_PENDING_LEASE_S", "7200")
+    proc, address = node_mod.start_gcs(str(tmp_path))
+    try:
+        meter = sb.GcsCpuMeter(proc.pid)
+
+        async def main():
+            out = {}
+            stubs, hb = await sb.start_fleet(address, 2000)
+            clients = await sb.connect_clients(address, 8)
+
+            t = time.perf_counter()
+            lats, wall = await sb.lease_churn(
+                clients, 20_000, concurrency=512
+            )
+            out["churn"] = {
+                "p50_ms": lats[len(lats) // 2] * 1e3,
+                "p95_ms": lats[int(len(lats) * 0.95)] * 1e3,
+                "rate": 20_000 / wall,
+            }
+
+            (out["submit_wall"], out["peak_depth"], out["drain_wall"],
+             out["abandon_wall"]) = await sb.queued_backlog_hold(
+                address, clients, 1_000_000, drain_n=50_000
+            )
+            # backlog_hold closed its clients (the dead-driver abandon
+            # path); the actor storm gets fresh connections
+            clients = await sb.connect_clients(address, 8)
+
+            reg_wall, kill_wall = await sb.actor_lifecycle_storm(
+                clients, 40_000, concurrency=512
+            )
+            out["actor_reg_rate"] = 40_000 / reg_wall
+            out["actor_kill_rate"] = 40_000 / kill_wall
+
+            # the GCS must still be interactive after the storm
+            t0 = time.perf_counter()
+            st = await clients[0].call("scheduler_stats", {}, timeout=60)
+            out["probe_ms"] = (time.perf_counter() - t0) * 1e3
+            out["nodes_alive"] = st["nodes_alive"]
+
+            await sb.close_clients(clients)
+            await sb.stop_fleet(stubs, hb)
+            return out
+
+        out = asyncio.run(main())
+        cpu = meter.sample()
+        print(
+            f"\n2k-node tier: churn p50={out['churn']['p50_ms']:.1f}ms "
+            f"p95={out['churn']['p95_ms']:.1f}ms "
+            f"rate={out['churn']['rate']:.0f}/s; "
+            f"1M tasks submitted in {out['submit_wall']:.0f}s, "
+            f"peak queue depth {out['peak_depth']}, "
+            f"50k drained in {out['drain_wall']:.0f}s, "
+            f"950k abandoned in {out['abandon_wall']:.0f}s; "
+            f"40k actors reg {out['actor_reg_rate']:.0f}/s "
+            f"kill {out['actor_kill_rate']:.0f}/s; "
+            f"post-storm stats probe {out['probe_ms']:.0f}ms, "
+            f"{out['nodes_alive']} nodes alive; "
+            f"GCS cpu {cpu['cpu_s']}s/{cpu['wall_s']}s "
+            f"({cpu['cpu_frac']:.0%})"
+        )
+        assert out["nodes_alive"] == 2000
+        assert out["peak_depth"] > 900_000, out["peak_depth"]
+        assert out["probe_ms"] < 5_000
+        assert out["actor_reg_rate"] > 200
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
 
 
 def test_1k_nodes_100k_queued_20k_actors_1k_pgs(tmp_path, monkeypatch):
