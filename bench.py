@@ -275,6 +275,10 @@ def bench_actor_calls_async(ray_tpu, duration_s=3.0, window=1000):
 
     a = Echo.remote()
     ray_tpu.get(a.ping.remote(), timeout=60)
+    # steady-state: one untimed window warms the worker, the connection
+    # buffers, and the allocator before the clock starts (ray_perf runs
+    # long enough that its ramp amortizes; a 3 s budget doesn't)
+    ray_tpu.get([a.ping.remote() for _ in range(window)], timeout=120)
     n = 0
     t0 = time.perf_counter()
     while True:
@@ -295,6 +299,10 @@ def bench_actor_calls_n_n(ray_tpu, duration_s=3.0, n_actors=8, window=200):
 
     actors = [Echo.options(num_cpus=0.1).remote() for _ in range(n_actors)]
     ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+    ray_tpu.get(  # untimed steady-state warmup round
+        [a.ping.remote() for a in actors for _ in range(window)],
+        timeout=120,
+    )
     n = 0
     t0 = time.perf_counter()
     while True:
@@ -326,6 +334,9 @@ def bench_tasks_async(ray_tpu, duration_s=3.0, window=1000):
         return b"ok"
 
     ray_tpu.get(noop.remote(), timeout=60)
+    ray_tpu.get(  # untimed steady-state warmup window (lease ramp-up)
+        [noop.remote() for _ in range(window)], timeout=120
+    )
     n = 0
     t0 = time.perf_counter()
     while True:

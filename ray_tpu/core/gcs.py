@@ -925,10 +925,17 @@ class GcsServer:
             locs.discard(node_id)
             if not locs:
                 del self.object_locations[oid]
-        # break leases on that node
+        # break leases on that node — kick=False + one kick after: a
+        # dense node (fractional-CPU actors) can hold thousands of
+        # leases, and a kick per release is the same O(leases × kick)
+        # event-loop starvation _cleanup_conn's batching eliminates
+        broke = 0
         for lease_id, lease in list(self.leases.items()):
             if lease.node_id == node_id:
-                await self._release_lease(lease_id, broken=True)
+                await self._release_lease(lease_id, broken=True, kick=False)
+                broke += 1
+        if broke:
+            self._kick_pending()
         # restart/kill actors that lived there
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (

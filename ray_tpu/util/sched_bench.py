@@ -230,6 +230,7 @@ async def queued_backlog_hold(address: str, clients: List, n_tasks: int,
     # is visible in the scheduler keeps the control plane responsive
     # throughout — which is itself part of what this envelope proves.
     t0 = time.perf_counter()
+    fill_deadline = time.monotonic() + 1800
     for start in range(0, n_tasks, submit_wave):
         n_wave = min(submit_wave, n_tasks - start)
         tasks.extend(
@@ -237,21 +238,31 @@ async def queued_backlog_hold(address: str, clients: List, n_tasks: int,
         )
         submitted = start + n_wave
         while True:
+            if time.monotonic() > fill_deadline:
+                raise RuntimeError(
+                    f"backlog fill stalled: {submitted} submitted but "
+                    "ingest plateaued below 90% (dropped client conn?)"
+                )
             st = await probe.call("scheduler_stats", {}, timeout=600)
             peak_depth = max(peak_depth, st["pending_leases"])
             if st["pending_leases"] + st["leases"] >= submitted * 0.9:
                 break
             await asyncio.sleep(1.0)
     # settle: the 0.9 pacing exit counts ~capacity held leases, so the
-    # queue can still be forming; wait until ingest plateaus so
-    # peak_depth reflects the true held backlog (~n_tasks - capacity)
-    prev = -1
+    # queue can still be forming; wait until ingest truly plateaus
+    # (3 identical samples ABOVE the 90% floor — a single repeat can be
+    # a momentarily busy GCS, not completion) so peak_depth reflects
+    # the held backlog (~n_tasks - capacity)
+    prev, repeats = -1, 0
     settle_deadline = time.monotonic() + 300
     while time.monotonic() < settle_deadline:
         st = await probe.call("scheduler_stats", {}, timeout=600)
         peak_depth = max(peak_depth, st["pending_leases"])
         depth = st["pending_leases"]
-        if depth >= n_tasks * 0.97 or depth == prev:
+        if depth >= n_tasks * 0.97:
+            break
+        repeats = repeats + 1 if depth == prev else 0
+        if repeats >= 3 and depth >= n_tasks * 0.9:
             break
         prev = depth
         await asyncio.sleep(2.0)
