@@ -1,0 +1,18 @@
+"""Numerics shared across model families."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def layernorm(x, scale, bias, eps: float = 1e-5):
+    """f32-accumulated LayerNorm returned in x.dtype (the single
+    implementation gpt2 and vit share)."""
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(
+        x.dtype
+    )

@@ -168,14 +168,7 @@ def init(rng, config: GPTConfig) -> Params:
     }
 
 
-def _layernorm(x, scale, bias):
-    x32 = x.astype(jnp.float32)
-    mu = x32.mean(-1, keepdims=True)
-    var = x32.var(-1, keepdims=True)
-    y = (x32 - mu) * lax.rsqrt(var + 1e-5)
-    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(
-        x.dtype
-    )
+from ray_tpu.models.common import layernorm as _layernorm  # noqa: E402
 
 
 def _attention(q, k, v, config: GPTConfig):

@@ -44,6 +44,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attention_impl: str = "dense"  # "dense" | "ring"
+    # sliding-window attention (Mistral-style): > 0 limits every query
+    # to the last `sliding_window` keys, in training AND in the cached
+    # decode paths.  0 = full causal.
+    sliding_window: int = 0
     remat: bool = True
     xent_chunk: int = 0
     scan_unroll: int = 1
@@ -59,6 +63,20 @@ class LlamaConfig:
 
     @staticmethod
     def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def mistral_7b(**kw) -> "LlamaConfig":
+        """Mistral-7B shape: GQA (8 KV heads) + 4096-token sliding
+        window over a 32k context."""
+        kw.setdefault("vocab_size", 32000)
+        kw.setdefault("max_seq_len", 32768)
+        kw.setdefault("num_layers", 32)
+        kw.setdefault("num_heads", 32)
+        kw.setdefault("num_kv_heads", 8)
+        kw.setdefault("embed_dim", 4096)
+        kw.setdefault("mlp_dim", 14336)
+        kw.setdefault("sliding_window", 4096)
         return LlamaConfig(**kw)
 
     @staticmethod
@@ -156,12 +174,17 @@ def _rope(x, positions, theta):
 
 def _attention(q, k, v, config: LlamaConfig):
     if config.attention_impl == "ring":
+        if config.sliding_window:
+            raise NotImplementedError(
+                "sliding_window with ring attention: window the KV ring "
+                "instead (sp shards already bound the lookback)"
+            )
         from ray_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v)
     from ray_tpu.ops.attention import dense_attention
 
-    return dense_attention(q, k, v)
+    return dense_attention(q, k, v, window=config.sliding_window)
 
 
 def _block(x, p, positions, config: LlamaConfig):
@@ -327,13 +350,30 @@ def _gen_step(params, padded, length, key, *, config, temperature):
 def init_cache(config: LlamaConfig, batch_size: int, max_len: int) -> Params:
     """Fixed-bucket KV cache: (L, B, max_len, KV, D) per tensor, bf16.
     Static shapes — one compiled prefill + one compiled decode step
-    serve any request up to max_len."""
+    serve any request up to max_len.
+
+    With ``sliding_window`` the cache is a ROLLING buffer (slot =
+    position mod max_len), so ``max_len`` can be as small as
+    ``window + max_prefill_chunk - 1`` regardless of how long decoding
+    runs — the Mistral memory win (8x at 32k context / 4k window).
+    Positions older than the window are overwritten in place; the
+    attention mask reconstructs each slot's position implicitly."""
     c = config
     shape = (c.num_layers, batch_size, max_len, c.num_kv_heads, c.head_dim)
     return {
         "k": jnp.zeros(shape, c.dtype),
         "v": jnp.zeros(shape, c.dtype),
     }
+
+
+def rolling_cache_len(config: LlamaConfig, prefill_chunk: int) -> int:
+    """Smallest safe rolling-cache length for unbounded windowed
+    decoding: ``window + prefill_chunk - 1`` slots guarantees a wrapped
+    write can only land on a position already outside every live
+    query's window (the Mistral memory bound — independent of how long
+    decoding runs)."""
+    assert config.sliding_window > 0, "rolling caches need sliding_window"
+    return config.sliding_window + max(1, prefill_chunk) - 1
 
 
 def _cached_attention(q, k_cache, v_cache, pos, config: LlamaConfig):
@@ -352,7 +392,15 @@ def _cached_attention(q, k_cache, v_cache, pos, config: LlamaConfig):
     # causal within the query block + bounded by pos overall
     q_pos = pos - (Sq - 1) + jnp.arange(Sq)  # absolute position per query
     t_idx = jnp.arange(T)
-    mask = t_idx[None, :] <= q_pos[:, None]  # (Sq, T)
+    if c.sliding_window:
+        # rolling buffer: slot s as seen by query q holds position
+        # q - ((q - s) mod T) — the newest position <= q congruent to
+        # s.  Valid iff non-negative and inside the window.  (Slot
+        # correctness needs T >= window + Sq - 1: see forward_cached.)
+        t_pos = q_pos[:, None] - ((q_pos[:, None] - t_idx[None, :]) % T)
+        mask = (t_pos >= 0) & (t_pos > q_pos[:, None] - c.sliding_window)
+    else:
+        mask = t_idx[None, :] <= q_pos[:, None]  # (Sq, T)
     scores = jnp.where(mask[None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
     return jnp.einsum("bhqt,bthd->bqhd", probs, v_cache)
@@ -374,12 +422,18 @@ def _block_cached(x, p, cache_k, cache_v, start, config: LlamaConfig):
         positions, c.rope_theta,
     )
     vv = jnp.einsum("bse,ekd->bskd", h, p["wv"].astype(c.dtype))
-    cache_k = lax.dynamic_update_slice(
-        cache_k, kk.astype(c.dtype), (0, start, 0, 0)
-    )
-    cache_v = lax.dynamic_update_slice(
-        cache_v, vv.astype(c.dtype), (0, start, 0, 0)
-    )
+    if c.sliding_window:
+        # rolling buffer: position t lives in slot t mod T
+        slots = (start + jnp.arange(Sq)) % cache_k.shape[1]
+        cache_k = cache_k.at[:, slots].set(kk.astype(c.dtype))
+        cache_v = cache_v.at[:, slots].set(vv.astype(c.dtype))
+    else:
+        cache_k = lax.dynamic_update_slice(
+            cache_k, kk.astype(c.dtype), (0, start, 0, 0)
+        )
+        cache_v = lax.dynamic_update_slice(
+            cache_v, vv.astype(c.dtype), (0, start, 0, 0)
+        )
     attn = _cached_attention(q, cache_k, cache_v, start + Sq - 1, c)
     x = x + jnp.einsum("bshd,hde->bse", attn, p["wo"].astype(c.dtype))
     h = _rmsnorm(x, p["mlp_norm"], c.rms_eps)
@@ -399,6 +453,18 @@ def forward_cached(params: Params, tokens, cache: Params, start,
     position of tokens[:, 0] (0 for prefill; prompt_len + i in decode) —
     a traced scalar, so one compile covers every step."""
     c = config
+    if c.sliding_window:
+        T, Sq = cache["k"].shape[2], tokens.shape[1]
+        # structural bound only: a chunk longer than the cache would
+        # self-overwrite within one write-set.  Whether WRAPPING (a
+        # position overwriting position-minus-T) is safe depends on how
+        # far the caller decodes: positions < T never wrap (generate_kv
+        # sizes exactly so), and truly rolling callers size via
+        # rolling_cache_len() so wrapped slots are always out-of-window.
+        assert Sq <= T, (
+            f"prefill chunk {Sq} exceeds cache length {T}; prefill long "
+            "prompts in chunks"
+        )
     x = params["tok_embed"].astype(c.dtype)[tokens]
 
     def body(carry, layer):
@@ -504,8 +570,10 @@ def _block_decode_rowwise(x, p, cache_k, cache_v, pos, config: LlamaConfig):
     )
     vv = jnp.einsum("bse,ekd->bskd", h, p["wv"].astype(c.dtype))
     rows = jnp.arange(B)
-    cache_k = cache_k.at[rows, pos].set(kk[:, 0].astype(c.dtype))
-    cache_v = cache_v.at[rows, pos].set(vv[:, 0].astype(c.dtype))
+    T = cache_k.shape[1]
+    slot = pos % T if c.sliding_window else pos  # rolling buffer slots
+    cache_k = cache_k.at[rows, slot].set(kk[:, 0].astype(c.dtype))
+    cache_v = cache_v.at[rows, slot].set(vv[:, 0].astype(c.dtype))
     # attention over each row's own prefix [0, pos[b]]
     k_all, v_all = cache_k, cache_v
     if c.q_per_kv > 1:
@@ -514,8 +582,13 @@ def _block_decode_rowwise(x, p, cache_k, cache_v, pos, config: LlamaConfig):
     scores = jnp.einsum(
         "bqhd,bthd->bhqt", q, k_all, preferred_element_type=jnp.float32
     ) / math.sqrt(c.head_dim)
-    t_idx = jnp.arange(cache_k.shape[1])
-    mask = t_idx[None, :] <= pos[:, None]  # (B, T)
+    t_idx = jnp.arange(T)
+    if c.sliding_window:
+        # rolling buffer: reconstruct each slot's position per row
+        t_pos = pos[:, None] - ((pos[:, None] - t_idx[None, :]) % T)
+        mask = (t_pos >= 0) & (t_pos > pos[:, None] - c.sliding_window)
+    else:
+        mask = t_idx[None, :] <= pos[:, None]  # (B, T)
     scores = jnp.where(mask[:, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
     attn = jnp.einsum("bhqt,bthd->bqhd", probs, v_all)

@@ -1,0 +1,244 @@
+"""Vision Transformer: image classification on the shared sharding rules.
+
+A non-LM model family for the train/data path (role parity: the
+reference's libraries are model-agnostic hosts — `ray:
+train/examples/pytorch/torch_fashion_mnist_example.py`,
+`rllib/models/torch/visionnet.py` are its vision touchpoints; here the
+family is first-class and TPU-native).  Design:
+
+- patchify = one einsum over non-overlapping patches (an MXU matmul,
+  not a conv — identical math for stride == kernel),
+- encoder blocks: pre-LN, BIDIRECTIONAL attention (no causal mask),
+  GELU MLP — parameters use the same logical axes as gpt2
+  ("embed"/"heads"/"kv"/"mlp"), so `parallel.sharding`'s rule table
+  shards it over dp/fsdp/tp with no new rules,
+- mean-pool over patch tokens → linear head (classes pad to 128 for
+  the MXU, like gpt2's vocab padding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.common import layernorm as _layernorm
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1024  # pad to a multiple of 128 for the MXU
+    channels: int = 3
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.channels * self.patch_size ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.mlp_ratio * self.embed_dim
+
+    @staticmethod
+    def vit_b16(**kw) -> "ViTConfig":
+        return ViTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("num_classes", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("embed_dim", 64)
+        return ViTConfig(**kw)
+
+
+def param_logical_axes(config: ViTConfig) -> Params:
+    """Same logical vocabulary as gpt2 → same sharding rule table."""
+    blk = {
+        "ln1_scale": ("layers", "embed"),
+        "ln1_bias": ("layers", "embed"),
+        "qkv_kernel": ("layers", "embed", "heads", "kv"),
+        "qkv_bias": ("layers", "heads", "kv"),
+        "proj_kernel": ("layers", "heads", "kv", "embed"),
+        "proj_bias": ("layers", "embed"),
+        "ln2_scale": ("layers", "embed"),
+        "ln2_bias": ("layers", "embed"),
+        "fc_kernel": ("layers", "embed", "mlp"),
+        "fc_bias": ("layers", "mlp"),
+        "out_kernel": ("layers", "mlp", "embed"),
+        "out_bias": ("layers", "embed"),
+    }
+    return {
+        "patch_kernel": (None, "embed"),  # (patch_dim, E)
+        "patch_bias": ("embed",),
+        "pos_embed": (None, "embed"),  # (num_patches, E)
+        "blocks": blk,
+        "lnf_scale": ("embed",),
+        "lnf_bias": ("embed",),
+        "head_kernel": ("embed", "vocab"),
+        "head_bias": ("vocab",),
+    }
+
+
+def init(rng, config: ViTConfig) -> Params:
+    c = config
+    dt = c.param_dtype
+    k = jax.random.split(rng, 8)
+    std = 0.02
+    resid_std = std / math.sqrt(2 * c.num_layers)
+    L, E, H, D, M = (c.num_layers, c.embed_dim, c.num_heads, c.head_dim,
+                     c.mlp_dim)
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dt)
+
+    blocks = {
+        "ln1_scale": jnp.ones((L, E), dt),
+        "ln1_bias": jnp.zeros((L, E), dt),
+        "qkv_kernel": norm(k[0], (L, E, 3 * H, D), std),
+        "qkv_bias": jnp.zeros((L, 3 * H, D), dt),
+        "proj_kernel": norm(k[1], (L, H, D, E), resid_std),
+        "proj_bias": jnp.zeros((L, E), dt),
+        "ln2_scale": jnp.ones((L, E), dt),
+        "ln2_bias": jnp.zeros((L, E), dt),
+        "fc_kernel": norm(k[2], (L, E, M), std),
+        "fc_bias": jnp.zeros((L, M), dt),
+        "out_kernel": norm(k[3], (L, M, E), resid_std),
+        "out_bias": jnp.zeros((L, E), dt),
+    }
+    return {
+        "patch_kernel": norm(k[4], (c.patch_dim, E), std),
+        "patch_bias": jnp.zeros((E,), dt),
+        "pos_embed": norm(k[5], (c.num_patches, E), 0.01),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((E,), dt),
+        "lnf_bias": jnp.zeros((E,), dt),
+        "head_kernel": norm(k[6], (E, c.num_classes), std),
+        "head_bias": jnp.zeros((c.num_classes,), dt),
+    }
+
+
+
+
+def patchify(images, config: ViTConfig):
+    """(B, H, W, C) → (B, num_patches, patch_dim): non-overlapping
+    patches, flattened — the subsequent matmul IS the patch-embed conv."""
+    B = images.shape[0]
+    P, S = config.patch_size, config.image_size
+    n = S // P
+    x = images.reshape(B, n, P, n, P, config.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, n, n, P, P, C)
+    return x.reshape(B, n * n, config.patch_dim)
+
+
+def _block(x, p, config: ViTConfig):
+    c = config
+    B, S, E = x.shape
+    H, D = c.num_heads, c.head_dim
+    h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = (
+        jnp.einsum("bse,ehd->bshd", h, p["qkv_kernel"].astype(c.dtype))
+        + p["qkv_bias"].astype(c.dtype)
+    )
+    q, k, v = jnp.split(qkv, 3, axis=2)  # (B, S, H, D) each
+
+    # bidirectional attention: every patch attends to every patch
+    q = q.transpose(0, 2, 1, 3) * (1.0 / math.sqrt(D))
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", probs, v).transpose(0, 2, 1, 3)
+
+    x = x + (
+        jnp.einsum("bshd,hde->bse", o, p["proj_kernel"].astype(c.dtype))
+        + p["proj_bias"].astype(c.dtype)
+    )
+    h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
+    h = jax.nn.gelu(
+        jnp.einsum("bse,em->bsm", h, p["fc_kernel"].astype(c.dtype))
+        + p["fc_bias"].astype(c.dtype),
+        approximate=True,
+    )
+    x = x + (
+        jnp.einsum("bsm,me->bse", h, p["out_kernel"].astype(c.dtype))
+        + p["out_bias"].astype(c.dtype)
+    )
+    return x
+
+
+def forward(params: Params, images, config: ViTConfig):
+    """images (B, H, W, C) float → logits (B, num_classes)."""
+    c = config
+    x = patchify(images.astype(c.dtype), c)
+    x = (
+        jnp.einsum("bsp,pe->bse", x, params["patch_kernel"].astype(c.dtype))
+        + params["patch_bias"].astype(c.dtype)
+        + params["pos_embed"].astype(c.dtype)[None]
+    )
+
+    blk = _block
+    if c.remat:
+        blk = jax.checkpoint(_block, static_argnums=(2,))
+
+    def body(carry, layer_params):
+        return blk(carry, layer_params, c), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+    pooled = x.mean(axis=1)  # mean-pool patch tokens
+    logits = (
+        pooled.astype(jnp.float32)
+        @ params["head_kernel"].astype(jnp.float32)
+        + params["head_bias"].astype(jnp.float32)
+    )
+    return logits
+
+
+def loss_fn(params: Params, batch, config: ViTConfig):
+    """Scalar cross-entropy (the spmd.compile_train_step contract).
+    batch: {"images": (B,H,W,C), "labels": (B,)}."""
+    logits = forward(params, batch["images"], config)
+    labels = batch["labels"].astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0].mean()
+
+
+def accuracy(params: Params, batch, config: ViTConfig):
+    logits = forward(params, batch["images"], config)
+    return (
+        jnp.argmax(logits, axis=-1) == batch["labels"].astype(jnp.int32)
+    ).mean()
+
+
+def num_params(config: ViTConfig) -> int:
+    return sum(
+        int(jnp.size(v))
+        for v in jax.tree_util.tree_leaves(init(jax.random.key(0), config))
+    )

@@ -12,11 +12,14 @@ import jax
 import jax.numpy as jnp
 
 
-def dense_attention(q, k, v, *, start_pos: int = 0):
+def dense_attention(q, k, v, *, start_pos: int = 0, window: int = 0):
     """Causal attention, f32 softmax.  q,k,v: (B, S, H, D).
 
     ``start_pos`` offsets query positions for decode-time use (queries
-    are a suffix of the key sequence).
+    are a suffix of the key sequence).  ``window`` > 0 limits each
+    query to the last ``window`` keys (Mistral-style sliding-window
+    attention: position t attends to (t-window, t]; memory-for-range
+    tradeoff long-context models use).
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -25,6 +28,9 @@ def dense_attention(q, k, v, *, start_pos: int = 0):
     ) / math.sqrt(D)
     q_pos = jnp.arange(Sq)[:, None] + start_pos
     k_pos = jnp.arange(Sk)[None, :]
-    scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+    mask = q_pos >= k_pos
+    if window > 0:
+        mask &= k_pos > q_pos - window
+    scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -250,19 +250,21 @@ async def queued_backlog_hold(address: str, clients: List, n_tasks: int,
             await asyncio.sleep(1.0)
     # settle: the 0.9 pacing exit counts ~capacity held leases, so the
     # queue can still be forming; wait until ingest truly plateaus
-    # (3 identical samples ABOVE the 90% floor — a single repeat can be
+    # (3 identical samples at the ingest floor — a single repeat can be
     # a momentarily busy GCS, not completion) so peak_depth reflects
-    # the held backlog (~n_tasks - capacity)
+    # the held backlog (~n_tasks - capacity).  The floor counts held
+    # leases too, or a small n_tasks against a big fleet (capacity >
+    # 10% of tasks) could never exit and would burn the whole deadline.
     prev, repeats = -1, 0
     settle_deadline = time.monotonic() + 300
     while time.monotonic() < settle_deadline:
         st = await probe.call("scheduler_stats", {}, timeout=600)
         peak_depth = max(peak_depth, st["pending_leases"])
         depth = st["pending_leases"]
-        if depth >= n_tasks * 0.97:
+        if depth + st["leases"] >= n_tasks * 0.97:
             break
         repeats = repeats + 1 if depth == prev else 0
-        if repeats >= 3 and depth >= n_tasks * 0.9:
+        if repeats >= 2 and depth + st["leases"] >= n_tasks * 0.9:
             break
         prev = depth
         await asyncio.sleep(2.0)

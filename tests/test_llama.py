@@ -89,6 +89,120 @@ class TestLlamaModel:
         assert losses[-1] < losses[0] - 1.0, losses[::8]
 
 
+class TestSlidingWindow:
+    """Mistral-style sliding-window attention (llama sliding_window)."""
+
+    def test_window_geq_seq_equals_full_causal(self):
+        cfg = llama.LlamaConfig.tiny()
+        cfg_w = dataclasses.replace(cfg, sliding_window=64)  # > seq
+        params = llama.init(jax.random.key(0), cfg)
+        toks = jax.random.randint(jax.random.key(1), (2, 16), 0, 256)
+        np.testing.assert_allclose(
+            np.asarray(llama.forward(params, toks, cfg)),
+            np.asarray(llama.forward(params, toks, cfg_w)),
+            atol=1e-5,
+        )
+
+    def test_window_bounds_receptive_field_one_layer(self):
+        """With ONE layer and window w, position t is independent of
+        tokens at positions <= t - w (multi-layer stacks widen the
+        field by w per layer, like Mistral)."""
+        cfg = llama.LlamaConfig.tiny(num_layers=1, sliding_window=4)
+        params = llama.init(jax.random.key(0), cfg)
+        t1 = jnp.zeros((1, 16), jnp.int32)
+        t2 = t1.at[0, 2].set(9)  # perturb position 2
+        l1 = llama.forward(params, t1, cfg)
+        l2 = llama.forward(params, t2, cfg)
+        # positions >= 2 + 4 never see position 2
+        np.testing.assert_allclose(
+            np.asarray(l1[0, 6:]), np.asarray(l2[0, 6:]), atol=1e-4
+        )
+        # but positions inside the window do
+        assert not np.allclose(
+            np.asarray(l1[0, 2:6]), np.asarray(l2[0, 2:6])
+        )
+
+    def test_cached_decode_matches_dense_with_window(self):
+        """The KV-cache prefill + rowwise decode must agree with the
+        dense windowed forward token-for-token."""
+        cfg = llama.LlamaConfig.tiny(sliding_window=5)
+        params = llama.init(jax.random.key(0), cfg)
+        toks = jax.random.randint(jax.random.key(7), (1, 9), 0, 256)
+        dense_last = llama.forward(params, toks, cfg)[:, -1, :]
+        cache = llama.init_cache(cfg, 1, 16)
+        cached_last, cache = llama.forward_cached(
+            params, toks, cache, jnp.int32(0), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(cached_last), np.asarray(dense_last),
+            atol=2e-4, rtol=2e-4,
+        )
+        # one rowwise decode step vs dense recompute of the longer seq
+        nxt = jnp.argmax(cached_last, axis=-1).astype(jnp.int32)
+        pos = jnp.full((1,), 9, jnp.int32)
+        step_logits, cache = llama.decode_step_rowwise(
+            params, nxt, cache, pos, cfg
+        )
+        longer = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        dense_step = llama.forward(params, longer, cfg)[:, -1, :]
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(dense_step),
+            atol=2e-4, rtol=2e-4,
+        )
+
+    def test_rolling_cache_wraps_and_matches_dense(self):
+        """A cache SMALLER than the decoded sequence (the Mistral
+        memory win) must still match dense logits step for step — the
+        rolling slots wrap and old positions get overwritten."""
+        cfg = llama.LlamaConfig.tiny(sliding_window=4)
+        params = llama.init(jax.random.key(0), cfg)
+        toks = jax.random.randint(jax.random.key(8), (1, 3), 0, 256)
+        T = llama.rolling_cache_len(cfg, prefill_chunk=3)  # 4 + 3 - 1
+        assert T == 6
+        cache = llama.init_cache(cfg, 1, T)
+        logits, cache = llama.forward_cached(
+            params, toks, cache, jnp.int32(0), cfg
+        )
+        seq = toks
+        for step in range(10):  # total 13 positions >> T=6: wraps twice
+            np.testing.assert_allclose(
+                np.asarray(logits),
+                np.asarray(llama.forward(params, seq, cfg)[:, -1, :]),
+                atol=3e-4, rtol=3e-4,
+                err_msg=f"diverged at decode step {step}",
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+            pos = jnp.full((1,), seq.shape[1] - 1, jnp.int32)
+            logits, cache = llama.decode_step_rowwise(
+                params, nxt, cache, pos, cfg
+            )
+
+    def test_prefill_chunk_exceeding_cache_raises(self):
+        cfg = llama.LlamaConfig.tiny(sliding_window=4)
+        params = llama.init(jax.random.key(0), cfg)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        cache = llama.init_cache(cfg, 1, 6)  # chunk 8 > T 6
+        with pytest.raises(AssertionError, match="prefill chunk"):
+            llama.forward_cached(params, toks, cache, jnp.int32(0), cfg)
+
+    def test_generate_kv_with_window_larger_than_sequence(self):
+        """The common config (window >> decoded length) must serve
+        through the cached fast path — the cache never wraps, so no
+        rolling constraint applies — and agree with full recompute."""
+        cfg = llama.LlamaConfig.tiny(sliding_window=64)
+        params = llama.init(jax.random.key(0), cfg)
+        prompt = jax.random.randint(jax.random.key(9), (1, 8), 0, 256)
+        cached = llama.generate_kv(params, prompt, cfg, max_new_tokens=4)
+        full = llama.generate(params, prompt, cfg, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(full))
+
+    def test_mistral_preset_shape(self):
+        cfg = llama.LlamaConfig.mistral_7b()
+        assert cfg.sliding_window == 4096 and cfg.num_kv_heads == 8
+        assert cfg.mlp_dim == 14336 and cfg.max_seq_len == 32768
+
+
 class TestLlamaSharded:
     def test_sharded_train_step(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
