@@ -376,6 +376,16 @@ def rolling_cache_len(config: LlamaConfig, prefill_chunk: int) -> int:
     return config.sliding_window + max(1, prefill_chunk) - 1
 
 
+def _rolling_mask(q_pos, t_idx, T: int, window: int):
+    """Validity mask for rolling-buffer slots: slot s as seen by query
+    position q holds position q - ((q - s) mod T) — the newest position
+    <= q congruent to s.  Valid iff non-negative and inside the window.
+    q_pos: (..., 1)-broadcastable positions; t_idx: (T,) slot indices.
+    The ONE implementation both cached-attention paths share."""
+    t_pos = q_pos - ((q_pos - t_idx) % T)
+    return (t_pos >= 0) & (t_pos > q_pos - window)
+
+
 def _cached_attention(q, k_cache, v_cache, pos, config: LlamaConfig):
     """q: (B, Sq, H, D) attends over cache[:, :T]; positions > pos are
     masked.  Works for prefill (Sq = prompt len, pos = len-1) and decode
@@ -393,12 +403,11 @@ def _cached_attention(q, k_cache, v_cache, pos, config: LlamaConfig):
     q_pos = pos - (Sq - 1) + jnp.arange(Sq)  # absolute position per query
     t_idx = jnp.arange(T)
     if c.sliding_window:
-        # rolling buffer: slot s as seen by query q holds position
-        # q - ((q - s) mod T) — the newest position <= q congruent to
-        # s.  Valid iff non-negative and inside the window.  (Slot
-        # correctness needs T >= window + Sq - 1: see forward_cached.)
-        t_pos = q_pos[:, None] - ((q_pos[:, None] - t_idx[None, :]) % T)
-        mask = (t_pos >= 0) & (t_pos > q_pos[:, None] - c.sliding_window)
+        # rolling buffer (slot correctness needs T >= window + Sq - 1:
+        # see rolling_cache_len / forward_cached)
+        mask = _rolling_mask(
+            q_pos[:, None], t_idx[None, :], T, c.sliding_window
+        )
     else:
         mask = t_idx[None, :] <= q_pos[:, None]  # (Sq, T)
     scores = jnp.where(mask[None, None, :, :], scores, -1e30)
@@ -585,8 +594,9 @@ def _block_decode_rowwise(x, p, cache_k, cache_v, pos, config: LlamaConfig):
     t_idx = jnp.arange(T)
     if c.sliding_window:
         # rolling buffer: reconstruct each slot's position per row
-        t_pos = pos[:, None] - ((pos[:, None] - t_idx[None, :]) % T)
-        mask = (t_pos >= 0) & (t_pos > pos[:, None] - c.sliding_window)
+        mask = _rolling_mask(
+            pos[:, None], t_idx[None, :], T, c.sliding_window
+        )
     else:
         mask = t_idx[None, :] <= pos[:, None]  # (B, T)
     scores = jnp.where(mask[:, None, None, :], scores, -1e30)

@@ -238,7 +238,9 @@ def accuracy(params: Params, batch, config: ViTConfig):
 
 
 def num_params(config: ViTConfig) -> int:
+    shapes = jax.eval_shape(
+        lambda rng: init(rng, config), jax.random.key(0)
+    )
     return sum(
-        int(jnp.size(v))
-        for v in jax.tree_util.tree_leaves(init(jax.random.key(0), config))
+        math.prod(v.shape) for v in jax.tree_util.tree_leaves(shapes)
     )

@@ -51,7 +51,7 @@ class LLMEngine:
     """Slot-based continuous batcher: admit-prefill + shared decode step."""
 
     def __init__(self, params, config, *, max_slots: int = 4,
-                 max_len: int = 256):
+                 max_len: int = 256, max_prompt_len: Optional[int] = None):
         from ray_tpu.models import llama
 
         self._llama = llama
@@ -59,7 +59,19 @@ class LLMEngine:
         self.config = config
         self.max_slots = max_slots
         self.max_len = max_len
-        self.cache = llama.init_cache(config, max_slots, max_len)
+        # Sliding-window models with an explicit prompt cap get a
+        # ROLLING cache: window + max_prompt - 1 slots serve ANY decode
+        # length up to max_len positions (the Mistral KV-memory win;
+        # llama.rolling_cache_len).  Without the cap — or without a
+        # window — the cache holds every position, as before.
+        self.max_prompt_len = max_prompt_len or max_len
+        if config.sliding_window and max_prompt_len:
+            self.cache_len = min(
+                max_len, llama.rolling_cache_len(config, max_prompt_len)
+            )
+        else:
+            self.cache_len = max_len
+        self.cache = llama.init_cache(config, max_slots, self.cache_len)
         self.slots: List[Optional[_Slot]] = [None] * max_slots
         self._pending: "asyncio.Queue" = asyncio.Queue()
         self._runner: Optional[asyncio.Task] = None
@@ -107,7 +119,7 @@ class LLMEngine:
                     await q.put(e)
                     await q.put(_END)
                 self.cache = self._llama.init_cache(
-                    self.config, self.max_slots, self.max_len
+                    self.config, self.max_slots, self.cache_len
                 )
 
     async def _run_inner(self):
@@ -120,12 +132,20 @@ class LLMEngine:
             # admit pending requests into free slots (prefill)
             while not self._pending.empty() and None in self.slots:
                 prompt, max_new, q = self._pending.get_nowait()
+                if max_new <= 0:  # exact budget: zero tokens requested
+                    await q.put(_END)
+                    continue
                 slot = self.slots.index(None)
                 S0 = len(prompt)
-                if S0 + max_new > self.max_len or S0 == 0:
+                if (
+                    S0 + max_new > self.max_len
+                    or S0 > self.max_prompt_len
+                    or S0 == 0
+                ):
                     await q.put(ValueError(
                         f"prompt of {S0} tokens + {max_new} new exceeds "
-                        f"max_len {self.max_len}"
+                        f"max_len {self.max_len} (or prompt cap "
+                        f"{self.max_prompt_len})"
                     ))
                     await q.put(_END)
                     continue
@@ -191,7 +211,8 @@ class LlamaDeployment:
     ``weights_ref`` (object-store ref) / ``weights_loader`` callable."""
 
     def __init__(self, config=None, weights_ref=None, weights_loader=None,
-                 max_slots: int = 4, max_len: int = 256, seed: int = 0):
+                 max_slots: int = 4, max_len: int = 256,
+                 max_prompt_len: Optional[int] = None, seed: int = 0):
         import jax
 
         from ray_tpu.models import llama
@@ -206,7 +227,8 @@ class LlamaDeployment:
         else:
             params = llama.init(jax.random.key(seed), self.config)
         self.engine = LLMEngine(
-            params, self.config, max_slots=max_slots, max_len=max_len
+            params, self.config, max_slots=max_slots, max_len=max_len,
+            max_prompt_len=max_prompt_len,
         )
 
     async def generate(self, prompt: List[int], max_new_tokens: int = 16):

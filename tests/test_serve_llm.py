@@ -232,3 +232,67 @@ class TestHTTPStreaming:
         assert len(toks) == 5
         assert all(isinstance(t, int) for t in toks)
         serve.delete("llmh_app")
+
+
+class TestRollingCacheEngine:
+    def test_windowed_engine_uses_small_cache_and_matches_dense(self):
+        """A sliding-window model with a prompt cap serves through a
+        ROLLING cache (window + max_prompt - 1 slots) and must emit the
+        same greedy tokens as full dense recompute, decoding far past
+        the cache length (the Mistral KV-memory win, live in serving)."""
+        import asyncio
+
+        import jax
+        import numpy as np
+
+        from ray_tpu.models import llama
+        from ray_tpu.serve.llm import LLMEngine
+
+        cfg = llama.LlamaConfig.tiny(sliding_window=6)
+        params = llama.init(jax.random.key(0), cfg)
+        engine = LLMEngine(
+            params, cfg, max_slots=2, max_len=64, max_prompt_len=4
+        )
+        assert engine.cache_len == 9  # 6 + 4 - 1 << 64
+        assert engine.cache["k"].shape[2] == 9
+
+        prompt = [3, 7, 11, 2]
+
+        async def run():
+            toks = []
+            async for t in engine.stream(prompt, max_new_tokens=30):
+                toks.append(t)
+            return toks
+
+        got = asyncio.run(run())
+        assert len(got) == 30
+        import jax.numpy as jnp
+
+        ref = llama.generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg,
+            max_new_tokens=30,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(ref[0, len(prompt):])
+        )
+
+    def test_prompt_cap_enforced(self):
+        import asyncio
+
+        import jax
+
+        from ray_tpu.models import llama
+        from ray_tpu.serve.llm import LLMEngine
+
+        cfg = llama.LlamaConfig.tiny(sliding_window=6)
+        params = llama.init(jax.random.key(0), cfg)
+        engine = LLMEngine(
+            params, cfg, max_slots=1, max_len=64, max_prompt_len=4
+        )
+
+        async def run():
+            with pytest.raises(ValueError, match="prompt cap"):
+                async for _ in engine.stream([1] * 8, max_new_tokens=2):
+                    pass
+
+        asyncio.run(run())
