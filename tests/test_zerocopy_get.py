@@ -70,6 +70,10 @@ def test_freed_while_pinned_becomes_evictable(zc_cluster):
     gc.collect()
     time.sleep(3.0)  # let the GCS free -> raylet delete (refused:
     # pinned -> unprotect) land while the pin is still held
+    # while the pin is live the delete MUST have been refused: absence
+    # or corruption HERE is the delete-under-live-pin bug, loudly
+    assert store.contains(oid), "entry deleted while a pin was held"
+    assert int(val[0]) == 1, "pinned view corrupted by premature delete"
     del val
     gc.collect()  # last pin drops; entry now sealed + unpinned
     time.sleep(0.2)
