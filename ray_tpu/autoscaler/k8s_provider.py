@@ -39,6 +39,7 @@ import logging
 import os
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -182,11 +183,40 @@ class KubeRayProvider(NodeProvider):
     def _groups(self, cr: dict) -> List[dict]:
         return (cr.get("spec") or {}).get("workerGroups") or []
 
-    def _patch_groups(self, groups: List[dict]) -> None:
-        self.api.patch(
-            cr_path(self.namespace, self.cluster_name),
-            {"spec": {"workerGroups": groups}},
-        )
+    def _patch_groups(self, cr: dict, groups: List[dict]) -> None:
+        # Optimistic concurrency: echo the read CR's resourceVersion so
+        # the apiserver rejects (409) a write that would clobber a
+        # concurrent writer's update (e.g. the operator consuming a
+        # workersToDelete entry between our read and patch).
+        body: dict = {"spec": {"workerGroups": groups}}
+        rv = (cr.get("metadata") or {}).get("resourceVersion")
+        if rv is not None:
+            body["metadata"] = {"resourceVersion": rv}
+        self.api.patch(cr_path(self.namespace, self.cluster_name), body)
+
+    def _mutate_groups(self, mutate) -> Optional[dict]:
+        """get → ``mutate(groups)`` → patch, retrying the whole
+        read-modify-write on 409 conflict.  ``mutate`` returns the
+        touched group dict, or None to abort (no patch sent)."""
+        last: Optional[KubeApiError] = None
+        for attempt in range(8):
+            cr = self._get_cr()
+            groups = self._groups(cr)
+            g = mutate(groups)
+            if g is None:
+                return None
+            try:
+                self._patch_groups(cr, groups)
+                return g
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+                last = e  # stale resourceVersion: re-read and retry
+                # any CR write (operator status updates included) bumps
+                # resourceVersion; back off so a reconcile storm can't
+                # exhaust back-to-back retries
+                time.sleep(min(0.05 * (2 ** attempt), 1.0))
+        raise last  # type: ignore[misc]
 
     def _pods(self) -> List[dict]:
         resp = self.api.get(pods_path(self.namespace, self.cluster_name))
@@ -196,20 +226,19 @@ class KubeRayProvider(NodeProvider):
     def create_node(self, node_type, resources, labels) -> ProviderNode:
         """Ask for one more replica of ``node_type``'s group.  One CR
         read + one merge patch; the operator does the rest."""
-        with self._lock:
-            cr = self._get_cr()
-            groups = self._groups(cr)
+        def bump(groups: List[dict]) -> dict:
             for g in groups:
                 if g.get("name") == node_type:
                     g["replicas"] = int(g.get("replicas", 0)) + 1
-                    break
-            else:
-                raise KeyError(
-                    f"RtCluster {self.cluster_name} has no worker group "
-                    f"{node_type!r} (groups: "
-                    f"{[g.get('name') for g in groups]})"
-                )
-            self._patch_groups(groups)
+                    return g
+            raise KeyError(
+                f"RtCluster {self.cluster_name} has no worker group "
+                f"{node_type!r} (groups: "
+                f"{[g.get('name') for g in groups]})"
+            )
+
+        with self._lock:
+            g = self._mutate_groups(bump)
         logger.info(
             "scaled group %s of %s to %s replicas",
             node_type, self.cluster_name, g["replicas"],
@@ -230,9 +259,7 @@ class KubeRayProvider(NodeProvider):
             pod_name = None
         else:
             pod_name = node.provider_id
-        with self._lock:
-            cr = self._get_cr()
-            groups = self._groups(cr)
+        def drop(groups: List[dict]) -> Optional[dict]:
             for g in groups:
                 if g.get("name") == node.node_type:
                     g["replicas"] = max(0, int(g.get("replicas", 0)) - 1)
@@ -241,10 +268,13 @@ class KubeRayProvider(NodeProvider):
                         if pod_name not in wtd:
                             wtd.append(pod_name)
                         g["workersToDelete"] = wtd
-                    break
-            else:
-                return  # group vanished: nothing to do
-            self._patch_groups(groups)
+                    return g
+            return None  # group vanished: nothing to do
+
+        with self._lock:
+            g = self._mutate_groups(drop)
+            if g is None:
+                return
         logger.info(
             "descaled group %s of %s to %s replicas (deleting %s)",
             node.node_type, self.cluster_name, g["replicas"], pod_name,

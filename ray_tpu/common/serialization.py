@@ -237,6 +237,12 @@ class SerializationContext:
         evict or reuse the range while any deserialized view survives
         (ray: plasma client pins mapped objects until the last Buffer
         is destructed, plasma/client.cc)."""
+        if owner is not None and not SUPPORTS_ZEROCOPY_OWNER:
+            raise RuntimeError(
+                "zero-copy deserialize (owner=) requires CPython >= 3.12 "
+                "(PEP 688 __buffer__); gate callers on "
+                "serialization.SUPPORTS_ZEROCOPY_OWNER"
+            )
         mv = memoryview(data)
         off = 0
         (meta_len,) = _HEADER.unpack_from(mv, off)
@@ -254,6 +260,12 @@ class SerializationContext:
             off += blen
         return pickle.loads(bytes(meta) if isinstance(meta, memoryview) else meta,
                             buffers=buffers)
+
+
+# PEP 688 ``__buffer__`` is honored by CPython >= 3.12 only; on older
+# interpreters _OwnedBuffer would raise TypeError inside pickle, so
+# callers gate the owner= zero-copy path on this and fall back to a copy.
+SUPPORTS_ZEROCOPY_OWNER = sys.version_info >= (3, 12)
 
 
 class _OwnedBuffer:

@@ -982,6 +982,7 @@ class Runtime:
         if (
             pin.view.nbytes >= self._zerocopy_threshold
             and self.store.pin_headroom() > 64
+            and ser.SUPPORTS_ZEROCOPY_OWNER
         ):
             # Zero-copy: deserialize straight off the arena; the pin's
             # lifetime rides the returned object's buffer-base chain
@@ -989,12 +990,17 @@ class Runtime:
             # semantics.  Read-only so a caller can't scribble on shm.
             # The pin is deliberately NOT released here — it unpins when
             # the last deserialized view is garbage-collected.
-            return (
-                self._serialization.deserialize(
+            try:
+                value = self._serialization.deserialize(
                     pin.view.toreadonly(), owner=pin
-                ),
-                True,
-            )
+                )
+            except BaseException:
+                # On failure nothing chains the pin; a retained exception
+                # (logging, sys.last_exc) would otherwise keep the arena
+                # range pinned for as long as the traceback lives.
+                pin.release()
+                raise
+            return value, True
         try:
             # small objects (and pin-ledger pressure — many large results
             # already held zero-copy): a copy is cheaper than holding a

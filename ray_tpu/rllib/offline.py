@@ -136,9 +136,11 @@ class TransitionReader:
     (CQL) and advantage-weighted ones (MARWIL) train on (ray:
     rllib/offline/json_reader.py transition batches role).
 
-    ``next_obs`` of an episode's last step repeats its own obs with
-    done=1 — the done mask kills the bootstrap, so the value never
-    matters.  ``returns`` are discounted returns-to-go per step.
+    Episodes may record one trailing terminal obs (len(obs) ==
+    len(actions)+1); it becomes the last step's ``next_obs``.  Without
+    it, the last ``next_obs`` repeats its own obs with done=1 — the done
+    mask kills the bootstrap, so the value never matters.  Zero-step
+    episodes are skipped.  ``returns`` are discounted returns-to-go.
     """
 
     def __init__(self, paths: Sequence[str], gamma: float = 0.99,
@@ -148,6 +150,26 @@ class TransitionReader:
         for o, actions, rewards in _iter_episodes(paths, env_to_module_fn):
             r = np.asarray(rewards, np.float32)
             T = len(r)
+            if len(actions) != T:
+                raise ValueError(
+                    f"episode shape mismatch: {len(actions)} actions, "
+                    f"{T} rewards (expected equal)"
+                )
+            if T == 0:
+                continue  # zero-step episode: no transitions to learn from
+            if len(o) == len(actions) + 1:
+                # terminal-obs format: the trailing obs is the real s_T —
+                # use it for next_obs instead of repeating s_{T-1}
+                nxt = o[1:]
+                o = o[: len(actions)]
+            elif len(o) == len(actions):
+                nxt = np.concatenate([o[1:], o[-1:]])
+            else:
+                raise ValueError(
+                    f"episode shape mismatch: {len(o)} obs, "
+                    f"{len(actions)} actions (expected equal, or one "
+                    "trailing terminal obs)"
+                )
             ret = np.zeros(T, np.float32)
             acc = 0.0
             for t in range(T - 1, -1, -1):
@@ -156,7 +178,7 @@ class TransitionReader:
             done = np.zeros(T, np.float32)
             done[-1] = 1.0
             obs_l.append(o)
-            nxt_l.append(np.concatenate([o[1:], o[-1:]]))
+            nxt_l.append(nxt)
             act_l.extend(actions)
             rew_l.append(r)
             done_l.append(done)

@@ -35,6 +35,8 @@ class FakeKube:
     """In-memory RtCluster + pods, operator-reconciled."""
 
     def __init__(self):
+        self.rv = 1  # resourceVersion, bumped on every CR write
+        self.conflicts_to_serve = 0  # force N 409s (concurrent-writer sim)
         self.cr = {
             "apiVersion": f"{GROUP}/v1",
             "kind": "RtCluster",
@@ -55,6 +57,7 @@ class FakeKube:
         spec = body.get("spec", {})
         if "workerGroups" in spec:
             self.cr["spec"]["workerGroups"] = spec["workerGroups"]
+        self.rv += 1
         self.reconcile()
 
     def reconcile(self):
@@ -115,6 +118,7 @@ def kube_server():
             self._check_auth()
             requests.append(("GET", self.path))
             if self.path == cr_path(NS, NAME):
+                state.cr["metadata"]["resourceVersion"] = str(state.rv)
                 return self._reply(200, state.cr)
             if self.path.startswith(f"/api/v1/namespaces/{NS}/pods"):
                 assert "labelSelector=" in self.path
@@ -135,6 +139,18 @@ def kube_server():
             requests.append(("PATCH", self.path, json.loads(raw)))
             if self.path != cr_path(NS, NAME):
                 return self._reply(404, {"message": "not found"})
+            # optimistic concurrency: the client must echo the CR's
+            # resourceVersion; a stale one (or a simulated concurrent
+            # writer) is rejected with 409 like the real apiserver
+            sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+            assert sent_rv is not None, (
+                "PATCH must carry metadata.resourceVersion"
+            )
+            if state.conflicts_to_serve > 0 or sent_rv != str(state.rv):
+                if state.conflicts_to_serve > 0:
+                    state.conflicts_to_serve -= 1
+                    state.rv += 1  # the concurrent writer's bump
+                return self._reply(409, {"message": "conflict"})
             state.merge_patch(body)
             return self._reply(200, state.cr)
 
@@ -229,6 +245,24 @@ def test_unknown_group_and_bad_path(provider):
     with pytest.raises(KubeApiError) as ei:
         api.get("/apis/ray-tpu.io/v1/namespaces/ml/rtclusters/other")
     assert ei.value.status == 404
+
+
+def test_409_conflict_rereads_and_retries(provider):
+    """A concurrent writer between GET and PATCH bumps resourceVersion;
+    the provider must re-read the fresh CR and re-apply its mutation
+    rather than clobber (ADVICE r4: optimistic concurrency)."""
+    prov, state, requests = provider
+    state.conflicts_to_serve = 2
+    prov.create_node("v5e-4", {"TPU": 4}, {})
+    patches = [r for r in requests if r[0] == "PATCH"]
+    assert len(patches) == 3  # two 409s, then the successful write
+    # every attempt echoed a resourceVersion, and the final state is the
+    # single intended increment (not a lost update, not a double bump)
+    assert all(p[2]["metadata"]["resourceVersion"] for p in patches)
+    g = next(
+        g for g in state.cr["spec"]["workerGroups"] if g["name"] == "v5e-4"
+    )
+    assert g["replicas"] == 1
 
 
 def test_autoscaler_drives_k8s_provider(provider):
