@@ -197,25 +197,31 @@ class KubeRayProvider(NodeProvider):
     def _mutate_groups(self, mutate) -> Optional[dict]:
         """get → ``mutate(groups)`` → patch, retrying the whole
         read-modify-write on 409 conflict.  ``mutate`` returns the
-        touched group dict, or None to abort (no patch sent)."""
+        touched group dict, or None to abort (no patch sent).
+
+        The provider lock covers each ATTEMPT, not the backoff sleeps —
+        every attempt re-reads the CR anyway, so correctness is per-RMW,
+        and sleeping under the lock would convoy concurrent scale ops
+        behind one retry storm for seconds."""
         last: Optional[KubeApiError] = None
         for attempt in range(8):
-            cr = self._get_cr()
-            groups = self._groups(cr)
-            g = mutate(groups)
-            if g is None:
-                return None
-            try:
-                self._patch_groups(cr, groups)
-                return g
-            except KubeApiError as e:
-                if e.status != 409:
-                    raise
-                last = e  # stale resourceVersion: re-read and retry
-                # any CR write (operator status updates included) bumps
-                # resourceVersion; back off so a reconcile storm can't
-                # exhaust back-to-back retries
-                time.sleep(min(0.05 * (2 ** attempt), 1.0))
+            with self._lock:
+                cr = self._get_cr()
+                groups = self._groups(cr)
+                g = mutate(groups)
+                if g is None:
+                    return None
+                try:
+                    self._patch_groups(cr, groups)
+                    return g
+                except KubeApiError as e:
+                    if e.status != 409:
+                        raise
+                    last = e  # stale resourceVersion: re-read and retry
+            # any CR write (operator status updates included) bumps
+            # resourceVersion; back off so a reconcile storm can't
+            # exhaust back-to-back retries
+            time.sleep(min(0.05 * (2 ** attempt), 1.0))
         raise last  # type: ignore[misc]
 
     def _pods(self) -> List[dict]:
@@ -237,8 +243,7 @@ class KubeRayProvider(NodeProvider):
                 f"{[g.get('name') for g in groups]})"
             )
 
-        with self._lock:
-            g = self._mutate_groups(bump)
+        g = self._mutate_groups(bump)
         logger.info(
             "scaled group %s of %s to %s replicas",
             node_type, self.cluster_name, g["replicas"],
@@ -271,10 +276,9 @@ class KubeRayProvider(NodeProvider):
                     return g
             return None  # group vanished: nothing to do
 
-        with self._lock:
-            g = self._mutate_groups(drop)
-            if g is None:
-                return
+        g = self._mutate_groups(drop)
+        if g is None:
+            return
         logger.info(
             "descaled group %s of %s to %s replicas (deleting %s)",
             node.node_type, self.cluster_name, g["replicas"], pod_name,

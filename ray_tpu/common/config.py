@@ -67,7 +67,12 @@ D("node_death_timeout_s", float, 10.0)
 # before declaring the cluster lost
 D("gcs_reconnect_max_downtime_s", float, 60.0)
 # debounce for GCS snapshot flushes (fault-tolerance checkpoint)
-D("gcs_checkpoint_debounce_s", float, 0.05)
+# Snapshot compaction cadence.  Durability does NOT ride this: critical
+# mutations are WAL-appended before their ack (see CheckpointStore), so a
+# longer debounce only lengthens the WAL replayed at restart — while each
+# snapshot pickles the full control-plane state, which at high PG/actor
+# churn was ~15% of GCS CPU at 50 ms.
+D("gcs_checkpoint_debounce_s", float, 0.25)
 # how often each process ships its util.metrics registry to the GCS
 D("metrics_push_interval_s", float, 5.0)
 # node-to-node object transfer: chunk size + pipelined chunks in flight

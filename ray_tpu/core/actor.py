@@ -11,6 +11,7 @@ from typing import Any, Dict, Optional
 from ray_tpu.common.config import cfg
 from ray_tpu.common.ids import ActorID
 from ray_tpu.core.remote_function import _build_resources, _strategy_dict
+from ray_tpu.core.runtime import get_runtime
 
 
 class ActorMethod:
@@ -37,8 +38,6 @@ class ActorMethod:
         return ClassMethodNode(self._handle, self._name, args)
 
     def remote(self, *args, **kwargs):
-        from ray_tpu.core.runtime import get_runtime
-
         refs = get_runtime().submit_actor_task(
             self._handle._actor_id,
             self._name,
@@ -97,8 +96,6 @@ class ActorClass:
         return ActorClass(self._cls, **merged)
 
     def remote(self, *args, **kwargs) -> ActorHandle:
-        from ray_tpu.core.runtime import get_runtime
-
         o = self._opts
         # actors default to 0 CPU (like the reference) unless asked
         resources = _build_resources(
@@ -150,7 +147,6 @@ class ActorClass:
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     """Look up a named actor (ray: ray.get_actor)."""
     from ray_tpu.core.errors import RayTpuError
-    from ray_tpu.core.runtime import get_runtime
 
     rt = get_runtime()
     info = rt._run(

@@ -23,7 +23,9 @@ StoreClient analogue, gcs/store_client/store_client.h).
 from __future__ import annotations
 
 import asyncio
+import copy
 import logging
+import os
 import time
 from collections import OrderedDict, deque
 from typing import Deque
@@ -167,6 +169,9 @@ class PendingLease:
     client_conn: rpc.Connection
     actor_id: Optional[ActorID]
     enqueued_at: float = field(default_factory=time.monotonic)
+    # client-chosen tag (scheduling-class id) so the client can cancel
+    # parked requests whose demand evaporated (ray: CancelWorkerLease)
+    tag: Optional[int] = None
 
 
 # --------------------------------------------------------------------------
@@ -483,7 +488,8 @@ _READONLY_RPCS = frozenset({
     "get_autoscaler_state", "list_tasks", "list_objects",
     "metrics_push", "get_metrics", "get_job_info", "get_job_logs",
     "list_jobs", "list_events", "report_event", "get_worker_death_info",
-    "cluster_store_stats", "dump_worker_stacks",
+    "cluster_store_stats", "dump_worker_stacks", "cancel_lease_requests",
+    "dump_tasks",
 })
 
 
@@ -573,8 +579,6 @@ class GcsServer:
 
     def _snapshot_state(self) -> dict:
         """Connection-free copy of every durable table."""
-        import copy
-
         actors = {}
         for aid, a in self.actors.items():
             c = copy.copy(a)
@@ -758,9 +762,9 @@ class GcsServer:
             self._mark_dirty()
             if method in _CRITICAL_RPCS and self.checkpoint is not None:
                 # O(delta) persistence before the ack: append just the
-                # mutated rows to the WAL; the debounced snapshot (50 ms)
-                # compacts it.  Rewriting the full snapshot inline here
-                # capped PG churn at ~150/s.
+                # mutated rows to the WAL; the debounced snapshot
+                # (cfg.gcs_checkpoint_debounce_s) compacts it.  Rewriting
+                # the full snapshot inline here capped PG churn at ~150/s.
                 for rec in self._wal_records(method, p):
                     self.checkpoint.wal_append(rec)
         return result
@@ -770,8 +774,6 @@ class GcsServer:
         over the loaded snapshot at restore (see start()).  Covers the
         primary row the ack promises durability for; cascaded effects on
         other tables ride the debounced snapshot like everything else."""
-        import copy
-
         recs = []
         if method in ("create_placement_group", "remove_placement_group"):
             pid = PlacementGroupID(p["pg_id"])
@@ -1985,19 +1987,31 @@ class GcsServer:
                 f"cluster can ever satisfy it (cluster: "
                 f"{[n.resources_total.to_dict() for n in self.nodes.values()]})"
             )
-        deadline = time.monotonic() + cfg.sched_max_pending_lease_s
+        t_start = time.monotonic()
+        deadline = t_start + cfg.sched_max_pending_lease_s
+        tag = p.get("tag")
         while True:
+            if tag is not None:
+                stamp = conn.peer_info.get("cancelled_tags", {}).get(tag)
+                if stamp is not None and stamp >= t_start:
+                    return {"cancelled": True}
             node = self.scheduler.pick_node(demand, strategy)
             if node is None:
                 fut = asyncio.get_running_loop().create_future()
-                entry = PendingLease(fut, demand, strategy, conn, actor_id)
+                entry = PendingLease(
+                    fut, demand, strategy, conn, actor_id, tag=tag
+                )
                 self.scheduler.pending.append(entry)
                 try:
                     # bounded wait: the client re-requests on LEASE_PENDING so
                     # a vanished client can never leak a queued grant
-                    await asyncio.wait_for(
+                    if await asyncio.wait_for(
                         fut, timeout=deadline - time.monotonic()
-                    )
+                    ) == "cancelled":
+                        # client demand evaporated (rpc_cancel_lease_requests):
+                        # answer with a no-lease marker instead of granting
+                        # capacity the client would bounce straight back
+                        return {"cancelled": True}
                 except asyncio.TimeoutError:
                     # no eager dequeue: membership + remove are O(queue)
                     # on a deque, and with 100k queued the timeout path
@@ -2117,6 +2131,70 @@ class GcsServer:
     async def rpc_return_lease(self, conn, p):
         await self._release_lease(p["lease_id"], broken=p.get("broken", False))
         return True
+
+    async def rpc_dump_tasks(self, conn, p):
+        """Stacks of every live asyncio task in the GCS process — the
+        suspended-coroutine complement of dump_worker_stacks (thread
+        stacks only show the epoll wait)."""
+        def chain(coro, limit=12):
+            # follow the await chain (task.get_stack stops at the
+            # outermost suspended frame, hiding WHAT it awaits)
+            frames = []
+            while coro is not None and len(frames) < limit:
+                f = getattr(coro, "cr_frame", None) or getattr(
+                    coro, "gi_frame", None
+                )
+                if f is None:
+                    frames.append(repr(coro)[:120])
+                    break
+                frames.append(
+                    f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                    f"{f.f_lineno} {f.f_code.co_name}"
+                )
+                coro = getattr(coro, "cr_await", None) or getattr(
+                    coro, "gi_yieldfrom", None
+                )
+            return frames
+
+        out = []
+        for t in asyncio.all_tasks():
+            coro = t.get_coro()
+            out.append({
+                "name": getattr(coro, "__qualname__", str(coro)),
+                "stack": chain(coro),
+            })
+        return out
+
+    async def rpc_cancel_lease_requests(self, conn, p):
+        """Cancel THIS client's parked lease requests carrying one of the
+        given tags (ray: CancelWorkerLease, raylet node_manager.cc).
+
+        Without this, a client whose task queue drained leaves its parked
+        requests behind; every freed slot then ping-pongs through
+        grant → client-sees-no-work → return-after-grace, serially
+        starving real demand (PGs, new classes) for `grace × parked`
+        seconds.  O(pending) walk — acceptable because cancels fire only
+        on queue-drain edges, not per task."""
+        tags = set(p["tags"])
+        # Stamp the cancel on the connection: a request that was mid-wake
+        # (granted a re-pick by _kick_pending) is NOT in pending right now
+        # but re-parks immediately — it must still observe this cancel, or
+        # it ping-pongs forever.  rpc_request_lease checks the stamp
+        # against its own start time on every loop iteration.
+        stamps = conn.peer_info.setdefault("cancelled_tags", {})
+        now = time.monotonic()
+        for t in tags:
+            stamps[t] = now
+        n = 0
+        for req in self.scheduler.pending:
+            if (
+                req.client_conn is conn
+                and req.tag in tags
+                and not req.fut.done()
+            ):
+                req.fut.set_result("cancelled")
+                n += 1
+        return n
 
     async def _release_lease(self, lease_id: int, broken: bool = False,
                              kick: bool = True):
@@ -2546,6 +2624,31 @@ def main():
 
     logging.basicConfig(level=logging.INFO,
                         format="[gcs] %(levelname)s %(message)s")
+
+    # SIGUSR1 → dump all thread stacks to stderr (the gcs log): the
+    # zero-dependency "where is it stuck" probe
+    import faulthandler
+    import signal as _sig
+
+    faulthandler.register(_sig.SIGUSR1)
+
+    prof_dir = os.environ.get("RT_PROFILE_DIR")
+    if prof_dir:
+        # dev profiling (see util/profiling.py): capture the whole server
+        # loop; SIGTERM (the normal teardown signal) dumps the stats
+        import cProfile
+        import signal
+
+        prof = cProfile.Profile()
+        path = os.path.join(prof_dir, f"gcs-{os.getpid()}.pstats")
+
+        def _term(_sig, _frm):
+            prof.disable()
+            prof.dump_stats(path)
+            sys.exit(0)
+
+        signal.signal(signal.SIGTERM, _term)
+        prof.enable()
 
     async def run():
         gcs = GcsServer(

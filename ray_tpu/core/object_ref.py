@@ -12,6 +12,20 @@ from typing import Optional
 
 from ray_tpu.common.ids import ObjectID
 
+# Lazily-bound runtime module (circular import: runtime.py imports this
+# module at load).  Bound once on first ref construction — an in-function
+# import would pay the import-machinery lookup on EVERY ref create/delete,
+# which is measurable on the submission hot path.
+_rt_mod = None
+
+
+def _bind_runtime():
+    global _rt_mod
+    from ray_tpu.core import runtime as _rt
+
+    _rt_mod = _rt
+    return _rt
+
 
 class ObjectRef:
     __slots__ = ("object_id", "_owner_hint", "__weakref__")
@@ -19,14 +33,12 @@ class ObjectRef:
     def __init__(self, object_id: ObjectID, owner_hint: Optional[str] = None):
         self.object_id = object_id
         self._owner_hint = owner_hint  # node hint for locality-aware pulls
-        try:
-            from ray_tpu.core import runtime as _rt
-
-            rt = _rt._global_runtime
-            if rt is not None:
-                rt.on_ref_created(object_id)
-        except Exception:
-            pass
+        m = _rt_mod
+        if m is None:
+            m = _bind_runtime()
+        rt = m._global_runtime
+        if rt is not None:
+            rt.on_ref_created(object_id)
 
     def hex(self) -> str:
         return self.object_id.hex()
@@ -45,15 +57,13 @@ class ObjectRef:
 
     def future(self):
         """concurrent.futures.Future resolving to the object's value."""
-        from ray_tpu.core.runtime import get_runtime
-
-        return get_runtime().as_future(self)
+        m = _rt_mod or _bind_runtime()
+        return m.get_runtime().as_future(self)
 
     def __await__(self):
         """Allow `await ref` inside async actors."""
-        from ray_tpu.core.runtime import get_runtime
-
-        return get_runtime().await_ref(self).__await__()
+        m = _rt_mod or _bind_runtime()
+        return m.get_runtime().await_ref(self).__await__()
 
     def __reduce__(self):
         # Plain pickle path (no runtime mediation): carry id + hint.
@@ -61,9 +71,10 @@ class ObjectRef:
 
     def __del__(self):
         try:
-            from ray_tpu.core import runtime as _rt
-
-            rt = _rt._global_runtime
+            m = _rt_mod
+            if m is None:
+                return  # no runtime ever existed: nothing to release
+            rt = m._global_runtime
             if rt is not None:
                 rt.on_ref_deleted(self.object_id)
         except Exception:

@@ -825,6 +825,11 @@ class Raylet:
                     break
             pool.extend(mismatched)
         if w is None:
+            logger.info(
+                "lease %s: no idle worker for key=%s (pools: %s) — spawning",
+                p["lease_id"], key,
+                {k: len(v) for k, v in self._idle_by_env.items()},
+            )
             w = self._spawn_worker(python_exe=venv_python,
                                    venv_key=venv_key,
                                    container=container)
@@ -1212,6 +1217,14 @@ def main():
 
     logging.basicConfig(level=logging.INFO,
                         format="[raylet] %(levelname)s %(message)s")
+
+    # SIGUSR1 → dump all thread stacks to stderr (the raylet log): the
+    # zero-dependency "where is it stuck" probe (reference role: py-spy
+    # via the dashboard reporter)
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.register(_signal.SIGUSR1)
 
     async def run():
         import signal

@@ -102,10 +102,24 @@ class Connection:
     def _write_frames(self, bufs):
         """Synchronous frame write (header + buffers, no await between
         writes — frames never interleave).  ONE encoder for _send and
-        call_soon; wire-format changes live here only."""
+        call_soon; wire-format changes live here only.
+
+        Small frames coalesce into a single transport write: each write()
+        tries a sock.send() when the buffer is empty, so header+payload
+        as separate writes costs 2-3 syscalls per message — the dominant
+        per-RPC term for control-plane traffic.  Large buffers still pass
+        through uncopied (a memcpy of a big payload beats nothing)."""
         header = bytearray(_U32.pack(len(bufs)))
+        total = 0
         for b in bufs:
-            header += _U32.pack(len(b) if isinstance(b, bytes) else b.nbytes)
+            n = len(b) if isinstance(b, bytes) else b.nbytes
+            header += _U32.pack(n)
+            total += n
+        if total < 65536:
+            for b in bufs:
+                header += b
+            self.writer.write(bytes(header))
+            return
         self.writer.write(bytes(header))
         for b in bufs:
             self.writer.write(b)

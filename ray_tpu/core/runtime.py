@@ -23,6 +23,7 @@ import os
 import threading
 import weakref
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -112,12 +113,19 @@ class ActorClientState:
     draining: bool = False  # pump is parked mid-drain waiting for inflight
 
 
+_sched_class_tags = iter(range(1, 1 << 62))
+
+
 class SchedClassState:
     def __init__(self):
         self.queue: List[PendingTask] = []
         self.leases: List[Lease] = []
         self.requests_inflight = 0
         self.idle_timer: Optional[asyncio.TimerHandle] = None
+        # wire id for cancel_lease_requests (parked requests at the GCS
+        # are cancelled by (client conn, tag) when local demand drains)
+        self.tag = next(_sched_class_tags)
+        self.cancel_sent = False
 
 
 # --------------------------------------------------------------------------
@@ -160,6 +168,9 @@ class Runtime:
             target=self._loop.run_forever, name="rt-io", daemon=True
         )
         self._thread.start()
+        from ray_tpu.util.profiling import maybe_enable_loop_profile
+
+        maybe_enable_loop_profile(self._loop, mode)
 
         self.store = ShmStore(store_path)
         self._zerocopy_threshold = cfg.zerocopy_get_min_bytes
@@ -239,8 +250,6 @@ class Runtime:
         self._class_runtime_envs: Dict[Any, dict] = {}
         # timeline: bounded ring of task lifecycle events for
         # api.timeline() (ray: ray.timeline / chrome-trace export role)
-        from collections import deque
-
         self._timeline = deque(maxlen=cfg.timeline_max_events)
         self._closed = False
 
@@ -398,6 +407,9 @@ class Runtime:
             self._run(_close(), timeout=5)
         except Exception:
             pass
+        from ray_tpu.util.profiling import dump_profile
+
+        dump_profile()
         self.store.close()
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=2)
@@ -592,8 +604,18 @@ class Runtime:
         if ev is None:
             ev = self._sync_get_tls.ev = threading.Event()
         for r in refs:
-            v = self._try_sync_get(r.object_id.binary(), deadline, ev)
+            oid = r.object_id.binary()
+            v = self._try_sync_get(oid, deadline, ev)
             if v is _SYNC_MISS:
+                # local shm hit: read directly on the caller thread — the
+                # arena is process-shared-mutex guarded, deserialize is
+                # pure, so no io-loop round trip is needed (ray: plasma
+                # client reads mmap'd objects without the core worker)
+                if oid not in self.result_futures:
+                    value, found = self._read_from_store(oid)
+                    if found:
+                        out.append(value)
+                        continue
                 break
             out.append(v)
         if len(out) < len(refs):
@@ -1340,12 +1362,26 @@ class Runtime:
             # beyond current capacity
             want = (len(st.queue) + cap - 1) // cap
             have = len(st.leases) + st.requests_inflight
-            for _ in range(min(want - have, 8)):
-                st.requests_inflight += 1
-                self._loop.create_task(
-                    self._acquire_lease(class_key, resources, strategy)
-                )
+            if want > have:
+                st.cancel_sent = False
+                for _ in range(min(want - have, 8)):
+                    st.requests_inflight += 1
+                    self._loop.create_task(
+                        self._acquire_lease(class_key, resources, strategy)
+                    )
         else:
+            # demand drained: cancel requests still parked at the GCS —
+            # left alone, every freed slot would be granted to a parked
+            # request, bounced back after the reuse grace, granted to the
+            # next one, ... serially starving other classes/PGs for
+            # grace × parked seconds (ray: CancelWorkerLease)
+            if st.requests_inflight and not st.cancel_sent:
+                st.cancel_sent = True
+                self._spawn(
+                    self.gcs.notify(
+                        "cancel_lease_requests", {"tags": [st.tag]}
+                    )
+                )
             # idle leases (including ones granted after the queue drained)
             # go back to the GCS after a short reuse grace
             for lease in st.leases:
@@ -1362,6 +1398,7 @@ class Runtime:
                         {
                             "resources": resources,
                             "strategy": strategy,
+                            "tag": st.tag,
                             "runtime_env": self._class_runtime_envs.get(
                                 class_key
                             ),
@@ -1376,15 +1413,20 @@ class Runtime:
                     if "LEASE_PENDING" in str(e.remote_exception) and st.queue:
                         continue
                     raise
-            conn = await self._connect_worker(grant["worker_addr"])
-            lease = Lease(
-                lease_id=grant["lease_id"],
-                worker_addr=grant["worker_addr"],
-                worker_id=grant["worker_id"],
-                node_id=grant["node_id"],
-                conn=conn,
-            )
-            st.leases.append(lease)
+            if grant.get("cancelled"):
+                # demand drained while parked — no lease; the pump below
+                # re-requests if demand reappeared since the cancel
+                pass
+            else:
+                conn = await self._connect_worker(grant["worker_addr"])
+                lease = Lease(
+                    lease_id=grant["lease_id"],
+                    worker_addr=grant["worker_addr"],
+                    worker_id=grant["worker_id"],
+                    node_id=grant["node_id"],
+                    conn=conn,
+                )
+                st.leases.append(lease)
         except Exception as e:
             # fail queued tasks if the demand is infeasible
             if st.queue and isinstance(e, rpc.RemoteCallError):
@@ -1835,8 +1877,6 @@ class Runtime:
         return refs
 
     def _enqueue_actor_task(self, task: PendingTask):
-        from collections import deque
-
         aid = task.spec["actor_id"]
         st = self._actor_states.get(aid)
         if st is None:

@@ -30,6 +30,7 @@ from ray_tpu.common.ids import ActorID, NodeID, WorkerID
 from ray_tpu.core import rpc
 from ray_tpu.core.errors import TaskCancelledError, TaskError
 from ray_tpu.core.runtime import Runtime, set_runtime
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -64,13 +65,14 @@ class WorkerServer:
         # method name -> [fast_streak, demoted]
         self._method_stats: Dict[str, list] = {}
         self._sync_exec_inflight = 0  # sync methods currently on the pool
+        self._exec_counts = [0, 0]    # [inline runs, pool runs] (status RPC)
         # in-flight streaming generator tasks: task_id -> credit state
         self._out_streams: Dict[bytes, dict] = {}
 
     _REPLY_CACHE_PER_CALLER = 256
-    _INLINE_AFTER = 10       # consecutive sub-threshold runs to promote
-    _INLINE_FAST_S = 0.002   # "fast" means under 2 ms
-    _INLINE_DEMOTE_S = 0.05  # one run this long bans inline for good
+    _INLINE_AFTER = 10        # samples before a method may promote
+    _INLINE_EMA_S = 0.005     # stay inline while the exec-time EMA is under
+    _INLINE_DEMOTE_S = 0.05   # one run this long bans inline for good
 
     async def start(self):
         await self.server.start()
@@ -134,6 +136,10 @@ class WorkerServer:
                 if self.actor_instance is not None
                 else None,
                 "running_tasks": list(self._running_tasks.values()),
+                "exec_counts": {
+                    "inline": self._exec_counts[0],
+                    "pool": self._exec_counts[1],
+                },
             }
         raise rpc.RpcError(f"worker: unknown method {method!r}")
 
@@ -171,16 +177,18 @@ class WorkerServer:
         except Exception as e:
             return self._error_reply(e, spec)
         self._sync_exec_inflight += 1
-        t0 = time.perf_counter()
         try:
+            # the streak is noted inside _execute_sync with PURE execution
+            # time (queue wait excluded): pure time is what an inline run
+            # would cost the loop, and for a serial executor the pool can
+            # never overlap — so pipelined windows must still be able to
+            # promote (r4 regression: queue-wait-inclusive timing kept
+            # every windowed call on the pool forever)
             reply = await asyncio.get_running_loop().run_in_executor(
                 self._exec, self._execute_sync, fn, args, kwargs, spec
             )
         finally:
             self._sync_exec_inflight -= 1
-        # executor timing includes queue wait: under contention the
-        # streak resets, exactly when staying on the pool is right
-        self._note_method_time(key, time.perf_counter() - t0)
         return reply
 
     def _maybe_execute_task_inline(self, fn, key: str, spec):
@@ -192,7 +200,10 @@ class WorkerServer:
         if self._sync_exec_inflight:
             return None
         st = self._method_stats.get(key)
-        if st is None or st[1] or st[0] < self._INLINE_AFTER:
+        if (
+            st is None or st[1] or st[0] < self._INLINE_AFTER
+            or st[2] >= self._INLINE_EMA_S
+        ):
             return None
         try:
             unpacked = self.rt.unpack_args_sync(spec["args"])
@@ -208,11 +219,18 @@ class WorkerServer:
             self._cancelled.discard(tid)
             return self._error_reply(TaskCancelledError("cancelled"), spec)
         t0_wall = time.time()
+        # time ONLY fn(): all four note sites (inline + pool, task +
+        # actor) must measure the same quantity or the EMA flaps between
+        # promote and demote for methods with expensive serialization;
+        # noted in a finally so slow RAISING runs demote/ban too
         t0 = time.perf_counter()
         try:
             args, kwargs = unpacked
-            with _maybe_execute_span(spec):
-                result = fn(*args, **kwargs)
+            try:
+                with _maybe_execute_span(spec):
+                    result = fn(*args, **kwargs)
+            finally:
+                self._note_method_time(key, time.perf_counter() - t0)
             reply = self._exec_pack(spec, result)
             # exec span for the timeline, both reply shapes (promoted
             # fns must not vanish from dashboards)
@@ -229,7 +247,6 @@ class WorkerServer:
             )
         finally:
             self._cancelled.discard(tid)
-        self._note_method_time(key, time.perf_counter() - t0)
         return reply
 
     def _execute_sync(self, fn, args, kwargs, spec) -> dict:
@@ -245,8 +262,16 @@ class WorkerServer:
         }
         try:
             t0 = time.time()
-            with _maybe_execute_span(spec):
-                result = fn(*args, **kwargs)
+            t0p = time.perf_counter()
+            try:
+                with _maybe_execute_span(spec):
+                    result = fn(*args, **kwargs)
+            finally:
+                # finally: slow raising runs must demote/ban too
+                self._note_method_time(
+                    "task:" + spec["fn_hash"].hex(),
+                    time.perf_counter() - t0p,
+                )
             reply = self._exec_pack(spec, result)
             if type(reply) is tuple:  # compact ("i", payload) fast shape
                 return (reply[0], reply[1], t0, time.time())
@@ -671,24 +696,23 @@ class WorkerServer:
                 reply = None if cg else self._maybe_execute_inline(
                     method, spec
                 )
-                if reply is None:
+                if reply is not None:
+                    self._exec_counts[0] += 1
+                else:
                     pool = (
                         cg["pool"] if cg
                         else self._actor_thread_pool or self._exec
                     )
-                    mname = spec["method"]
+                    self._exec_counts[1] += 1
                     self._sync_exec_inflight += 1
-                    t0 = time.perf_counter()
                     try:
+                        # streak noted inside _execute_sync_method with
+                        # PURE execution time — see handle_push_task
                         reply = await asyncio.get_running_loop().run_in_executor(
                             pool, self._execute_sync_method, method, spec
                         )
                     finally:
                         self._sync_exec_inflight -= 1
-                    # executor timing includes queue wait: under
-                    # contention the streak resets, which is exactly when
-                    # we want to stay on the pool (overlap > latency)
-                    self._note_method_time(mname, time.perf_counter() - t0)
         except BaseException as e:
             reply = self._error_reply(
                 e if isinstance(e, Exception) else RuntimeError(repr(e)), spec
@@ -713,19 +737,23 @@ class WorkerServer:
         the executor's two context switches.  Inline is taken only when it
         cannot be observed: the actor is serial (no thread pool), nothing
         is running on the executor (so executions can't overlap), the args
-        are ref-free (resolving a ref needs the loop), and the method has
-        a streak of sub-2ms runs behind it.  First calls always go through
-        the pool, so a blocking method never runs inline.  The tail risk —
-        a promoted method whose NEXT run turns slow blocks the loop for
-        that one run, and cancellation cannot interrupt it — is bounded by
-        demotion: any run past _INLINE_DEMOTE_S (50 ms) bans the method
-        from inline permanently, and a merely-slow run resets the streak.
+        are ref-free (resolving a ref needs the loop), and the method's
+        recent-execution-time EMA is under _INLINE_EMA_S.  First calls
+        always go through the pool, so a blocking method never runs
+        inline.  The tail risk — a promoted method whose NEXT run turns
+        slow blocks the loop for that one run, and cancellation cannot
+        interrupt it — is bounded by demotion: any run past
+        _INLINE_DEMOTE_S (50 ms) bans the method from inline permanently,
+        and a sustained slowdown drags the EMA over the bar.
         Returns None when the pool must be used."""
         if self._actor_thread_pool is not None or self._sync_exec_inflight:
             return None
         mname = spec["method"]
         st = self._method_stats.get(mname)
-        if st is None or st[1] or st[0] < self._INLINE_AFTER:
+        if (
+            st is None or st[1] or st[0] < self._INLINE_AFTER
+            or st[2] >= self._INLINE_EMA_S
+        ):
             return None
         unpacked = self.rt.unpack_args_sync(spec["args"])
         if unpacked is None:
@@ -734,10 +762,18 @@ class WorkerServer:
         if tid in self._cancelled:
             self._cancelled.discard(tid)
             return self._error_reply(TaskCancelledError("cancelled"), spec)
-        t0 = time.perf_counter()
         try:
             args, kwargs = unpacked
-            reply = self._exec_pack(spec, method(*args, **kwargs))
+            # time ONLY the method call (matches the pool path's
+            # estimator — timing pack here too made the EMA disagree
+            # between paths and flap promote/demote); noted in a finally
+            # so slow raising runs demote/ban as well
+            t0 = time.perf_counter()
+            try:
+                result = method(*args, **kwargs)
+            finally:
+                self._note_method_time(mname, time.perf_counter() - t0)
+            reply = self._exec_pack(spec, result)
         except TaskCancelledError as e:
             reply = self._error_reply(e, spec)
         except BaseException as e:
@@ -746,19 +782,23 @@ class WorkerServer:
             )
         finally:
             self._cancelled.discard(tid)
-        self._note_method_time(mname, time.perf_counter() - t0)
         return reply
 
     def _note_method_time(self, mname: str, dt: float):
+        # [samples, banned, ema].  An EMA (not a consecutive-fast streak)
+        # so one OS-preemption spike — routine on a loaded host, and the
+        # r4 regression: a single >2ms measurement de-promoted the method
+        # and locked pipelined windows onto the pool — cannot flip a
+        # genuinely fast method back to the executor.  A single run past
+        # the demote bound still bans inline outright.
         st = self._method_stats.get(mname)
         if st is None:
-            st = self._method_stats[mname] = [0, False]
-        if dt < self._INLINE_FAST_S:
-            st[0] += 1
+            st = self._method_stats[mname] = [1, False, dt]
         else:
-            st[0] = 0
-            if dt > self._INLINE_DEMOTE_S:
-                st[1] = True
+            st[0] += 1
+            st[2] += 0.125 * (dt - st[2])
+        if dt > self._INLINE_DEMOTE_S:
+            st[1] = True
 
     def _execute_sync_method(self, method, spec) -> dict:
         tid = spec["task_id"]
@@ -776,8 +816,15 @@ class WorkerServer:
             if unpacked is None:  # ObjectRef args: resolve on the io loop
                 unpacked = self.rt._run(self.rt.unpack_args(spec["args"]))
             args, kwargs = unpacked
-            with _maybe_execute_span(spec):
-                result = method(*args, **kwargs)
+            t0p = time.perf_counter()
+            try:
+                with _maybe_execute_span(spec):
+                    result = method(*args, **kwargs)
+            finally:
+                # finally: slow raising runs must demote/ban too
+                self._note_method_time(
+                    spec["method"], time.perf_counter() - t0p
+                )
             return self._exec_pack(spec, result)
         except TaskCancelledError as e:
             return self._error_reply(e, spec)
@@ -795,8 +842,6 @@ def _maybe_execute_span(spec):
     """Execute-side span parented under the submitter's context (the
     TaskSpec's trace_ctx carrier); a no-op context when tracing is off
     or the caller sent no context."""
-    from ray_tpu.util import tracing
-
     if tracing.enabled() and spec.get("trace_ctx"):
         return tracing.span(
             f"execute {spec.get('method') or spec.get('name') or 'task'}",
@@ -808,6 +853,9 @@ def _maybe_execute_span(spec):
 
 def _exit_soon():
     time.sleep(0.1)
+    from ray_tpu.util.profiling import dump_profile
+
+    dump_profile()
     os._exit(0)
 
 
@@ -868,6 +916,16 @@ def main():
         return raylet_conn
 
     rt.connect()
+    if os.environ.get("RT_PROFILE_DIR"):
+        # profiled runs: SIGTERM (raylet teardown) must still dump
+        import signal
+        from ray_tpu.util.profiling import dump_profile as _dump
+
+        def _term(_sig, _frm):
+            _dump()
+            os._exit(0)
+
+        signal.signal(signal.SIGTERM, _term)
     raylet_conn = asyncio.run_coroutine_threadsafe(boot(), rt._loop).result(30)
 
     # Block the main thread forever; exit when the raylet connection drops
@@ -877,6 +935,9 @@ def main():
             time.sleep(1.0)
     except KeyboardInterrupt:
         pass
+    from ray_tpu.util.profiling import dump_profile
+
+    dump_profile()
     os._exit(0)
 
 
