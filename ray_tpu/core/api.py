@@ -37,6 +37,7 @@ def init(
     object_store_bytes: int = 0,
     session_dir: Optional[str] = None,
     labels: Optional[dict] = None,
+    log_to_driver: bool = True,
 ) -> dict:
     """Start (or connect to) a cluster and attach this process as a driver.
 
@@ -44,6 +45,10 @@ def init(
     reference's `ray.init()` standalone mode.  With an address (`host:port`
     of the GCS): connects to the existing cluster and uses a raylet on this
     host.
+
+    ``log_to_driver`` (default True, like the reference): every print /
+    stderr write inside tasks and actors of THIS job is streamed back and
+    printed here with a ``(pid=..., node=...)`` prefix.
     """
     global _node_group
     if is_initialized():
@@ -90,6 +95,15 @@ def init(
             _node_group = None
         raise
     set_runtime(rt)
+    if log_to_driver:
+        from ray_tpu.core import log_streaming
+
+        rt.subscribe(
+            "worker_logs",
+            log_streaming.make_driver_printer(
+                rt.job_id.hex() if rt.job_id else None
+            ),
+        )
     return {
         "gcs_address": gcs_addr,
         "node_id": node_id,

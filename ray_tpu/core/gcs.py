@@ -489,7 +489,7 @@ _READONLY_RPCS = frozenset({
     "metrics_push", "get_metrics", "get_job_info", "get_job_logs",
     "list_jobs", "list_events", "report_event", "get_worker_death_info",
     "cluster_store_stats", "dump_worker_stacks", "cancel_lease_requests",
-    "dump_tasks",
+    "dump_tasks", "publish",
 })
 
 
@@ -986,6 +986,13 @@ class GcsServer:
                 await conn.notify("publish", {"channel": channel, "message": message})
             except Exception:
                 pass
+
+    async def rpc_publish(self, conn, p):
+        """Client-initiated publish (worker log streaming rides this;
+        reference role: log_monitor -> GCS pubsub -> driver print_logs,
+        python/ray/_private/log_monitor.py:103)."""
+        await self.publish(p["channel"], p["message"])
+        return True
 
     async def rpc_subscribe(self, conn, p):
         self.subscribers.setdefault(p["channel"], set()).add(conn)

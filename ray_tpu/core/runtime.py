@@ -244,6 +244,11 @@ class Runtime:
         self._lineage: Dict[bytes, dict] = {}          # task_id -> entry
         self._lineage_by_return: Dict[bytes, bytes] = {}  # oid -> task_id
 
+        # pubsub: channel -> callback (driver log streaming rides this)
+        self._subscriptions: Dict[str, Any] = {}
+        # job attribution for log streaming: drivers use job_id; workers
+        # learn it from executed task specs (nested submissions inherit)
+        self._current_job_hex: Optional[str] = None
         self._serialization = ser.SerializationContext()
         self._serialization.register_reducer(ObjectRef, self._reduce_ref)
         self._nested_ref_sink = threading.local()
@@ -358,10 +363,39 @@ class Runtime:
                 "register_job",
                 {"pid": os.getpid(), "job_id": self.job_id.binary()},
             )
+        for channel in list(self._subscriptions):
+            await conn.call("subscribe", {"channel": channel})
+
+    def _job_hex(self) -> Optional[str]:
+        """Job attribution for specs: the driver's own job, or (in a
+        worker) the job of the task that last ran here."""
+        if self.job_id is not None:
+            return self.job_id.hex()
+        return self._current_job_hex
+
+    def subscribe(self, channel: str, callback) -> None:
+        """Register a pubsub callback (runs on the io loop) and subscribe
+        at the GCS; survives GCS restarts via _reattach_gcs."""
+        self._subscriptions[channel] = callback
+        self._run(self.gcs.call("subscribe", {"channel": channel}))
+
+    def publish(self, channel: str, message: dict) -> None:
+        """Fire-and-forget publish from any thread."""
+        self._spawn(
+            self.gcs.notify("publish", {"channel": channel, "message": message})
+        )
 
     async def _gcs_handler(self, conn, method, payload):
         # GCS-initiated pushes (actor restarts target workers; pubsub)
         if method == "publish":
+            cb = self._subscriptions.get(payload.get("channel"))
+            if cb is not None:
+                try:
+                    cb(payload["message"])
+                except Exception:
+                    logger.exception(
+                        "pubsub callback for %r failed", payload.get("channel")
+                    )
             return True
         if method == "exit_worker":
             logger.info("worker told to exit: %s", payload.get("reason"))
@@ -1208,6 +1242,7 @@ class Runtime:
             "num_returns": num_returns,
             "resources": resources,
             "caller_id": self.worker_id.binary(),
+            "job": self._job_hex(),
         }
         if streaming:
             spec["streaming"] = True
@@ -1688,6 +1723,7 @@ class Runtime:
             "cls_hash": cls_hash,
             "args": self._pack_args(args, kwargs),
             "max_task_retries": max_task_retries,
+            "job": self._job_hex(),
         }
         if max_concurrency is not None:
             creation_spec["max_concurrency"] = int(max_concurrency)
@@ -1842,6 +1878,7 @@ class Runtime:
             "args": self._pack_args(args, kwargs),
             "num_returns": num_returns,
             "caller_id": self.worker_id.binary(),
+            "job": self._job_hex(),
             # seq/seq_epoch are assigned at push time by the actor pump
         }
         if tracing.enabled():

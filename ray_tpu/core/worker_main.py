@@ -145,6 +145,9 @@ class WorkerServer:
 
     # ---- normal tasks --------------------------------------------------
     async def handle_push_task(self, spec, conn=None) -> dict:
+        if spec.get("job"):
+            # log-streaming attribution + nested submissions inherit it
+            self.rt._current_job_hex = spec["job"]
         try:
             fn = await self.rt.resolve_fn(spec["fn_hash"])
         except Exception as e:
@@ -506,6 +509,13 @@ class WorkerServer:
                     thread_name_prefix=f"actor-cg-{gname}",
                 ),
             }
+        if spec.get("job"):
+            self.rt._current_job_hex = spec["job"]
+        from ray_tpu.core import log_streaming
+
+        if log_streaming._publisher is not None:
+            # driver-side log prefix becomes "(ClassName pid=..., ...)"
+            log_streaming._publisher.set_actor_name(cls.__name__)
         loop = asyncio.get_running_loop()
         self.actor_instance = await loop.run_in_executor(
             self._exec, lambda: cls(*args, **kwargs)
@@ -533,6 +543,8 @@ class WorkerServer:
         seq = spec.get("seq")
         epoch = spec.get("seq_epoch", 0)
         tid = spec["task_id"]
+        if spec.get("job"):
+            self.rt._current_job_hex = spec["job"]
         cs = self._callers.get(caller)
         if cs is None:
             cs = self._callers[caller] = {
@@ -901,6 +913,9 @@ def main():
         worker_id=worker_id,
     )
     set_runtime(rt)
+    from ray_tpu.core import log_streaming
+
+    log_streaming.install_worker_tee(rt)
     server = WorkerServer(rt)
     rt._worker_server = server
 

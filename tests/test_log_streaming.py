@@ -1,0 +1,73 @@
+"""Worker -> driver log streaming (reference:
+python/ray/_private/log_monitor.py:103 + driver print_logs in
+python/ray/_private/worker.py): a print() inside a task or actor method
+must appear on the DRIVER's stdout, prefixed with (pid=..., node=...).
+
+Runs the driver in a subprocess so the assertion covers real process
+stdout, not a monkeypatched stream.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+DRIVER = textwrap.dedent("""
+    import sys
+    import time
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, num_tpus=0, log_to_driver={log_to_driver})
+
+    @ray_tpu.remote
+    def talk():
+        print("hello-from-task")
+        print("oops-from-task", file=sys.stderr)
+        return True
+
+    @ray_tpu.remote
+    class Talker:
+        def speak(self):
+            print("hello-from-actor")
+            return True
+
+    ray_tpu.get(talk.remote(), timeout=60)
+    a = Talker.remote()
+    ray_tpu.get(a.speak.remote(), timeout=60)
+    # streaming is batched (~100ms flush): give the lines time to land
+    time.sleep(1.0)
+    ray_tpu.shutdown()
+    print("DRIVER-DONE")
+""")
+
+
+def _run_driver(log_to_driver: bool):
+    proc = subprocess.run(
+        [sys.executable, "-c", DRIVER.format(log_to_driver=log_to_driver)],
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DRIVER-DONE" in proc.stdout
+    return proc
+
+
+def test_task_and_actor_prints_reach_driver_stdout():
+    proc = _run_driver(True)
+    out_lines = [l for l in proc.stdout.splitlines() if "hello-from" in l]
+    task_lines = [l for l in out_lines if "hello-from-task" in l]
+    actor_lines = [l for l in out_lines if "hello-from-actor" in l]
+    assert task_lines, proc.stdout[-2000:]
+    assert actor_lines, proc.stdout[-2000:]
+    # (pid=..., node=...) prefix, actor lines carry the class name
+    assert "pid=" in task_lines[0] and "node=" in task_lines[0]
+    assert "Talker" in actor_lines[0]
+    # stderr prints route to the driver's stderr
+    assert any("oops-from-task" in l for l in proc.stderr.splitlines())
+
+
+def test_log_to_driver_false_opts_out():
+    proc = _run_driver(False)
+    assert "hello-from-task" not in proc.stdout
+    assert "hello-from-actor" not in proc.stdout
