@@ -38,6 +38,7 @@ class Trial:
     error: Optional[str] = None
     early_stopped: bool = False
     actor: Any = None
+    num_failures: int = 0  # crashes absorbed so far (FailureConfig)
 
     @property
     def last_result(self) -> Dict[str, Any]:
@@ -56,11 +57,16 @@ class TuneController:
         experiment_name: str = "tune",
         searcher=None,
         trial_factory: Optional[Callable[[Dict[str, Any]], Trial]] = None,
+        max_failures: int = 0,
     ):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or FIFOScheduler()
         self.max_concurrent = max_concurrent  # 0 = unlimited
+        # trial-level fault tolerance (reference: FailureConfig.max_failures,
+        # python/ray/air/config.py:399-409): a crashed trial is relaunched
+        # from its latest checkpoint up to this many times; < 0 = forever
+        self.max_failures = max_failures
         self.experiment_dir = experiment_dir
         self.experiment_name = experiment_name
         # sequential search (TPE etc.): trials are created on demand from
@@ -174,7 +180,26 @@ class TuneController:
                 try:
                     report = ray_tpu.get(ref, timeout=60)
                 except (TaskError, ActorDiedError) as e:
-                    self._finalize(trial, ERROR, str(e))
+                    if (
+                        self.max_failures < 0
+                        or trial.num_failures < self.max_failures
+                    ):
+                        # restore: relaunch from the trial's latest
+                        # checkpoint (possibly on a different node) and
+                        # keep polling — the trainable resumes via
+                        # session.get_checkpoint(), like gang restart
+                        trial.num_failures += 1
+                        if trial.actor is not None:
+                            try:
+                                ray_tpu.kill(trial.actor)
+                            except Exception:
+                                pass
+                            trial.actor = None
+                        self._launch(trial, from_checkpoint=trial.checkpoint)
+                        nref = trial.actor.next_report.remote(timeout=30.0)
+                        outstanding[nref] = trial
+                    else:
+                        self._finalize(trial, ERROR, str(e))
                     continue
                 if report is None:  # loop finished cleanly
                     self._finalize(trial, TERMINATED)

@@ -199,6 +199,7 @@ class Tuner:
                 return Trial(trial_id=tid, config=cfg,
                              resources=dict(resources))
 
+        fc = self.run_config.failure_config
         controller = TuneController(
             self._resolve_trainable(),
             trials,
@@ -208,6 +209,7 @@ class Tuner:
             experiment_name=name,
             searcher=searcher,
             trial_factory=trial_factory,
+            max_failures=fc.max_failures if fc is not None else 0,
         )
         controller.run()
         trials = controller.trials

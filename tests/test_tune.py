@@ -83,6 +83,76 @@ class TestTuner:
         best = grid.get_best_result(metric="score", mode="max")
         assert best.metrics["score"] == 2
 
+    def test_failure_config_restores_crashed_trial(self, cluster, tmp_path):
+        """FailureConfig.max_failures (ray: python/ray/air/config.py:399):
+        a trial whose ACTOR dies mid-run is relaunched from its latest
+        checkpoint and the experiment still completes with the right
+        best result."""
+        import os
+
+        from ray_tpu.train import Checkpoint, FailureConfig, RunConfig
+
+        def objective(config):
+            start = 1
+            ckpt = tune.get_checkpoint()
+            if ckpt is not None:
+                start = ckpt.to_dict()["iter"] + 1
+            for i in range(start, 6):
+                if config["x"] == 2 and i == 3 and ckpt is None:
+                    os._exit(1)  # hard-kill the trial actor mid-run
+                tune.report(
+                    {"score": config["x"] * 10 + i, "iter": i},
+                    checkpoint=Checkpoint.from_dict({"iter": i}),
+                )
+
+        grid = Tuner(
+            objective,
+            param_space={"x": tune.grid_search([1, 2, 3])},
+            tune_config=TuneConfig(metric="score", mode="max"),
+            run_config=RunConfig(
+                name="trial_ft",
+                storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=2),
+            ),
+        ).fit()
+        assert not grid.errors, [str(e) for e in grid.errors]
+        best = grid.get_best_result(metric="score", mode="max")
+        assert best.metrics["score"] == 35  # x=3 ran all 5 iters
+        # the crashed trial resumed from its iter-2 checkpoint, not from
+        # scratch (a restart-from-scratch would re-crash at iter 3)
+        crashed = next(t for t in grid._trials if t.config["x"] == 2)
+        assert crashed.num_failures == 1
+        assert crashed.last_result["iter"] == 5
+        iters = [r["iter"] for r in crashed.results]
+        # iters 1-2 from the first run, 3-5 after restore
+        assert iters == [1, 2, 3, 4, 5]
+
+    def test_failure_config_exhausted_marks_error(self, cluster, tmp_path):
+        import os
+
+        from ray_tpu.train import FailureConfig, RunConfig
+
+        def objective(config):
+            if config["x"] == 1:
+                os._exit(1)  # crashes on every attempt
+            tune.report({"score": config["x"]})
+
+        grid = Tuner(
+            objective,
+            param_space={"x": tune.grid_search([0, 1, 2])},
+            tune_config=TuneConfig(metric="score", mode="max"),
+            run_config=RunConfig(
+                name="trial_ft_exhaust",
+                storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1),
+            ),
+        ).fit()
+        assert len(grid.errors) == 1
+        crashed = next(t for t in grid._trials if t.config["x"] == 1)
+        assert crashed.num_failures == 1  # one restore attempt, then ERROR
+        best = grid.get_best_result(metric="score", mode="max")
+        assert best.metrics["score"] == 2
+
     def test_asha_stops_bad_trials(self, cluster, tmp_path):
         from ray_tpu.train import RunConfig
 
