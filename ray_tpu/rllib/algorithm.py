@@ -36,6 +36,15 @@ class AlgorithmConfig:
     # extra model-catalog options (conv_filters, hidden, ...); the
     # catalog picks CNN vs MLP from the (post-connector) obs shape
     model_config: Optional[dict] = None
+    # -- evaluation (ray: rllib/algorithms/algorithm.py:954 evaluate() +
+    # evaluation_interval / evaluation_duration on AlgorithmConfig) --
+    # every N train() iterations a SEPARATE EnvRunnerGroup rolls the
+    # current weights greedily; None/0 disables periodic evaluation
+    # (evaluate() can still be called directly)
+    evaluation_interval: Optional[int] = None
+    evaluation_duration: int = 10  # episodes per evaluation
+    evaluation_num_env_runners: int = 1
+    evaluation_greedy: bool = True  # argmax actions (else sample policy)
 
     algo_class = None  # set by subclasses
 
@@ -67,6 +76,30 @@ class AlgorithmConfig:
 
     def learners(self, num_learners: int):
         return dataclasses.replace(self, num_learners=num_learners)
+
+    def evaluation(
+        self,
+        evaluation_interval=None,
+        evaluation_duration=None,
+        evaluation_num_env_runners=None,
+        evaluation_greedy=None,
+    ):
+        out = self
+        if evaluation_interval is not None:
+            out = dataclasses.replace(
+                out, evaluation_interval=evaluation_interval
+            )
+        if evaluation_duration is not None:
+            out = dataclasses.replace(
+                out, evaluation_duration=evaluation_duration
+            )
+        if evaluation_num_env_runners is not None:
+            out = dataclasses.replace(
+                out, evaluation_num_env_runners=evaluation_num_env_runners
+            )
+        if evaluation_greedy is not None:
+            out = dataclasses.replace(out, evaluation_greedy=evaluation_greedy)
+        return out
 
     def build(self) -> "Algorithm":
         assert self.algo_class is not None, "config has no algo_class"
@@ -156,7 +189,90 @@ class Algorithm:
             "time_total_s": time.monotonic() - t0,
         }
         out.update(metrics)
+        interval = getattr(self.config, "evaluation_interval", None)
+        if interval and self.iteration % interval == 0:
+            out["evaluation"] = self.evaluate()
         return out
+
+    # -- evaluation (ray: Algorithm.evaluate, algorithm.py:954) ----------
+    def evaluate(self) -> Dict[str, Any]:
+        """Roll the CURRENT weights on a dedicated eval EnvRunnerGroup
+        (greedy by default) and report unbiased episode metrics —
+        training returns come from an exploring, mid-update policy and
+        overstate nothing so much as they understate convergence."""
+        c = self.config
+        group = self._ensure_eval_group()
+        group.sync_weights(self._eval_weights())
+        t0 = time.monotonic()
+        results = group.evaluate(
+            num_episodes=c.evaluation_duration,
+            greedy=getattr(c, "evaluation_greedy", True),
+        )
+        returns = np.concatenate([r["episode_returns"] for r in results])
+        lengths = np.concatenate([r["episode_lengths"] for r in results])
+        return {
+            "episode_return_mean": (
+                float(returns.mean()) if len(returns) else float("nan")
+            ),
+            "episode_return_min": (
+                float(returns.min()) if len(returns) else float("nan")
+            ),
+            "episode_return_max": (
+                float(returns.max()) if len(returns) else float("nan")
+            ),
+            "episode_len_mean": (
+                float(lengths.mean()) if len(lengths) else float("nan")
+            ),
+            "num_episodes": int(len(returns)),
+            "time_evaluation_s": time.monotonic() - t0,
+        }
+
+    def _ensure_eval_group(self):
+        group = getattr(self, "_eval_group", None)
+        if group is None:
+            from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+            c = self.config
+            if getattr(self, "module_config", None) is None:
+                raise RuntimeError(
+                    "evaluate() needs self.module_config and config.env "
+                    "(set up by single-agent algorithms)"
+                )
+            group = self._eval_group = EnvRunnerGroup(
+                c.env,
+                self.module_config,
+                num_runners=max(
+                    1, getattr(c, "evaluation_num_env_runners", 1)
+                ),
+                num_envs_per_runner=c.num_envs_per_runner,
+                seed=c.seed + 777_000,  # decorrelated from training envs
+                env_to_module_fn=c.env_to_module,
+            )
+        return group
+
+    def _eval_weights(self):
+        lg = getattr(self, "learner_group", None)
+        if lg is not None:
+            return lg.get_weights()
+        lr = getattr(self, "learner", None)
+        if lr is not None:
+            return lr.params
+        raise RuntimeError("no learner_group/learner to take weights from")
+
+    def _rollout_returns(self, num_steps: int, epsilon=None) -> np.ndarray:
+        """Shared step-bounded policy rollout on the TRAINING runner
+        group, feeding the episode_return_mean metric — the offline
+        algos' (CQL/MARWIL) only env contact during training.  Episode-
+        bounded, unbiased evaluation is evaluate() on the eval group."""
+        self.env_runner_group.sync_weights(self._eval_weights())
+        frags = self.env_runner_group.sample(num_steps, epsilon=epsilon)
+        ep_returns = (
+            np.concatenate([f["episode_returns"] for f in frags])
+            if frags
+            else np.zeros(0)
+        )
+        self._record_returns(ep_returns)
+        return ep_returns
 
     def _record_returns(self, episode_returns) -> None:
         self._recent_returns.extend(np.asarray(episode_returns).tolist())
@@ -185,6 +301,9 @@ class Algorithm:
         group = getattr(self, "env_runner_group", None)
         if group is not None:
             group.stop()
+        eval_group = getattr(self, "_eval_group", None)
+        if eval_group is not None:
+            eval_group.stop()
         lg = getattr(self, "learner_group", None)
         if lg is not None:
             lg.stop()

@@ -145,15 +145,9 @@ class CQL(Algorithm):
             stats = self.learner.update(batch)
             losses.append(float(stats["total_loss"]))
         learn_time = time.monotonic() - t0
-        # greedy rollout of the learned Q policy (epsilon 0)
-        self.env_runner_group.sync_weights(self.learner.params)
-        frags = self.env_runner_group.sample(
-            c.evaluation_num_steps, epsilon=0.0
-        )
-        ep_returns = np.concatenate(
-            [f["episode_returns"] for f in frags]
-        ) if frags else np.zeros(0)
-        self._record_returns(ep_returns)
+        # greedy rollout of the learned Q policy (epsilon 0); unified
+        # metric helper — episode-bounded eval is Algorithm.evaluate()
+        ep_returns = self._rollout_returns(c.evaluation_num_steps, epsilon=0.0)
         return {
             "total_loss": float(np.mean(losses)),
             "num_offline_samples": len(self.reader),

@@ -81,6 +81,49 @@ class EnvRunnerActor:
         self._params = params
         return True
 
+    def evaluate(
+        self,
+        num_episodes: int,
+        greedy: bool = True,
+        max_env_steps: int = 200_000,
+    ) -> Dict[str, np.ndarray]:
+        """Run until ``num_episodes`` episodes complete; greedy takes
+        argmax over the module's first head (policy logits or Q-values —
+        both maximize correctly), else samples the policy.  Meant for
+        DEDICATED eval runners (ray: evaluation EnvRunnerGroup,
+        algorithm.py:954): it advances this runner's env/connector state.
+        """
+        import jax
+
+        returns: List[float] = []
+        lengths: List[int] = []
+        ep_len = np.zeros(self._num_envs, np.int64)
+        steps = 0
+        while len(returns) < num_episodes and steps < max_env_steps:
+            if greedy:
+                head, _ = self._forward(self._params, self._proc)
+                action = np.argmax(np.asarray(head), axis=-1).astype(np.int32)
+            else:
+                self._rng, key = jax.random.split(self._rng)
+                a, _, _ = self._sample_fn(self._params, self._proc, key)
+                action = np.asarray(a)
+            self._obs, reward, term, trunc, _ = self._envs.step(action)
+            done = np.logical_or(term, trunc)
+            self._proc = self._process(self._obs)
+            self._prev_done |= done
+            self._ep_return += reward
+            ep_len += 1
+            steps += self._num_envs
+            for i in np.nonzero(done)[0]:
+                returns.append(float(self._ep_return[i]))
+                lengths.append(int(ep_len[i]))
+                self._ep_return[i] = 0.0
+                ep_len[i] = 0
+        return {
+            "episode_returns": np.asarray(returns, np.float64),
+            "episode_lengths": np.asarray(lengths, np.int64),
+        }
+
     def sample(
         self, num_steps: int, epsilon: Optional[float] = None
     ) -> Dict[str, np.ndarray]:
@@ -175,6 +218,17 @@ class EnvRunnerGroup:
         # in the runner; a dead runner fails the get with ActorDiedError.
         return ray_tpu.get(
             [r.sample.remote(num_steps, epsilon) for r in self.runners]
+        )
+
+    def evaluate(
+        self, num_episodes: int, greedy: bool = True
+    ) -> List[Dict[str, np.ndarray]]:
+        """Split the episode budget across runners (ceil per runner so
+        the total is >= num_episodes, like evaluation_duration)."""
+        n = len(self.runners)
+        per = max(1, -(-num_episodes // n))
+        return ray_tpu.get(
+            [r.evaluate.remote(per, greedy) for r in self.runners]
         )
 
     def sync_weights(self, params) -> None:

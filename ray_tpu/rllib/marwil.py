@@ -135,12 +135,9 @@ class MARWIL(Algorithm):
             stats = self.learner.update(batch)
             losses.append(float(stats["total_loss"]))
         learn_time = time.monotonic() - t0
-        self.env_runner_group.sync_weights(self.learner.params)
-        frags = self.env_runner_group.sample(c.evaluation_num_steps)
-        ep_returns = np.concatenate(
-            [f["episode_returns"] for f in frags]
-        ) if frags else np.zeros(0)
-        self._record_returns(ep_returns)
+        # policy rollout via the unified metric helper — episode-bounded
+        # eval is Algorithm.evaluate()
+        ep_returns = self._rollout_returns(c.evaluation_num_steps)
         return {
             "total_loss": float(np.mean(losses)),
             "adv_sq_moving_avg": self.learner.adv_sq_ma,

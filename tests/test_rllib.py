@@ -130,6 +130,36 @@ class TestPPOEndToEnd:
         # CartPole random policy averages ~20; PPO must clearly learn
         assert best >= 80, (first_return, best)
 
+    def test_evaluate_reports_separately(self, cluster):
+        """Algorithm.evaluate() (ray: rllib/algorithms/algorithm.py:954):
+        a dedicated greedy eval EnvRunnerGroup reports
+        evaluation/episode_return_mean distinct from training returns."""
+        config = (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                         rollout_fragment_length=32)
+            .training(lr=3e-3, num_epochs=3, minibatch_size=128)
+            .evaluation(evaluation_interval=2, evaluation_duration=6,
+                        evaluation_num_env_runners=1)
+        )
+        algo = config.build()
+        r1 = algo.train()
+        assert "evaluation" not in r1  # interval=2: not this iteration
+        r2 = algo.train()
+        ev = r2["evaluation"]
+        assert ev["num_episodes"] >= 6
+        assert np.isfinite(ev["episode_return_mean"])
+        assert ev["episode_return_min"] <= ev["episode_return_max"]
+        assert ev["episode_len_mean"] > 0
+        # the eval metric is produced by a separate greedy rollout, not
+        # copied from the training-side running mean
+        assert ev["episode_return_mean"] != r2["episode_return_mean"]
+        # direct call works too and uses the same dedicated group
+        direct = algo.evaluate()
+        assert direct["num_episodes"] >= 6
+        algo.stop()
+
     def test_save_restore(self, cluster, tmp_path):
         config = (
             PPOConfig()
