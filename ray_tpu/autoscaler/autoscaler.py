@@ -281,30 +281,46 @@ def main():
         "--node-type", action="append", default=[],
         help='"name=v5e_slice4;resources=CPU:8,TPU:4;min=0;max=8"',
     )
+    ap.add_argument(
+        "--cluster-config", default=None,
+        help="cluster.yaml (ray_tpu up): node types AND provider come "
+             "from the file; --node-type is ignored",
+    )
     args = ap.parse_args()
 
     logging.basicConfig(level=logging.INFO,
                         format="[autoscaler] %(levelname)s %(message)s")
 
-    node_types = []
-    for spec in args.node_type:
-        fields = dict(f.split("=", 1) for f in spec.split(";"))
-        resources = {
-            k: float(v)
-            for k, v in (kv.split(":") for kv in fields["resources"].split(","))
-        }
-        node_types.append(
-            NodeTypeConfig(
-                fields["name"],
-                resources,
-                int(fields.get("min", 0)),
-                int(fields.get("max", 100)),
-            )
+    if args.cluster_config:
+        from ray_tpu.autoscaler import launcher
+
+        ccfg = launcher.load_cluster_config(args.cluster_config)
+        node_types = launcher.node_type_configs(ccfg)
+        provider = launcher.build_provider(
+            ccfg, args.gcs, args.session_dir
         )
+    else:
+        node_types = []
+        for spec in args.node_type:
+            fields = dict(f.split("=", 1) for f in spec.split(";"))
+            resources = {
+                k: float(v)
+                for k, v in (
+                    kv.split(":") for kv in fields["resources"].split(",")
+                )
+            }
+            node_types.append(
+                NodeTypeConfig(
+                    fields["name"],
+                    resources,
+                    int(fields.get("min", 0)),
+                    int(fields.get("max", 100)),
+                )
+            )
 
-    from ray_tpu.autoscaler.node_provider import LocalSubprocessProvider
+        from ray_tpu.autoscaler.node_provider import LocalSubprocessProvider
 
-    provider = LocalSubprocessProvider(args.gcs, args.session_dir)
+        provider = LocalSubprocessProvider(args.gcs, args.session_dir)
     cfg = AutoscalerConfig(
         node_types=node_types,
         idle_timeout_s=args.idle_timeout,

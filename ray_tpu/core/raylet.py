@@ -108,6 +108,10 @@ class Raylet:
         # pip runtime envs: requirement-hash -> creation lock (venvs live
         # under session_dir/pip_envs; see _ensure_pip_env)
         self._pip_env_locks: Dict[str, asyncio.Lock] = {}
+        # set by SIGTERM or the shutdown_node RPC; main() awaits it and
+        # tears the node down (cluster launcher `down` uses the RPC to
+        # drain nodes it has no pid for, e.g. on other hosts)
+        self.stop_requested = asyncio.Event()
 
     # ---- lifecycle -----------------------------------------------------
     async def start(self):
@@ -401,6 +405,14 @@ class Raylet:
                 return f.read(length if length is not None else -1)
         except OSError:
             return None
+
+    async def rpc_shutdown_node(self, conn, p):
+        """Graceful remote shutdown (ray: `ray down` draining a node the
+        caller holds no pid for): main() observes stop_requested and runs
+        the same close() path SIGTERM takes — workers killed, arena
+        unlinked, node deregistered."""
+        self.stop_requested.set()
+        return True
 
     async def rpc_spill_now(self, conn, p):
         """Synchronous pressure relief: a client's create just failed."""
@@ -1233,9 +1245,6 @@ def main():
         # removal must not leak /dev/shm store files.  Installed BEFORE
         # start(): the parent can observe the node's GCS registration (made
         # inside start()) and send SIGTERM before this coroutine resumes.
-        stop = asyncio.Event()
-        asyncio.get_running_loop().add_signal_handler(signal.SIGTERM, stop.set)
-
         raylet = Raylet(
             gcs_address=args.gcs,
             node_id=NodeID.from_hex(args.node_id) if args.node_id else None,
@@ -1245,10 +1254,13 @@ def main():
             store_capacity=args.store_capacity,
             session_dir=args.session_dir,
         )
+        asyncio.get_running_loop().add_signal_handler(
+            signal.SIGTERM, raylet.stop_requested.set
+        )
         await raylet.start()
         print(f"RAYLET_ADDRESS={raylet.server.address}", flush=True)
         print(f"RAYLET_NODE_ID={raylet.node_id.hex()}", flush=True)
-        await stop.wait()
+        await raylet.stop_requested.wait()
         await raylet.close()
 
     try:

@@ -103,6 +103,30 @@ def cmd_stop(args) -> None:
     print(f"stopped {killed} control-plane processes")
 
 
+def cmd_up(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    state = launcher.up(args.config, wait_min_workers_s=args.wait)
+    print(f"cluster {state['cluster_name']!r} is up")
+    print(f"  GCS address: {state['gcs_address']}")
+    print(f"  session dir: {state['session_dir']}")
+    print(f"  monitor pid: {state['monitor_pid']}")
+    print(
+        f"connect with ray_tpu.init(address={state['gcs_address']!r}); "
+        f"tear down with `ray_tpu down {args.config}`"
+    )
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler import launcher
+
+    stats = launcher.down(args.config)
+    print(
+        f"cluster down: {stats['provider_nodes']} provider nodes removed, "
+        f"{stats['processes']} control-plane processes stopped"
+    )
+
+
 def _connect(args):
     import ray_tpu
 
@@ -211,6 +235,23 @@ def main(argv=None) -> None:
 
     p = sub.add_parser("stop", help="stop CLI-started nodes")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser(
+        "up", help="provision a cluster from cluster.yaml (head + "
+                   "autoscaler monitor + min_workers)",
+    )
+    p.add_argument("config", help="path to cluster.yaml")
+    p.add_argument(
+        "--wait", type=float, default=0.0,
+        help="block until min_workers are up (seconds)",
+    )
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser(
+        "down", help="tear a cluster down (provider nodes, monitor, head)"
+    )
+    p.add_argument("config", help="path to cluster.yaml")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("status", help="cluster summary")
     p.add_argument("--address")

@@ -1,0 +1,258 @@
+"""`ray_tpu up/down cluster.yaml` end to end against the GCE fixture.
+
+Role-parity test for ray: `ray up` (python/ray/scripts/scripts.py:1279,
+autoscaler/_private/commands.py:221).  The declared cluster comes up
+with ONE command — head (GCS + raylet), autoscaler monitor daemon, and
+min_workers TPU slices provisioned through the byte-asserting fixture
+GCE server; `down` drains every node, deletes every queued resource
+(including a pre-existing leaked one), and stops the control plane.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from ray_tpu.autoscaler import launcher
+
+PARENT = "projects/proj-1/locations/us-central2-b"
+QR = f"/v2/{PARENT}/queuedResources"
+NODE = f"/v2/{PARENT}/nodes"
+SLICE = "rt-v5litepod-8-1"
+
+CREATE_BODY = {
+    "tpu": {
+        "node_spec": [
+            {
+                "parent": PARENT,
+                "node_id": SLICE,
+                "node": {
+                    "accelerator_type": "v5litepod-8",
+                    "runtime_version": "tpu-ubuntu2204-base",
+                    "network_config": {
+                        "network": "default",
+                        "enable_external_ips": False,
+                    },
+                },
+            }
+        ]
+    },
+}
+
+QR_ROW = {
+    "name": f"{PARENT}/queuedResources/{SLICE}",
+    "state": {"state": "ACTIVE"},
+    "tpu": {"nodeSpec": [{"node": {"acceleratorType": "v5litepod-8"}}]},
+}
+LEAKED_ROW = {
+    # a slice some earlier crashed run left behind: down must delete it
+    "name": f"{PARENT}/queuedResources/leaked-slice",
+    "state": {"state": "ACTIVE"},
+    "tpu": {"nodeSpec": [{"node": {"acceleratorType": "v5litepod-8"}}]},
+}
+
+FIXTURES = {
+    ("POST", f"{QR}?queued_resource_id={SLICE}",
+     json.dumps(CREATE_BODY, sort_keys=True)): (200, {
+        "name": f"{PARENT}/queuedResources/{SLICE}",
+        "state": {"state": "ACCEPTED"},
+    }),
+    ("GET", f"{QR}/{SLICE}", None): [
+        (200, {
+            "name": f"{PARENT}/queuedResources/{SLICE}",
+            "state": {"state": "WAITING_FOR_RESOURCES"},
+            "tpu": {"nodeSpec": [{"node": {
+                "acceleratorType": "v5litepod-8"}}]},
+        }),
+        (200, QR_ROW),
+    ],
+    ("GET", f"{NODE}/{SLICE}", None): (200, {
+        "name": f"{PARENT}/nodes/{SLICE}",
+        "state": "READY",
+        "acceleratorType": "v5litepod-8",
+        "networkEndpoints": [
+            {"ipAddress": "10.164.0.7", "port": 8470},
+            {"ipAddress": "10.164.0.8", "port": 8470},
+        ],
+    }),
+    ("GET", QR, None): (200, {
+        "queuedResources": [QR_ROW, LEAKED_ROW],
+    }),
+    ("DELETE", f"{NODE}/{SLICE}", None): (200, {}),
+    ("DELETE", f"{QR}/{SLICE}", None): (200, {}),
+    ("DELETE", f"{NODE}/leaked-slice", None): (404, {"error": "gone"}),
+    ("DELETE", f"{QR}/leaked-slice", None): (200, {}),
+}
+
+
+class FixtureHandler(BaseHTTPRequestHandler):
+    requests_seen = []
+    fixtures = {}
+
+    def _serve(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length).decode() if length else None
+        type(self).requests_seen.append((self.command, self.path, body))
+        fx = type(self).fixtures.get((self.command, self.path, body))
+        if fx is None:
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(
+                f"unexpected: {(self.command, self.path, body)}".encode()
+            )
+            return
+        if isinstance(fx, list):
+            status, payload = fx.pop(0) if len(fx) > 1 else fx[0]
+        else:
+            status, payload = fx
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    do_GET = do_POST = do_DELETE = _serve
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def fixture_server():
+    import copy
+
+    FixtureHandler.requests_seen = []
+    FixtureHandler.fixtures = copy.deepcopy(FIXTURES)
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), FixtureHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        # reap if it's our zombie child (up() ran in this process)
+        os.waitpid(pid, os.WNOHANG)
+    except ChildProcessError:
+        pass
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            state = f.read().rsplit(") ", 1)[1].split()[0]
+        return state != "Z"
+    except (FileNotFoundError, IndexError):
+        return False
+
+
+def test_up_status_down_lifecycle(fixture_server, tmp_path):
+    cfg = {
+        "cluster_name": "launcher-e2e",
+        "provider": {
+            "type": "gce_tpu",
+            "project_id": "proj-1",
+            "zone": "us-central2-b",
+            "api_base_url": fixture_server,
+            "api_token": "tok-123",
+            "cpus_per_host": 1.0,
+            "poll_interval_s": 0.05,
+            "slice_ready_timeout_s": 30.0,
+        },
+        "head": {"resources": {"CPU": 2}},
+        "available_node_types": {
+            "v5litepod-8": {
+                "resources": {"CPU": 1},
+                "min_workers": 1,
+                "max_workers": 2,
+            },
+        },
+        "autoscaler_interval_s": 0.2,
+        "idle_timeout_s": 3600,
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+
+    state = launcher.up(str(path), wait_min_workers_s=120.0)
+    try:
+        assert launcher.load_state("launcher-e2e") is not None
+        # `status` view: head + 2 slice hosts registered at the GCS, the
+        # slice hosts carrying node-type/slice labels and TPU resources
+        nodes = launcher._query_nodes(state["gcs_address"])
+        alive = [n for n in nodes if n["alive"]]
+        heads = [
+            n for n in alive if (n.get("labels") or {}).get("ray_tpu.head")
+        ]
+        slice_hosts = [
+            n for n in alive
+            if (n.get("labels") or {}).get("ray_tpu.node_type")
+            == "v5litepod-8"
+        ]
+        assert len(heads) == 1
+        assert len(slice_hosts) == 2  # v5litepod-8 = 2 hosts x 4 chips
+        assert all(
+            n["resources_total"].get("TPU") == 4.0 for n in slice_hosts
+        )
+        # the fixture server really served the provisioning flow
+        posts = [
+            r for r in FixtureHandler.requests_seen if r[0] == "POST"
+        ]
+        assert len(posts) == 1
+        # double-up is refused while the state file exists
+        with pytest.raises(launcher.ClusterConfigError):
+            launcher.up(str(path))
+    finally:
+        stats = launcher.down(str(path))
+
+    # every queued resource is gone — including the pre-existing leak
+    deleted_qrs = {
+        r[1] for r in FixtureHandler.requests_seen if r[0] == "DELETE"
+    }
+    assert f"{QR}/{SLICE}" in deleted_qrs
+    assert f"{QR}/leaked-slice" in deleted_qrs
+    assert stats["provider_nodes"] >= 2
+    # control plane stopped, record removed
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and (
+        _pid_alive(state["monitor_pid"]) or _pid_alive(state["gcs_pid"])
+    ):
+        time.sleep(0.2)
+    assert not _pid_alive(state["monitor_pid"])
+    assert not _pid_alive(state["gcs_pid"])
+    assert launcher.load_state("launcher-e2e") is None
+
+
+def test_config_validation(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump({
+        "cluster_name": "x",
+        "provider": {"type": "nope"},
+        "available_node_types": {},
+    }))
+    with pytest.raises(launcher.ClusterConfigError):
+        launcher.load_cluster_config(str(bad))
+    bad.write_text(yaml.safe_dump({
+        "cluster_name": "x",
+        "provider": {"type": "gce_tpu"},  # missing project/zone
+        "available_node_types": {"t": {"resources": {"CPU": 1}}},
+    }))
+    with pytest.raises(launcher.ClusterConfigError):
+        launcher.load_cluster_config(str(bad))
+    bad.write_text(yaml.safe_dump({
+        "cluster_name": "x",
+        "provider": {"type": "local"},
+        "available_node_types": {
+            "t": {"resources": {"CPU": 1}, "min_workers": 5,
+                  "max_workers": 2},
+        },
+    }))
+    with pytest.raises(launcher.ClusterConfigError):
+        launcher.load_cluster_config(str(bad))
