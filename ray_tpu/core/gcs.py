@@ -1725,6 +1725,22 @@ class GcsServer:
             for info in self.submitted_jobs.values()
         ]
 
+    async def rpc_delete_job(self, conn, p):
+        """Drop a TERMINAL submitted job's record (reference:
+        DELETE /api/jobs/{id}, job_head.py:368 — running jobs must be
+        stopped first)."""
+        self._poll_submitted_jobs()
+        info = self.submitted_jobs.get(p["submission_id"])
+        if info is None:
+            return False
+        if info["status"] == RUNNING_JOB:
+            raise rpc.RpcError(
+                f"job {p['submission_id']!r} is RUNNING; stop it first"
+            )
+        del self.submitted_jobs[p["submission_id"]]
+        self._mark_dirty()
+        return True
+
     async def rpc_list_tasks(self, conn, p):
         """Cluster-wide live tasks: fan out to raylets → workers (ray:
         python/ray/util/state/api.py list_tasks, sourced live instead of

@@ -297,6 +297,18 @@ class DashboardActor:
                      "placement_groups"):
             app.router.add_get(f"/api/{name}", self._make_list(name))
         app.router.add_get("/api/jobs", self._jobs)
+        # REST job API (reference: dashboard/modules/job/job_head.py:273-380
+        # JobHead) — external tooling/CI submits without the Python SDK;
+        # thin handlers over the GCS job-manager RPCs
+        app.router.add_post("/api/jobs/", self._job_submit)
+        app.router.add_get("/api/jobs/{submission_id}", self._job_info)
+        app.router.add_get(
+            "/api/jobs/{submission_id}/logs", self._job_logs
+        )
+        app.router.add_post(
+            "/api/jobs/{submission_id}/stop", self._job_stop
+        )
+        app.router.add_delete("/api/jobs/{submission_id}", self._job_delete)
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/metrics", self._metrics_prometheus)
         app.router.add_get("/api/profile/stacks", self._profile_stacks)
@@ -372,6 +384,92 @@ class DashboardActor:
 
     async def _jobs(self, req):
         return self._json(await self._page_rows("jobs"))
+
+    # -- REST job API (ray: dashboard/modules/job/job_head.py:273-380) --
+
+    def _gcs_call(self, method, payload):
+        from ray_tpu.core.runtime import get_runtime
+
+        def call():
+            rt = get_runtime()
+            return rt._run(rt.gcs.call(method, payload))
+
+        return self._offload(call)
+
+    async def _job_submit(self, req):
+        from aiohttp import web
+
+        try:
+            body = await req.json()
+        except Exception:
+            return web.json_response(
+                {"error": "body must be JSON"}, status=400
+            )
+        if not body.get("entrypoint"):
+            return web.json_response(
+                {"error": "entrypoint is required"}, status=400
+            )
+        payload = {
+            "entrypoint": body["entrypoint"],
+            "submission_id": body.get("submission_id"),
+            "runtime_env": body.get("runtime_env"),
+            "metadata": body.get("metadata", {}),
+        }
+        try:
+            reply = await self._gcs_call("submit_job", payload)
+        except Exception as e:  # duplicate id, bad runtime env, ...
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(
+            {"submission_id": reply["submission_id"]}
+        )
+
+    async def _job_info(self, req):
+        from aiohttp import web
+
+        try:
+            info = await self._gcs_call(
+                "get_job_info",
+                {"submission_id": req.match_info["submission_id"]},
+            )
+        except Exception:  # GCS raises for unknown submission ids
+            return web.json_response({"error": "no such job"}, status=404)
+        return self._json(info)
+
+    async def _job_logs(self, req):
+        from aiohttp import web
+
+        try:
+            logs = await self._gcs_call(
+                "get_job_logs",
+                {"submission_id": req.match_info["submission_id"]},
+            )
+        except Exception:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response({"logs": logs})
+
+    async def _job_stop(self, req):
+        from aiohttp import web
+
+        ok = await self._gcs_call(
+            "stop_job", {"submission_id": req.match_info["submission_id"]}
+        )
+        if not ok:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response({"stopped": True})
+
+    async def _job_delete(self, req):
+        from aiohttp import web
+
+        try:
+            ok = await self._gcs_call(
+                "delete_job",
+                {"submission_id": req.match_info["submission_id"]},
+            )
+        except Exception as e:  # still RUNNING
+            return web.json_response({"error": str(e)}, status=400)
+        if not ok:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response({"deleted": True})
 
     async def _metrics(self, req):
         return self._json(await self._page_rows("metrics"))

@@ -1,6 +1,8 @@
 """Dashboard HTTP server tests (ray: dashboard/head.py + modules)."""
 
 import json
+import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -22,6 +24,20 @@ def _get(url, as_json=True):
     with urllib.request.urlopen(url, timeout=30) as r:
         body = r.read().decode()
     return json.loads(body) if as_json else body
+
+
+def _req(url, method, payload=None, timeout=60):
+    """curl-shaped helper: returns (status, parsed-JSON body)."""
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
 
 
 class TestDashboard:
@@ -149,3 +165,63 @@ class TestHtmlPages:
         assert "<script>alert(1)</script>" not in page
         assert "&lt;script&gt;" in page
         ray_tpu.kill(a)
+
+
+class TestRestJobApi:
+    """REST job endpoints (ray: dashboard/modules/job/job_head.py:273-380):
+    submit over HTTP, poll to SUCCEEDED, fetch logs, stop, delete —
+    external tooling needs no Python SDK."""
+
+    def test_submit_poll_logs_delete(self, dash_url):
+        status, body = _req(
+            f"{dash_url}/api/jobs/", "POST",
+            {"entrypoint": "echo rest-job-hello && echo done"},
+        )
+        assert status == 200, body
+        sub_id = body["submission_id"]
+
+        deadline = time.monotonic() + 60
+        info = None
+        while time.monotonic() < deadline:
+            status, info = _req(f"{dash_url}/api/jobs/{sub_id}", "GET")
+            assert status == 200
+            if info["status"] in ("SUCCEEDED", "FAILED", "STOPPED"):
+                break
+            time.sleep(0.3)
+        assert info["status"] == "SUCCEEDED", info
+
+        status, logs = _req(f"{dash_url}/api/jobs/{sub_id}/logs", "GET")
+        assert status == 200
+        assert "rest-job-hello" in logs["logs"]
+
+        status, body = _req(f"{dash_url}/api/jobs/{sub_id}", "DELETE")
+        assert status == 200 and body["deleted"]
+        status, _ = _req(f"{dash_url}/api/jobs/{sub_id}", "GET")
+        assert status == 404
+
+    def test_stop_running_job(self, dash_url):
+        status, body = _req(
+            f"{dash_url}/api/jobs/", "POST",
+            {"entrypoint": "sleep 600", "submission_id": "rest-sleeper"},
+        )
+        assert status == 200
+        # deleting a RUNNING job is refused
+        status, body = _req(f"{dash_url}/api/jobs/rest-sleeper", "DELETE")
+        assert status == 400
+        status, body = _req(
+            f"{dash_url}/api/jobs/rest-sleeper/stop", "POST"
+        )
+        assert status == 200 and body["stopped"]
+        status, info = _req(f"{dash_url}/api/jobs/rest-sleeper", "GET")
+        assert info["status"] == "STOPPED"
+        status, _ = _req(f"{dash_url}/api/jobs/rest-sleeper", "DELETE")
+        assert status == 200
+
+    def test_validation_and_404s(self, dash_url):
+        status, body = _req(f"{dash_url}/api/jobs/", "POST", {})
+        assert status == 400
+        assert "entrypoint" in body["error"]
+        assert _req(f"{dash_url}/api/jobs/nope", "GET")[0] == 404
+        assert _req(f"{dash_url}/api/jobs/nope/logs", "GET")[0] == 404
+        assert _req(f"{dash_url}/api/jobs/nope/stop", "POST")[0] == 404
+        assert _req(f"{dash_url}/api/jobs/nope", "DELETE")[0] == 404
