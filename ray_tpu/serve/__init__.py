@@ -25,6 +25,7 @@ from ray_tpu.serve.handle import (  # noqa: F401
     DeploymentResponse,
     DeploymentResponseGenerator,
 )
+from ray_tpu.serve.asgi import ingress  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.grpc_proxy import start_grpc_proxy  # noqa: F401
 from ray_tpu.serve.multiplex import (  # noqa: F401

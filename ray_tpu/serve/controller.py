@@ -54,6 +54,10 @@ class ServeControllerActor:
         # serve/_private/controller.py) — so a second driver or a driver
         # restart can't clobber routes installed by others.
         self._http_routes: Dict[str, tuple] = {}  # prefix -> (app, deployment)
+        # prefixes whose target class carries @serve.ingress (ASGI): the
+        # HTTP proxy dispatches those through the ASGI adapter instead of
+        # the method-call convention
+        self._asgi_prefixes: set = set()
         self._app_roots: Dict[str, str] = {}  # app -> ingress deployment
         self._routes_version = 0
         self._lock = threading.RLock()
@@ -111,6 +115,7 @@ class ServeControllerActor:
             for prefix, (app, _d) in list(self._http_routes.items()):
                 if app == app_name:
                     del self._http_routes[prefix]
+                    self._asgi_prefixes.discard(prefix)
             self._routes_version += 1
         return True
 
@@ -119,12 +124,20 @@ class ServeControllerActor:
     ) -> bool:
         with self._lock:
             self._http_routes[prefix] = (app_name, deployment_name)
+            st = self._apps.get(app_name, {}).get(deployment_name)
+            if st is not None and getattr(
+                st.deployment.func_or_class, "__rt_is_asgi__", False
+            ):
+                self._asgi_prefixes.add(prefix)
+            else:
+                self._asgi_prefixes.discard(prefix)
             self._routes_version += 1
         return True
 
     def remove_route_prefix(self, prefix: str) -> bool:
         with self._lock:
             removed = self._http_routes.pop(prefix, None) is not None
+            self._asgi_prefixes.discard(prefix)
             if removed:
                 self._routes_version += 1
         return removed
@@ -287,6 +300,7 @@ class ServeControllerActor:
                 "version": self._routes_version,
                 "apps": out,
                 "http_routes": dict(self._http_routes),
+                "asgi_prefixes": list(self._asgi_prefixes),
             }
 
     def get_status(self) -> dict:

@@ -26,6 +26,7 @@ class ProxyActor:
     def __init__(self, port: int = 8000):
         self._port = port
         self._routes: Dict[str, Any] = {}  # route_prefix -> (app, deployment)
+        self._asgi_prefixes: set = set()  # prefixes served via @serve.ingress
         self._routes_version = -1
         self._last_poll = 0.0
         self._handles: Dict[str, Any] = {}
@@ -85,6 +86,7 @@ class ProxyActor:
                 if new_routes.get(p) != self._routes.get(p):
                     self._handles.pop(p, None)
             self._routes = dict(new_routes)
+            self._asgi_prefixes = set(routes.get("asgi_prefixes", ()))
 
     def _handle_for(self, prefix: str):
         from ray_tpu.serve.handle import DeploymentHandle
@@ -155,20 +157,50 @@ class ProxyActor:
                     self._poll_routes(force=True)
                     prefix = _match()
                 if prefix is None:
-                    return None
+                    return None, False
                 handle = self._handle_for(prefix)
+                if prefix in self._asgi_prefixes:
+                    # @serve.ingress deployment: forward the raw request
+                    # through the replica's ASGI adapter with the prefix
+                    # stripped, so the mounted app's own routing applies
+                    # (ray: serve/api.py:172 ingress semantics)
+                    suffix = path[len(prefix.rstrip("/")):] or "/"
+                    asgi_req = {
+                        "method": request.method,
+                        "path": suffix,
+                        "query_string": request.query_string,
+                        "headers": [
+                            (k, v) for k, v in request.headers.items()
+                        ],
+                        "body": body,
+                    }
+                    h = handle.options(method_name="__asgi_handle__")
+                    return h.remote(asgi_req), True
                 if method_name or want_stream:
                     handle = handle.options(
                         method_name=method_name or "__call__",
                         stream=want_stream,
                     )
-                return handle.remote(*args, **kwargs)
+                return handle.remote(*args, **kwargs), False
 
-            resp = await asyncio.get_running_loop().run_in_executor(
+            resp, is_asgi = await asyncio.get_running_loop().run_in_executor(
                 None, _route_and_dispatch
             )
             if resp is None:
                 return web.Response(status=404, text="no route")
+            if is_asgi:
+                r = await resp.result_async()
+                headers = {
+                    k: v for k, v in r.get("headers", [])
+                    # aiohttp computes these from the body it writes
+                    if k.lower() not in ("content-length",
+                                         "transfer-encoding")
+                }
+                return web.Response(
+                    status=r.get("status", 500),
+                    headers=headers,
+                    body=r.get("body", b""),
+                )
             if want_stream:
                 # newline-delimited JSON over chunked transfer (the HTTP
                 # face of the core streaming-generator transport), fully
