@@ -57,7 +57,12 @@ class ReadTask:
 
 
 class _Op:
-    pass
+    def label(self) -> str:
+        """Stage-name fragment for Dataset.stats()."""
+        name = type(self).__name__.lstrip("_")
+        fn = getattr(self, "fn", None)
+        fn_name = getattr(fn, "__name__", None)
+        return f"{name}({fn_name})" if fn_name else name
 
 
 class ActorPoolStrategy:
@@ -162,7 +167,10 @@ def _apply_ops(block: Block, ops: List[_Op]) -> Block:
 
 class Dataset:
     def __init__(self, block_refs: List[Any], ops: Optional[List[_Op]] = None,
-                 exec_opts: Optional[dict] = None):
+                 exec_opts: Optional[dict] = None,
+                 stats_lineage: Optional[tuple] = None):
+        import uuid
+
         self._input_refs = block_refs
         self._ops: List[_Op] = ops or []
         self._materialized: Optional[List[Any]] = None  # refs post-ops
@@ -171,10 +179,17 @@ class Dataset:
         # carried through map chains, reset at shuffle boundaries (each
         # operator configures its own stage)
         self._exec_opts: dict = dict(exec_opts or {})
+        # execution-stats identity: this plan's stage tasks report under
+        # _stats_run_id; _stats_lineage carries ancestor run ids across
+        # shuffle/actor-pool boundaries so stats() shows the whole plan
+        # (ray: Dataset.stats(), python/ray/data/dataset.py:4573)
+        self._stats_run_id = uuid.uuid4().hex[:16]
+        self._stats_lineage: tuple = stats_lineage or ()
 
     # -- plan building ---------------------------------------------------
     def _chain(self, op: _Op) -> "Dataset":
-        return Dataset(self._input_refs, self._ops + [op], self._exec_opts)
+        return Dataset(self._input_refs, self._ops + [op], self._exec_opts,
+                       self._stats_lineage)
 
     def with_resources(
         self,
@@ -202,7 +217,8 @@ class Dataset:
             if window < 1:
                 raise ValueError("window must be >= 1")
             opts["window"] = window
-        return Dataset(self._input_refs, list(self._ops), opts)
+        return Dataset(self._input_refs, list(self._ops), opts,
+                       self._stats_lineage)
 
     def map_batches(
         self,
@@ -249,6 +265,12 @@ class Dataset:
         if not refs:
             return Dataset([])
         size = max(1, max(min_size, min(max_size, len(refs))))
+        import uuid
+
+        out_run_id = uuid.uuid4().hex[:16]
+        stage_name = (
+            f"MapBatches(actors:{getattr(fn, '__name__', type(fn).__name__)})"
+        )
 
         @ray_tpu.remote
         class _MapWorker:
@@ -258,15 +280,22 @@ class Dataset:
                 )
 
             def apply(self, block):
+                import time as _time
+
+                from ray_tpu.data import stats as stats_mod
+
+                t0 = _time.perf_counter()
                 batch = _from_block(block, batch_format)
-                out = self._callable(batch, **fn_kwargs)
-                return _to_block(out)
+                out = _to_block(self._callable(batch, **fn_kwargs))
+                stats_mod.record_stage(out_run_id, stage_name, t0, out)
+                return out
 
         pool = [
             _MapWorker.options(num_cpus=0.5).remote(fn, ctor_args)
             for _ in range(size)
         ]
         out = [pool[i % size].apply.remote(r) for i, r in enumerate(refs)]
+        out_lineage = self._stats_lineage + ((self._stats_run_id, "Input"),)
         # The pool dies when the LAST output ref does — not with the
         # Dataset object, which a chained stage may drop while its refs
         # live on.  Finalizers hold the handles; consumption proceeds
@@ -284,7 +313,9 @@ class Dataset:
 
         for r in out:
             weakref.finalize(r, _one_ref_dead)
-        return Dataset(out)
+        ds = Dataset(out, stats_lineage=out_lineage)
+        ds._stats_run_id = out_run_id
+        return ds
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         return self._chain(_MapRows(fn))
@@ -338,16 +369,28 @@ class Dataset:
         return self.map_batches(rename, batch_format="pyarrow")
 
     # -- execution -------------------------------------------------------
+    def _stage_label(self, src) -> str:
+        head = "Read" if isinstance(src, ReadTask) else "Input"
+        return "->".join([head] + [op.label() for op in self._ops])
+
     def _submit_stage(self, src) -> Any:
         """One fused read+transform task for one source → block ref."""
         ops = self._ops
         if not ops and not isinstance(src, ReadTask):
             return src  # already-materialized block, nothing to run
+        run_id, stage = self._stats_run_id, self._stage_label(src)
 
         @ray_tpu.remote
-        def run_stage(ops, src):
+        def run_stage(ops, src, run_id, stage):
+            import time as _time
+
+            from ray_tpu.data import stats as stats_mod
+
+            t0 = _time.perf_counter()
             block = src() if isinstance(src, ReadTask) else src
-            return _apply_ops(block, ops)
+            block = _apply_ops(block, ops)
+            stats_mod.record_stage(run_id, stage, t0, block)
+            return block
 
         kw = {
             k: self._exec_opts[k]
@@ -356,7 +399,7 @@ class Dataset:
         }
         if kw:
             run_stage = run_stage.options(**kw)
-        return run_stage.remote(ops, src)
+        return run_stage.remote(ops, src, run_id, stage)
 
     def iter_block_refs(self) -> Iterator[Any]:
         """Streaming execution: yield block refs in order with a bounded
@@ -407,7 +450,43 @@ class Dataset:
         refs = self._execute()
         ray_tpu.wait(refs, num_returns=len(refs), timeout=600,
                      fetch_local=False)
-        return Dataset(refs)
+        return Dataset(refs, stats_lineage=self._stats_lineage + (
+            (self._stats_run_id, "Input"),
+        ))
+
+    def stats(self) -> str:
+        """Per-stage execution statistics for everything this plan has
+        RUN so far (ray: Dataset.stats, python/ray/data/dataset.py:4573):
+        wall time min/max/mean/total, blocks, output rows and bytes per
+        fused stage and shuffle map/reduce stage, plus cluster object
+        store spill counters.  Stats are recorded as stage tasks execute;
+        consume or materialize first for a complete picture."""
+        from ray_tpu.core.runtime import get_runtime
+        from ray_tpu.data import stats as stats_mod
+
+        runs = list(self._stats_lineage) + [(self._stats_run_id, "Stage")]
+        # stage tasks report fire-and-forget: poll until the record set
+        # stabilizes (bounded) so a stats() right after consumption sees
+        # the last stragglers
+        import time as _time
+
+        h = stats_mod.stats_handle()
+        ids = [r[0] for r in runs]
+        collected = ray_tpu.get(h.get.remote(ids), timeout=60)
+        deadline = _time.monotonic() + 3.0
+        while _time.monotonic() < deadline:
+            _time.sleep(0.15)
+            again = ray_tpu.get(h.get.remote(ids), timeout=60)
+            if again == collected:
+                break
+            collected = again
+        store_stats = None
+        try:
+            rt = get_runtime()
+            store_stats = rt._run(rt.gcs.call("cluster_store_stats", {}))
+        except Exception:
+            pass
+        return stats_mod.format_stats(runs, collected, store_stats)
 
     # -- shuffle-boundary ops -------------------------------------------
     # -- distributed shuffle core ---------------------------------------
@@ -428,32 +507,57 @@ class Dataset:
 
     @staticmethod
     def _exchange(refs, n_out: int, map_fn, reduce_fn,
-                  map_args=None) -> "Dataset":
+                  map_args=None, stats_from: Optional["Dataset"] = None,
+                  stage: str = "Shuffle") -> "Dataset":
         """map_fn(block, j_args...) -> tuple of n_out blocks;
         reduce_fn(*pieces) -> block.  map_args: per-input extra args."""
         if not refs:
             return Dataset([])
+        import uuid
+
+        out_run_id = uuid.uuid4().hex[:16]
+        map_stage, reduce_stage = f"{stage}Map", f"{stage}Reduce"
 
         @ray_tpu.remote
         def shuffle_map(block, *args):
+            import time as _time
+
+            from ray_tpu.data import stats as stats_mod
+
+            t0 = _time.perf_counter()
             pieces = tuple(map_fn(block, *args))
+            stats_mod.record_stage(out_run_id, map_stage, t0, block)
             # num_returns=1 stores the RETURN VALUE as the single object:
             # unwrap, or the reduce would receive a 1-tuple
             return pieces if n_out > 1 else pieces[0]
 
         @ray_tpu.remote
         def shuffle_reduce(*parts):
-            return reduce_fn(list(parts))
+            import time as _time
+
+            from ray_tpu.data import stats as stats_mod
+
+            t0 = _time.perf_counter()
+            block = reduce_fn(list(parts))
+            stats_mod.record_stage(out_run_id, reduce_stage, t0, block)
+            return block
 
         map_outs = []
         for i, r in enumerate(refs):
             args = map_args[i] if map_args is not None else ()
             out = shuffle_map.options(num_returns=n_out).remote(r, *args)
             map_outs.append(out if n_out > 1 else [out])
-        return Dataset([
+        lineage = ()
+        if stats_from is not None:
+            lineage = stats_from._stats_lineage + (
+                (stats_from._stats_run_id, "Input"),
+            )
+        ds = Dataset([
             shuffle_reduce.remote(*[mo[j] for mo in map_outs])
             for j in range(n_out)
-        ])
+        ], stats_lineage=lineage)
+        ds._stats_run_id = out_run_id
+        return ds
 
     def repartition(self, num_blocks: int) -> "Dataset":
         """Order-preserving rebalance into num_blocks equal-ish blocks."""
@@ -478,6 +582,7 @@ class Dataset:
         return self._exchange(
             refs, num_blocks, cut, concat_blocks,
             map_args=[(int(offsets[i]),) for i in range(len(refs))],
+            stats_from=self, stage="Repartition",
         )
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
@@ -511,6 +616,7 @@ class Dataset:
         return self._exchange(
             refs, n, scatter, merge_permute,
             map_args=[(i,) for i in range(n)],
+            stats_from=self, stage="RandomShuffle",
         )
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
@@ -560,7 +666,9 @@ class Dataset:
         def merge_sort(parts):
             return concat_blocks(parts).sort_by([(key, order)])
 
-        return self._exchange(refs, n, scatter, merge_sort)
+        return self._exchange(
+            refs, n, scatter, merge_sort, stats_from=self, stage="Sort"
+        )
 
     def union(self, *others: "Dataset") -> "Dataset":
         refs = list(self._execute())
@@ -1032,7 +1140,10 @@ class GroupedData:
         def merge_agg(parts):
             return concat_blocks(parts).group_by(key).aggregate(agg_list)
 
-        return Dataset._exchange(refs, n, scatter, merge_agg)
+        return Dataset._exchange(
+            refs, n, scatter, merge_agg, stats_from=self._ds,
+            stage="GroupByAgg",
+        )
 
     def sum(self, col: str) -> Dataset:
         return self._aggregate({col: "sum"})

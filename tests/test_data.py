@@ -466,3 +466,46 @@ class TestZipJoinBudgets:
         assert ds._exec_opts["window"] == 3
         # shuffle boundary resets the per-operator budget
         assert ds.repartition(2)._exec_opts == {}
+
+
+class TestStats:
+    def test_stats_after_read_map_shuffle(self, cluster):
+        """Dataset.stats() (ray: python/ray/data/dataset.py:4573): after a
+        read -> map_batches -> random_shuffle pipeline executes, the
+        stats string reports every stage with blocks/rows/bytes/wall."""
+
+        def double(b):
+            return {"id": b["id"] * 2}
+
+        ds = (
+            rd.range(100, override_num_blocks=4)
+            .map_batches(double)
+            .random_shuffle(seed=0)
+        )
+        assert ds.count() == 100  # executes the whole plan
+        s = ds.stats()
+        # the fused upstream stage and both shuffle stages appear
+        assert "Read->MapBatches(double)" in s, s
+        assert "RandomShuffleMap" in s and "RandomShuffleReduce" in s, s
+        # per-stage rows: 100 rows flowed through each stage
+        assert "Output rows: 100 total" in s, s
+        assert "Wall time:" in s and "blocks executed" in s, s
+        assert "Cluster object store:" in s, s
+
+    def test_stats_before_execution_is_explicit(self, cluster):
+        ds = rd.range(10).map(lambda r: r)
+        s = ds.stats()
+        assert "No execution stats recorded yet" in s
+
+    def test_stats_actor_pool_stage(self, cluster):
+        class AddOne:
+            def __call__(self, b):
+                return {"id": b["id"] + 1}
+
+        ds = rd.range(40, override_num_blocks=4).map_batches(
+            AddOne, concurrency=2
+        )
+        assert ds.count() == 40
+        s = ds.stats()
+        assert "MapBatches(actors:AddOne)" in s, s
+        assert "Output rows: 40 total" in s, s
