@@ -235,6 +235,10 @@ class Runtime:
         self._ref_registered: set = set()         # ref_add sent (or pending)
         self._pending_ref_add: set = set()
         self._pending_ref_del: set = set()
+        # adds skipped at flush time because the value was a LOCAL-ONLY
+        # inline result (nothing cluster-side to keep alive); promotion
+        # via ensure_shared re-registers (see _flush_ref_events)
+        self._deferred_reg: set = set()
         self._ref_flush_scheduled = False
 
         # ---- lineage (reference analogue: task_manager.h:208 lineage +
@@ -486,9 +490,30 @@ class Runtime:
     def deserialize(self, data) -> Any:
         return self._serialization.deserialize(data)
 
+    def _reregister_if_deferred(self, oid: bytes) -> None:
+        """A ref whose GCS registration was skipped as local-only is
+        escaping: register this process as holder after all."""
+        with self._ref_lock:
+            if oid in self._deferred_reg:
+                self._deferred_reg.discard(oid)
+                if (
+                    self._local_refs.get(oid, 0) > 0
+                    or self._task_holds.get(oid, 0) > 0
+                ):
+                    self._ref_registered.add(oid)
+                    self._pending_ref_add.add(oid)
+                    self._schedule_ref_flush()
+
     def ensure_shared(self, object_id: ObjectID) -> None:
         """Make the object resolvable cluster-wide (idempotent)."""
         oid = object_id.binary()
+        # Escape-in-progress marker BEFORE the (possibly slow: spill
+        # retries) promotion below: the ref flush must not classify this
+        # oid as local-only mid-promotion and silently drop our holder
+        # registration.  Ordered before _reregister_if_deferred so a
+        # deferral that raced us earlier is cured and none can follow.
+        self._escaped.add(oid)
+        self._reregister_if_deferred(oid)
         if oid in self._shared or self.store.contains(oid):
             self._shared.add(oid)
             return
@@ -2219,18 +2244,33 @@ class Runtime:
         value and tell the GCS this process no longer holds the object."""
         if self._closed:
             return
+        was_shared = oid in self._shared
         self.memory_store.pop(oid, None)
         self._shared.discard(oid)
         self._escaped.discard(oid)
         self._release_lineage_return(oid)
         with self._ref_lock:
+            self._deferred_reg.discard(oid)
             if oid in self._ref_registered:
-                # the del is sent even when its add is still pending in the
-                # same window (adds flush before dels): the GCS must see
-                # the empty holder set to free any stored copies
                 self._ref_registered.discard(oid)
-                self._pending_ref_del.add(oid)
-                self._schedule_ref_flush()
+                if (
+                    oid in self._pending_ref_add
+                    and not was_shared
+                    and oid not in self.result_futures
+                ):
+                    # the add never went out and nothing cluster-side
+                    # exists (local-only value, no in-flight outcome):
+                    # cancel the pair outright instead of planting a
+                    # holder entry the GCS would never delete
+                    self._pending_ref_add.discard(oid)
+                else:
+                    # the del is sent in the same or a later window as its
+                    # add (adds flush before dels; an add parked for an
+                    # in-flight result HOLDS its del — see
+                    # _flush_ref_events): the GCS must see the holder set
+                    # empty to free any stored copies
+                    self._pending_ref_del.add(oid)
+                    self._schedule_ref_flush()
 
     def _schedule_ref_flush(self):
         # caller holds _ref_lock
@@ -2247,11 +2287,53 @@ class Runtime:
 
     def _flush_ref_events(self):
         with self._ref_lock:
-            add = list(self._pending_ref_add)
-            dels = list(self._pending_ref_del)
+            add = []
+            revisit = []
+            for oid in self._pending_ref_add:
+                if (
+                    oid in self.memory_store
+                    and oid not in self._shared
+                    and oid not in self._escaped
+                ):
+                    # LOCAL-ONLY inline result: its value lives solely in
+                    # this process's memory store and no other process can
+                    # reach the ref (escape requires serialization, which
+                    # promotes via ensure_shared first) — cluster-wide
+                    # holder tracking would be 2 GCS messages + free
+                    # scheduling per task for nothing (the dominant
+                    # per-task GCS cost for small-result task storms).
+                    # ensure_shared re-registers on a later escape.
+                    self._ref_registered.discard(oid)
+                    self._deferred_reg.add(oid)
+                elif oid in self.result_futures and oid not in self._escaped:
+                    # OUR in-flight task return: nothing exists cluster-
+                    # side yet, so a holder add is premature — re-check
+                    # next flush window once the reply landed (then it
+                    # either defers as inline-local or registers as
+                    # stored).  Safe against the GCS free machinery:
+                    # frees are only scheduled on holder-set DELETIONS,
+                    # never on first registration of locations.
+                    revisit.append(oid)
+                else:
+                    add.append(oid)
+            # a del whose add is still parked must WAIT for it (an
+            # unpaired del is a GCS no-op and the later add would plant a
+            # holder entry nothing deletes — the fire-and-forget leak)
+            revisit_set = set(revisit)
+            dels = [
+                oid for oid in self._pending_ref_del
+                if oid not in revisit_set
+            ]
+            held_dels = [
+                oid for oid in self._pending_ref_del if oid in revisit_set
+            ]
             self._pending_ref_add.clear()
+            self._pending_ref_add.update(revisit)
             self._pending_ref_del.clear()
+            self._pending_ref_del.update(held_dels)
             self._ref_flush_scheduled = False
+            if revisit:
+                self._schedule_ref_flush()
         if (add or dels) and self.gcs and not self.gcs.closed:
             self._spawn(
                 self.gcs.notify(

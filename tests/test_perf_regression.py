@@ -92,6 +92,47 @@ def test_driver_allocations_per_actor_call_bounded(cluster):
     ray_tpu.kill(a)
 
 
+def test_local_inline_results_skip_gcs_registration(cluster):
+    """Refs to inline task results that never escape this process must
+    not be registered as cluster-wide holders — that was 2 GCS messages
+    + free scheduling per task, the dominant per-task GCS cost in task
+    storms.  A ref that DOES escape (passed as an arg) must re-register
+    and stay resolvable."""
+
+    @ray_tpu.remote
+    def produce():
+        return 41
+
+    @ray_tpu.remote
+    def consume(x):
+        return x + 1
+
+    rt = get_runtime()
+    refs = [produce.remote() for _ in range(50)]
+    assert all(v == 41 for v in ray_tpu.get(refs, timeout=60))
+    deadline = time.monotonic() + 5.0
+    oids = [r.object_id.binary() for r in refs]
+    while time.monotonic() < deadline:
+        with rt._ref_lock:
+            pending = any(o in rt._pending_ref_add for o in oids)
+            registered = [o for o in oids if o in rt._ref_registered]
+        if not pending:
+            break
+        time.sleep(0.1)
+    assert not registered, (
+        f"{len(registered)} local-only inline results registered at the "
+        "GCS (per-task cluster bookkeeping crept back)"
+    )
+    # escape: passing one of them as an arg promotes + re-registers it
+    escaped = refs[0]
+    assert ray_tpu.get(consume.remote(escaped), timeout=60) == 42
+    with rt._ref_lock:
+        eoid = escaped.object_id.binary()
+        ok = eoid in rt._ref_registered or eoid in rt._pending_ref_add
+    assert ok, "escaped ref was not re-registered as a holder"
+    del refs, escaped
+
+
 def test_drained_queue_leaves_no_parked_lease_requests(cluster):
     """After a burst of tasks completes, the scheduling class must cancel
     its parked lease requests; otherwise every freed slot ping-pongs
