@@ -20,6 +20,9 @@ import uuid
 from typing import Any, Dict, Optional
 
 _DICT_FILE = "_dict_checkpoint.pkl"
+# Metrics persisted beside the state by Session.report(); read back by the
+# trainer when a gang restart rescans storage that ran ahead of the driver.
+_METRICS_FILE = "_report_metrics.pkl"
 
 
 class Checkpoint:

@@ -94,6 +94,24 @@ class TrainSession:
                 ),
             )
             self.latest_checkpoint = checkpoint
+            # Metrics sidecar: a gang restart can rescan a checkpoint the
+            # driver never saw the report for (this worker is acked for
+            # round k, a peer dies in the same round, and this worker
+            # persists round k+1 before the teardown lands).  Persisting
+            # the metrics beside the state lets the trainer keep
+            # Result.metrics consistent with Result.checkpoint.
+            try:
+                import os
+                import pickle
+
+                from ray_tpu.train.checkpoint import _METRICS_FILE
+
+                with open(
+                    os.path.join(checkpoint.path, _METRICS_FILE), "wb"
+                ) as f:
+                    pickle.dump(dict(metrics), f)
+            except Exception:
+                pass  # best-effort: unpicklable metrics must not fail report()
         self._report_idx += 1
         self.reports.put({"metrics": dict(metrics), "checkpoint": checkpoint})
         self.reports.join()

@@ -123,10 +123,57 @@ class JaxTrainer:
                     failures_left -= 1
                 # Gang restart: workers persist checkpoints before report()
                 # returns, so storage may be ahead of the last handle the
-                # driver saw — rescan and take the newest.
+                # driver saw — rescan and take the newest.  When it IS
+                # ahead, also adopt its metrics sidecar: the resumed loop
+                # starts past that step and may report nothing new, and
+                # Result.metrics must match Result.checkpoint.
                 rescanned = self._latest_persisted(executor.trial_dir)
                 if rescanned is not None:
+                    seen = (
+                        self._ckpt_round(latest_checkpoint.path)
+                        if latest_checkpoint is not None
+                        else None
+                    )
+                    found = self._ckpt_round(rescanned.path)
+                    if found is not None and (seen is None or found > seen):
+                        side = self._sidecar_metrics(rescanned.path)
+                        if side is not None:
+                            last_metrics = side
+                            last_metrics.setdefault(
+                                "_timestamp", time.time()
+                            )
+                            history.append(dict(last_metrics))
                     latest_checkpoint = rescanned
+
+    @staticmethod
+    def _ckpt_round(ckpt_path: str) -> Optional[int]:
+        """Report round parsed from a ``checkpoint_{round}_rank{rank}`` dir
+        name (None for foreign names, e.g. resume_from_checkpoint dirs)."""
+        import os
+
+        parts = os.path.basename(ckpt_path.rstrip("/")).split("_")
+        if len(parts) >= 2 and parts[0] == "checkpoint":
+            try:
+                return int(parts[1])
+            except ValueError:
+                return None
+        return None
+
+    @staticmethod
+    def _sidecar_metrics(ckpt_path: str) -> Optional[Dict[str, Any]]:
+        import os
+        import pickle
+
+        from ray_tpu.train.checkpoint import _METRICS_FILE
+
+        p = os.path.join(ckpt_path, _METRICS_FILE)
+        if not os.path.exists(p):
+            return None
+        try:
+            with open(p, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            return None
 
     def _latest_persisted(self, trial_dir: str) -> Optional[Checkpoint]:
         import os
@@ -138,7 +185,16 @@ class JaxTrainer:
         )
         if not ckpts:
             return None
-        return Checkpoint(os.path.join(trial_dir, ckpts[-1]))
+        # newest round wins; within a round the LOWEST rank (rank 0's
+        # metrics are canonical, and its dir sorts first for same round)
+        newest = ckpts[-1]
+        top = self._ckpt_round(newest)
+        if top is not None:
+            for d in ckpts:
+                if self._ckpt_round(d) == top:
+                    newest = d
+                    break
+        return Checkpoint(os.path.join(trial_dir, newest))
 
     def _prune_checkpoints(self, trial_dir: str):
         import os

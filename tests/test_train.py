@@ -135,7 +135,15 @@ def test_gang_restart_resumes_from_checkpoint(cluster, tmp_path):
         ckpt = train.get_checkpoint()
         start = ckpt.to_dict()["step"] + 1 if ckpt is not None else 0
         for step in range(start, 4):
-            if step == 2 and not os.path.exists(marker):
+            # rank 0 (the checkpointing rank) crashes: deterministic —
+            # the survivor persists nothing, so the resumed gang always
+            # has steps left to run (the storage-runs-ahead variant is
+            # covered by test_gang_restart_adopts_sidecar_metrics)
+            if (
+                step == 2
+                and ctx.get_world_rank() == 0
+                and not os.path.exists(marker)
+            ):
                 open(marker, "w").close()
                 os._exit(1)  # kill this worker process mid-training
             if ctx.get_world_rank() == 0:
@@ -158,6 +166,55 @@ def test_gang_restart_resumes_from_checkpoint(cluster, tmp_path):
     assert r.error is None
     assert r.metrics["step"] == 3
     assert r.metrics["resumed"] is True  # second gang started from ckpt step 1
+
+
+def test_gang_restart_adopts_sidecar_metrics(cluster, tmp_path):
+    """A surviving rank can persist one checkpoint past the last report the
+    driver consumed (it is acked for round k, a peer dies in that round, and
+    it persists round k+1 before teardown lands).  After the gang restart,
+    Result.metrics must match that rescanned checkpoint, not the stale
+    pre-crash report — here the race outcome is staged deterministically."""
+    import pickle
+
+    def loop(config):
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        if ckpt is None:
+            if ctx.get_world_rank() == 0:
+                # stage storage one step AHEAD of anything the driver saw
+                d = os.path.join(
+                    ctx.trial_dir, "checkpoint_000003_rank00000"
+                )
+                os.makedirs(d, exist_ok=True)
+                with open(
+                    os.path.join(d, "_dict_checkpoint.pkl"), "wb"
+                ) as f:
+                    pickle.dump({"step": 3}, f)
+                with open(
+                    os.path.join(d, "_report_metrics.pkl"), "wb"
+                ) as f:
+                    pickle.dump({"step": 3}, f)
+                os._exit(1)  # die before reporting anything
+            import time as _t
+
+            _t.sleep(30)  # peer never reports; gang is torn down
+            return
+        # resumed attempt: already past the final step — nothing to report
+        assert ckpt.to_dict()["step"] == 3
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(
+            name="sidecar",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert r.error is None
+    assert r.metrics["step"] == 3
+    assert r.checkpoint is not None
+    assert r.checkpoint.to_dict()["step"] == 3
 
 
 def test_resume_from_checkpoint_arg(cluster, tmp_path):
