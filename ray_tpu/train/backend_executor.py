@@ -18,7 +18,7 @@ from typing import Any, Callable, Dict, List, Optional
 import ray_tpu
 from ray_tpu.core.errors import ActorDiedError, GetTimeoutError, TaskError
 from ray_tpu.train.backend import BackendConfig
-from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint import Checkpoint, _ckpt_round
 from ray_tpu.train.config import RunConfig, ScalingConfig
 from ray_tpu.train.session import TrainContext
 from ray_tpu.train.worker_group import WorkerGroup
@@ -72,6 +72,25 @@ class BackendExecutor:
         datasets: Optional[Dict[str, Any]] = None,
     ):
         os.makedirs(self.trial_dir, exist_ok=True)
+        # Computed ONCE, before any worker starts: every rank numbers its
+        # reports from past the highest round already persisted in this
+        # trial dir, so rounds stay monotonic across gang restarts and
+        # consistent across ranks (see TrainSession.__init__).
+        # Unreadable trial storage must surface (silently falling back to
+        # round 0 would re-issue numbers an earlier attempt persisted and
+        # corrupt the newest-round rescan ordering) — but as a gang error,
+        # so fit()'s handler still tears the already-started workers down.
+        start_round = 0
+        try:
+            listing = os.listdir(self.trial_dir)
+        except OSError as e:
+            raise TrainWorkerGroupError(
+                f"trial storage unreadable: {e}"
+            ) from e
+        for d in listing:
+            r = _ckpt_round(d)
+            if r is not None and r >= start_round:
+                start_round = r + 1
         self.backend.on_training_start(self.worker_group, self.backend_config)
         wg = self.worker_group
         node_count = len({w.node_id for w in wg.workers})
@@ -105,7 +124,8 @@ class BackendExecutor:
             }
             starts.append(
                 w.actor.start_training.remote(
-                    train_fn, config, ctx, latest_checkpoint, shards
+                    train_fn, config, ctx, latest_checkpoint, shards,
+                    start_round,
                 )
             )
         try:

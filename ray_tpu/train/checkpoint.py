@@ -25,6 +25,52 @@ _DICT_FILE = "_dict_checkpoint.pkl"
 _METRICS_FILE = "_report_metrics.pkl"
 
 
+def _ckpt_round(path: str) -> Optional[int]:
+    """Report round parsed from a trainer-issued
+    ``checkpoint_{round}_rank{rank}`` dir name; None for foreign names
+    (user-made, resume_from, or default uuid-suffixed ``persist()`` dirs
+    — the rank segment is required so an all-digit uuid prefix can't
+    masquerade as a round)."""
+    parts = os.path.basename(path.rstrip("/")).split("_")
+    if (
+        len(parts) >= 3
+        and parts[0] == "checkpoint"
+        and parts[2].startswith("rank")
+    ):
+        try:
+            return int(parts[1])
+        except ValueError:
+            return None
+    return None
+
+
+def _write_metrics_sidecar(ckpt_path: str, metrics: Dict[str, Any]) -> None:
+    """Best-effort: written AFTER persist() returns, so its presence also
+    marks the checkpoint directory as completely persisted.  Serialized
+    before any file exists and moved in atomically — a pickling error or
+    mid-write crash must not leave a truncated sidecar that wins the
+    completeness tie-break while being unreadable."""
+    try:
+        blob = pickle.dumps(dict(metrics))
+        tmp = os.path.join(ckpt_path, _METRICS_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(ckpt_path, _METRICS_FILE))
+    except Exception:
+        pass  # unpicklable metrics must not fail report()
+
+
+def _read_metrics_sidecar(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    p = os.path.join(ckpt_path, _METRICS_FILE)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p, "rb") as f:
+            return pickle.load(f)
+    except Exception:
+        return None
+
+
 class Checkpoint:
     """Handle to a checkpoint directory.
 

@@ -16,7 +16,7 @@ import queue
 import threading
 from typing import Any, Dict, Optional
 
-from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint import Checkpoint, _write_metrics_sidecar
 
 _session_lock = threading.Lock()
 _session: Optional["TrainSession"] = None
@@ -61,6 +61,7 @@ class TrainSession:
         latest_checkpoint: Optional[Checkpoint] = None,
         train_config: Optional[Dict[str, Any]] = None,
         dataset_shards: Optional[Dict[str, Any]] = None,
+        start_round: int = 0,
     ):
         self.context = context
         self.train_config = train_config or {}
@@ -70,7 +71,14 @@ class TrainSession:
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self.result: Any = None
-        self._report_idx = 0
+        # Rounds stay monotonic ACROSS gang restarts into the same trial
+        # dir: a fresh attempt must not re-issue round numbers an earlier
+        # attempt already persisted, or the trainer's newest-round rescan
+        # would prefer a stale pre-restart checkpoint.  The driver computes
+        # the start round ONCE before dispatching the gang (a per-worker
+        # directory scan here would race with fast peers' first persists
+        # and desynchronize round numbers across ranks).
+        self._report_idx = start_round
 
     # -- worker-side API -------------------------------------------------
     def report(
@@ -100,18 +108,7 @@ class TrainSession:
             # persists round k+1 before the teardown lands).  Persisting
             # the metrics beside the state lets the trainer keep
             # Result.metrics consistent with Result.checkpoint.
-            try:
-                import os
-                import pickle
-
-                from ray_tpu.train.checkpoint import _METRICS_FILE
-
-                with open(
-                    os.path.join(checkpoint.path, _METRICS_FILE), "wb"
-                ) as f:
-                    pickle.dump(dict(metrics), f)
-            except Exception:
-                pass  # best-effort: unpicklable metrics must not fail report()
+            _write_metrics_sidecar(checkpoint.path, metrics)
         self._report_idx += 1
         self.reports.put({"metrics": dict(metrics), "checkpoint": checkpoint})
         self.reports.join()

@@ -14,6 +14,7 @@ reality — a multi-host XLA program cannot lose one participant.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -22,7 +23,12 @@ from ray_tpu.train.backend_executor import (
     BackendExecutor,
     TrainWorkerGroupError,
 )
-from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint import (
+    _METRICS_FILE,
+    Checkpoint,
+    _ckpt_round,
+    _read_metrics_sidecar,
+)
 from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
 
 
@@ -111,6 +117,73 @@ class JaxTrainer:
                 # get timeouts) to TrainWorkerGroupError.  Either way the
                 # gang is torn down before deciding to retry or surface.
                 executor.shutdown()
+                # shutdown() returns before worker processes finish their
+                # short exit grace, during which a survivor may still be
+                # completing its final persist — wait for the trial dir
+                # listing to go quiescent before rescanning.
+                def _snapshot() -> Optional[List]:
+                    # dir names AND their sidecar presence: a survivor's
+                    # final act is the sidecar write inside an already-
+                    # listed dir, which a name-only listing can't see
+                    try:
+                        td = executor.trial_dir
+                        if not os.path.isdir(td):
+                            return []
+                        return sorted(
+                            (
+                                d,
+                                os.path.exists(
+                                    os.path.join(td, d, _METRICS_FILE)
+                                ),
+                            )
+                            for d in os.listdir(td)
+                        )
+                    except OSError:
+                        return None
+
+                prev = None
+                for _ in range(8):
+                    cur = _snapshot()
+                    if cur is None or cur == prev:
+                        break
+                    prev = cur
+                    time.sleep(0.25)
+                # Workers persist checkpoints before report() returns, so
+                # storage may be ahead of the last handle the driver saw —
+                # rescan and take the newest.  When it IS ahead, also adopt
+                # its metrics sidecar so metrics match the checkpoint: this
+                # holds for BOTH the retry (the resumed loop starts past
+                # that step and may report nothing new) and the terminal
+                # Result below (its checkpoint must be the newest too).
+                rescanned = self._latest_persisted(executor.trial_dir)
+                if rescanned is not None:
+                    # `seen` counts only checkpoints of THIS trial: a
+                    # resume_from_checkpoint handle into some other run's
+                    # dir may parse to an arbitrary round and must not
+                    # suppress sidecar adoption here.
+                    seen = None
+                    if latest_checkpoint is not None and os.path.realpath(
+                        os.path.dirname(latest_checkpoint.path)
+                    ) == os.path.realpath(executor.trial_dir):
+                        seen = _ckpt_round(latest_checkpoint.path)
+                    found = _ckpt_round(rescanned.path)
+                    if found is not None and (seen is None or found > seen):
+                        side = _read_metrics_sidecar(rescanned.path)
+                        if side is not None:
+                            last_metrics = side
+                            last_metrics.setdefault(
+                                "_timestamp", time.time()
+                            )
+                            history.append(dict(last_metrics))
+                    # Never move the resume point backwards OR sideways:
+                    # the verified-round fallback can return an older
+                    # round than the driver consumed (newest sidecar write
+                    # failed), and at equal rounds the rescan may have
+                    # picked a different rank's partial dir — the driver's
+                    # known-good handle wins unless storage is strictly
+                    # newer.
+                    if seen is None or (found is not None and found > seen):
+                        latest_checkpoint = rescanned
                 if failures_left == 0:
                     return Result(
                         metrics=last_metrics,
@@ -121,63 +194,8 @@ class JaxTrainer:
                     )
                 if failures_left > 0:
                     failures_left -= 1
-                # Gang restart: workers persist checkpoints before report()
-                # returns, so storage may be ahead of the last handle the
-                # driver saw — rescan and take the newest.  When it IS
-                # ahead, also adopt its metrics sidecar: the resumed loop
-                # starts past that step and may report nothing new, and
-                # Result.metrics must match Result.checkpoint.
-                rescanned = self._latest_persisted(executor.trial_dir)
-                if rescanned is not None:
-                    seen = (
-                        self._ckpt_round(latest_checkpoint.path)
-                        if latest_checkpoint is not None
-                        else None
-                    )
-                    found = self._ckpt_round(rescanned.path)
-                    if found is not None and (seen is None or found > seen):
-                        side = self._sidecar_metrics(rescanned.path)
-                        if side is not None:
-                            last_metrics = side
-                            last_metrics.setdefault(
-                                "_timestamp", time.time()
-                            )
-                            history.append(dict(last_metrics))
-                    latest_checkpoint = rescanned
-
-    @staticmethod
-    def _ckpt_round(ckpt_path: str) -> Optional[int]:
-        """Report round parsed from a ``checkpoint_{round}_rank{rank}`` dir
-        name (None for foreign names, e.g. resume_from_checkpoint dirs)."""
-        import os
-
-        parts = os.path.basename(ckpt_path.rstrip("/")).split("_")
-        if len(parts) >= 2 and parts[0] == "checkpoint":
-            try:
-                return int(parts[1])
-            except ValueError:
-                return None
-        return None
-
-    @staticmethod
-    def _sidecar_metrics(ckpt_path: str) -> Optional[Dict[str, Any]]:
-        import os
-        import pickle
-
-        from ray_tpu.train.checkpoint import _METRICS_FILE
-
-        p = os.path.join(ckpt_path, _METRICS_FILE)
-        if not os.path.exists(p):
-            return None
-        try:
-            with open(p, "rb") as f:
-                return pickle.load(f)
-        except Exception:
-            return None
 
     def _latest_persisted(self, trial_dir: str) -> Optional[Checkpoint]:
-        import os
-
         if not os.path.isdir(trial_dir):
             return None
         ckpts = sorted(
@@ -185,19 +203,37 @@ class JaxTrainer:
         )
         if not ckpts:
             return None
-        # newest round wins; within a round the LOWEST rank (rank 0's
-        # metrics are canonical, and its dir sorts first for same round)
-        newest = ckpts[-1]
-        top = self._ckpt_round(newest)
-        if top is not None:
-            for d in ckpts:
-                if self._ckpt_round(d) == top:
-                    newest = d
-                    break
-        return Checkpoint(os.path.join(trial_dir, newest))
+        rounds = [_ckpt_round(d) for d in ckpts]
+        top = max((r for r in rounds if r is not None), default=None)
+        if top is None:
+            return Checkpoint(os.path.join(trial_dir, ckpts[-1]))
+        # Newest VERIFIED round wins: the metrics sidecar is written after
+        # persist() completes, so it marks a directory as fully persisted
+        # (a rank that died mid-persist leaves none).  A sole partial dir
+        # in the top round must not shadow a complete earlier round, so
+        # fall back across rounds to the newest one holding a verified
+        # dir; if no round has any sidecar (pre-sidecar dirs, write
+        # failures), take the newest round as-is.  Within a round prefer
+        # verified dirs, then the LOWEST rank (rank 0's metrics are
+        # canonical; same-round dirs sort by rank).
+        def verified(d: str) -> bool:
+            return os.path.exists(os.path.join(trial_dir, d, _METRICS_FILE))
+
+        by_round: Dict[int, List[str]] = {}
+        for d, r in zip(ckpts, rounds):
+            if r is not None:
+                by_round.setdefault(r, []).append(d)
+        pick_round = top
+        for r in sorted(by_round, reverse=True):
+            if any(verified(d) for d in by_round[r]):
+                pick_round = r
+                break
+        cands = sorted(
+            by_round[pick_round], key=lambda d: (0 if verified(d) else 1, d)
+        )
+        return Checkpoint(os.path.join(trial_dir, cands[0]))
 
     def _prune_checkpoints(self, trial_dir: str):
-        import os
         import shutil
 
         cc = self.run_config.checkpoint_config

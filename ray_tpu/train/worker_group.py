@@ -66,12 +66,13 @@ class TrainWorkerActor:
         context: TrainContext,
         latest_checkpoint: Optional[Checkpoint],
         dataset_shards: Optional[Dict[str, Any]] = None,
+        start_round: int = 0,
     ) -> bool:
         if self._thread is not None and self._thread.is_alive():
             raise RuntimeError("training loop already running on this worker")
         session = TrainSession(
             context, latest_checkpoint=latest_checkpoint, train_config=config,
-            dataset_shards=dataset_shards,
+            dataset_shards=dataset_shards, start_round=start_round,
         )
         self._session = session
         init_session(session)

@@ -217,6 +217,80 @@ def test_gang_restart_adopts_sidecar_metrics(cluster, tmp_path):
     assert r.checkpoint.to_dict()["step"] == 3
 
 
+def test_terminal_result_adopts_newest_storage(cluster, tmp_path):
+    """Even when failures are exhausted, the error Result must carry the
+    newest persisted checkpoint and its sidecar metrics, not the stale
+    driver-seen pair — a user resuming from it must not repeat steps."""
+    import pickle
+
+    def loop(config):
+        ctx = train.get_context()
+        if ctx.get_world_rank() == 0:
+            d = os.path.join(ctx.trial_dir, "checkpoint_000003_rank00000")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "_dict_checkpoint.pkl"), "wb") as f:
+                pickle.dump({"step": 3}, f)
+            with open(os.path.join(d, "_report_metrics.pkl"), "wb") as f:
+                pickle.dump({"step": 3}, f)
+            os._exit(1)
+        import time as _t
+
+        _t.sleep(30)
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(
+            name="terminal",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=0),
+        ),
+    ).fit()
+    assert r.error is not None
+    assert r.metrics["step"] == 3
+    assert r.checkpoint.to_dict()["step"] == 3
+
+
+def test_gang_restart_twice_rounds_stay_monotonic(cluster, tmp_path):
+    """Report rounds must not restart at 0 after a gang restart: a second
+    failure would otherwise rescan attempt 1's higher-numbered (but older-
+    in-training-time) checkpoint and regress metrics and resume point."""
+    m1 = str(tmp_path / "crash1")
+    m2 = str(tmp_path / "crash2")
+
+    def loop(config):
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = ckpt.to_dict()["step"] + 1 if ckpt is not None else 0
+        for step in range(start, 6):
+            if ctx.get_world_rank() == 0:
+                if step == 2 and not os.path.exists(m1):
+                    open(m1, "w").close()
+                    os._exit(1)
+                if step == 4 and not os.path.exists(m2):
+                    open(m2, "w").close()
+                    os._exit(1)
+                train.report(
+                    {"step": step},
+                    checkpoint=Checkpoint.from_dict({"step": step}),
+                )
+            else:
+                train.report({"step": step})
+
+    r = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=RunConfig(
+            name="restart2",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    ).fit()
+    assert r.error is None
+    assert r.metrics["step"] == 5
+    assert r.checkpoint.to_dict()["step"] == 5
+
+
 def test_resume_from_checkpoint_arg(cluster, tmp_path):
     def loop(config):
         ckpt = train.get_checkpoint()
