@@ -62,17 +62,40 @@ class StoreFullError(StoreError):
     pass
 
 
-def _build_library() -> None:
+def _build_library(force: bool = False) -> None:
     """Compile the .so if missing or older than the source (flock-guarded so
-    concurrent workers don't race)."""
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    concurrent workers don't race).  ``force`` rebuilds even when the
+    binary looks fresh — used when dlopen rejects a prebuilt .so from a
+    different toolchain (e.g. a newer-glibc build shipped into an older
+    container)."""
+    def _stat_sig():
+        try:
+            st = os.stat(_SO)
+            return (st.st_mtime_ns, st.st_size, st.st_ino)
+        except OSError:
+            return None
+
+    def fresh():
+        return (
+            not force
+            and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        )
+
+    if fresh():
         return
+    pre_lock_sig = _stat_sig()
     lock_path = _SO + ".lock"
     with open(lock_path, "w") as lf:
         import fcntl
 
         fcntl.flock(lf, fcntl.LOCK_EX)
-        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        if fresh():
+            return
+        if force and _stat_sig() != pre_lock_sig:
+            # a peer that held the flock first already replaced the
+            # binary — N workers failing dlopen together must not each
+            # run a full recompile back-to-back
             return
         tmp = _SO + ".tmp"
         subprocess.run(
@@ -94,7 +117,14 @@ def _get_lib():
         with _lib_lock:
             if _lib is None:
                 _build_library()
-                lib = ctypes.CDLL(_SO)
+                try:
+                    lib = ctypes.CDLL(_SO)
+                except OSError:
+                    # prebuilt binary from an incompatible toolchain
+                    # (GLIBC version mismatch): rebuild from the bundled
+                    # source with the local compiler and retry
+                    _build_library(force=True)
+                    lib = ctypes.CDLL(_SO)
                 lib.rt_store_create.restype = ctypes.c_void_p
                 lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
                 lib.rt_store_attach.restype = ctypes.c_void_p

@@ -76,9 +76,10 @@ class WorkerServer:
 
     async def start(self):
         await self.server.start()
-        # capture the executor thread id for cancellation
+        # capture the executor thread id for cancellation; awaited (not
+        # fut.result()) so a slow pool spin-up can't stall the io loop
         fut = self._exec.submit(threading.get_ident)
-        self._exec_thread_id = fut.result()
+        self._exec_thread_id = await asyncio.wrap_future(fut)
 
     async def _handle(self, conn: rpc.Connection, method: str, p: Any):
         if method == "push_task":

@@ -105,8 +105,11 @@ def _render_page(title: str, active: str, content: str,
     from the same JSON endpoint every 5 s."""
     import html as _html
 
+    # NOTE: the class attr is built outside the f-string — a backslash
+    # escape inside an f-string expression is a syntax error before 3.12
+    active_attr = ' class="active"'
     nav = "".join(
-        f'<a href="/{k}"{" class=\"active\"" if k == active else ""}>'
+        f'<a href="/{k}"{active_attr if k == active else ""}>'
         f"{label}</a>"
         for k, label in _PAGE_KINDS
     )

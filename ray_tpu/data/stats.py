@@ -71,6 +71,10 @@ def record_stage(run_id: str, stage: str, t0: float, block) -> None:
     try:
         rows = int(getattr(block, "num_rows", 0) or 0)
         nbytes = int(getattr(block, "nbytes", 0) or 0)
+        # fire-and-forget BY DESIGN: stats are advisory, the enclosing
+        # try swallows every failure, and holding refs would pin one
+        # object per block task
+        # rtlint: disable-next=RT105
         stats_handle().record.remote(
             run_id, stage, time.perf_counter() - t0, rows, nbytes
         )

@@ -1,0 +1,15 @@
+"""Developer tooling for ray_tpu.
+
+``ray_tpu.devtools.lint`` (rtlint) is an AST-based static analyzer for
+the distributed-correctness bug families this codebase has actually hit:
+event-loop blocking, non-atomic persists, impure traced functions,
+nested blocking gets, dropped coroutines/refs, mutable defaults on
+remote surfaces, swallowed cancellation, and unlocked lazy init.
+
+Run it with::
+
+    python -m ray_tpu.devtools.lint ray_tpu [--format json]
+
+See ``docs/architecture.md`` ("Static analysis (rtlint)") for rule ids,
+suppression syntax, and the baseline workflow.
+"""

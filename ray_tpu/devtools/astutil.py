@@ -1,0 +1,219 @@
+"""Shared AST helpers for rtlint rules.
+
+The rules work on *resolved qualified names*: ``from time import sleep``
+and ``import time as t`` both resolve a call site to ``time.sleep``, so
+pattern tables stay small and alias-proof.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ImportMap:
+    """Maps local binding names to the dotted path they were imported as.
+
+    ``import numpy as np``      → ``np -> numpy``
+    ``import os.path``          → ``os -> os``
+    ``from time import sleep``  → ``sleep -> time.sleep``
+    ``from . import rpc``       → ``rpc -> .rpc`` (relative kept as-is)
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        top = a.name.split(".")[0]
+                        self.aliases[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                module = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    bound = a.asname or a.name
+                    self.aliases[bound] = (
+                        f"{module}.{a.name}" if module else a.name
+                    )
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Resolved dotted name for a Name/Attribute chain, or None when
+        the chain is not rooted in a plain name (call results, subscripts).
+        """
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        base = self.aliases.get(parts[0])
+        if base is not None:
+            parts[0] = base
+        return ".".join(parts)
+
+
+def dotted_text(node: ast.AST) -> Optional[str]:
+    """The literal dotted text of a Name/Attribute chain (unresolved)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def decorator_callable(dec: ast.AST) -> ast.AST:
+    """The callable expression of a decorator, unwrapping one call level:
+    ``@ray_tpu.remote(num_cpus=1)`` → the ``ray_tpu.remote`` node."""
+    return dec.func if isinstance(dec, ast.Call) else dec
+
+
+def resolved_decorators(
+    node: ast.AST, imports: ImportMap
+) -> List[Tuple[str, ast.AST]]:
+    """[(resolved_name, decorator_node)] for each decorator, skipping ones
+    that do not resolve to a dotted name."""
+    out = []
+    for dec in getattr(node, "decorator_list", []):
+        name = imports.resolve(decorator_callable(dec))
+        if name is not None:
+            out.append((name, dec))
+    return out
+
+
+def has_decorator(
+    node: ast.AST, imports: ImportMap, names: Sequence[str],
+    suffixes: Sequence[str] = (),
+) -> bool:
+    for resolved, _dec in resolved_decorators(node, imports):
+        if resolved in names:
+            return True
+        if any(resolved.endswith(s) for s in suffixes):
+            return True
+    return False
+
+
+def is_remote_decorated(node: ast.AST, imports: ImportMap) -> bool:
+    """``@ray_tpu.remote`` / ``@remote`` / ``@rt.remote(...)`` shapes."""
+    for resolved, _dec in resolved_decorators(node, imports):
+        if resolved == "remote" or resolved.endswith(".remote"):
+            return True
+    return False
+
+
+_LOCKISH = ("lock", "mutex", "cond", "sem")
+
+
+def is_lockish(expr: ast.AST) -> bool:
+    """Heuristic: a with-context expression that names a lock
+    (``self._lock``, ``_init_lock``, ``cls._mu.acquire()``...)."""
+    node = expr.func if isinstance(expr, ast.Call) else expr
+    text = dotted_text(node)
+    if text is None:
+        return False
+    return any(k in text.lower() for k in _LOCKISH)
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the enclosing function/class stack and the
+    set of ``with``-acquired lock contexts, so rules can ask "am I inside
+    an async def?", "what class owns this method?", "is a lock held?".
+    """
+
+    def __init__(self):
+        self.func_stack: List[ast.AST] = []
+        self.class_stack: List[ast.ClassDef] = []
+        self.with_lock_depth = 0
+
+    # -- stack queries ---------------------------------------------------
+    @property
+    def current_function(self) -> Optional[ast.AST]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    @property
+    def current_class(self) -> Optional[ast.ClassDef]:
+        return self.class_stack[-1] if self.class_stack else None
+
+    @property
+    def in_async_function(self) -> bool:
+        """Nearest-enclosing-function semantics: a sync ``def`` nested
+        inside an ``async def`` is NOT "in async" — those helpers are
+        conventionally shipped to executor threads (run_in_executor,
+        to_thread), where blocking is fine."""
+        return bool(self.func_stack) and isinstance(
+            self.func_stack[-1], ast.AsyncFunctionDef
+        )
+
+    @property
+    def lock_held(self) -> bool:
+        return self.with_lock_depth > 0
+
+    # -- traversal -------------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.func_stack.append(node)
+        self.enter_function(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self.func_stack.append(node)
+        self.enter_function(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_with(self, node):
+        locked = any(is_lockish(item.context_expr) for item in node.items)
+        if locked:
+            self.with_lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.with_lock_depth -= 1
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def enter_function(self, node: ast.AST):  # hook for subclasses
+        pass
+
+
+def call_name(call: ast.Call, imports: ImportMap) -> Optional[str]:
+    return imports.resolve(call.func)
+
+
+def body_contains_call(body: List[ast.stmt], imports: ImportMap,
+                       names: Sequence[str],
+                       suffixes: Sequence[str] = ()) -> bool:
+    """Any call in the statement list (recursively) resolving to one of
+    ``names`` (exact) or ``suffixes`` (endswith)?"""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                resolved = imports.resolve(node.func)
+                if resolved is None:
+                    continue
+                if resolved in names:
+                    return True
+                if any(resolved.endswith(s) for s in suffixes):
+                    return True
+    return False
+
+
+def body_contains_raise(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+    return False

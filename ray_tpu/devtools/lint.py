@@ -1,0 +1,457 @@
+"""rtlint: AST-based distributed-correctness static analysis for ray_tpu.
+
+Walks the package's Python sources and reports findings from the rule
+classes in ``ray_tpu.devtools.rules`` — each one targets a bug family
+this codebase has actually shipped (event-loop blocking, non-atomic
+persists, impure traced functions, ...).  Findings carry ``file:line``,
+a stable rule id, and a fix hint.
+
+CLI::
+
+    python -m ray_tpu.devtools.lint ray_tpu            # text report
+    python -m ray_tpu.devtools.lint ray_tpu --format json
+    python -m ray_tpu.devtools.lint --list-rules
+    python -m ray_tpu.devtools.lint ray_tpu --write-baseline
+
+Suppression (same line, or the line above with ``disable-next``)::
+
+    time.sleep(0.1)  # rtlint: disable=RT101
+    # rtlint: disable-next=RT101,RT104
+    rt.get(ref)
+    # rtlint: disable-file=RT103          (anywhere in the file)
+
+Baseline: grandfathered findings live in ``lint_baseline.json`` next to
+this module (override with ``--baseline``).  A finding is keyed by
+(path, rule, hash of the stripped source line) so unrelated edits don't
+invalidate it; ``--write-baseline`` regenerates the file from the
+current tree.  Exit code 0 = clean (or fully baselined), 1 = new
+findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import sys
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ray_tpu.devtools.astutil import ImportMap
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "lint_baseline.json"
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*rtlint:\s*(disable|disable-next|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*|all)"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str
+    line_text: str = ""
+
+    def fingerprint(self) -> str:
+        digest = hashlib.sha1(
+            self.line_text.strip().encode("utf-8", "replace")
+        ).hexdigest()[:12]
+        return f"{self.path}:{self.rule}:{digest}"
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+            "hint": self.hint,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"{self.message} (hint: {self.hint})"
+        )
+
+
+class Rule:
+    """Base class: subclasses set the metadata and a ``visitor_cls``
+    (an ``astutil.ScopedVisitor`` taking ``(rule, ctx)``)."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    hint: str = ""
+    # substrings matched against the posix path; empty = every file
+    path_markers: Tuple[str, ...] = ()
+    visitor_cls = None
+
+    def applies_to(self, path: str) -> bool:
+        if not self.path_markers:
+            return True
+        return any(m in path for m in self.path_markers)
+
+    def check(self, ctx: "ModuleContext") -> None:
+        self.visitor_cls(self, ctx).visit(ctx.tree)
+
+
+class ModuleContext:
+    """Everything a rule needs about one source file."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.imports = ImportMap(tree)
+        self.findings: List[Finding] = []
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def add(
+        self,
+        rule: Rule,
+        node: ast.AST,
+        message: Optional[str] = None,
+        hint: Optional[str] = None,
+    ) -> None:
+        lineno = getattr(node, "lineno", 1)
+        self.findings.append(
+            Finding(
+                path=self.path,
+                line=lineno,
+                col=getattr(node, "col_offset", 0) + 1,
+                rule=rule.id,
+                message=message or rule.description,
+                hint=hint or rule.hint,
+                line_text=self.line_text(lineno),
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def _iter_comment_lines(source: str):
+    """(lineno, comment_text) for real COMMENT tokens only — a
+    directive quoted inside a string literal or docstring (e.g. docs
+    describing the syntax) must NOT arm a suppression."""
+    import io
+    import tokenize
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return  # unparseable tail: no suppressions past this point
+
+
+def _parse_suppressions(source: str):
+    """(line -> set(ids), next_line -> set(ids), file-wide set(ids));
+    the id set may contain 'all'."""
+    per_line: Dict[int, set] = {}
+    per_next: Dict[int, set] = {}
+    file_wide: set = set()
+    for i, text in _iter_comment_lines(source):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, ids_text = m.group(1), m.group(2)
+        ids = {s.strip() for s in ids_text.split(",")}
+        if kind == "disable":
+            per_line.setdefault(i, set()).update(ids)
+        elif kind == "disable-next":
+            per_next.setdefault(i + 1, set()).update(ids)
+        else:
+            file_wide.update(ids)
+    return per_line, per_next, file_wide
+
+
+def _apply_suppressions(ctx: ModuleContext) -> List[Finding]:
+    per_line, per_next, file_wide = _parse_suppressions(ctx.source)
+    kept = []
+    for f in ctx.findings:
+        ids = (
+            per_line.get(f.line, set())
+            | per_next.get(f.line, set())
+            | file_wide
+        )
+        if f.rule in ids or "all" in ids:
+            continue
+        kept.append(f)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+def all_rules() -> List[Rule]:
+    from ray_tpu.devtools.rules import ALL_RULES
+
+    return [cls() for cls in ALL_RULES]
+
+
+def _select_rules(only: Optional[Sequence[str]]) -> List[Rule]:
+    rules = all_rules()
+    if only:
+        wanted = set(only)
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+        rules = [r for r in rules if r.id in wanted]
+    return rules
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>.py",
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint a source string (the fixture-test entry point).  ``path``
+    participates in rule path scoping, so fixtures pass paths like
+    ``pkg/train/ckpt.py`` to arm path-scoped rules."""
+    path = path.replace(os.sep, "/")
+    tree = ast.parse(source, filename=path)
+    ctx = ModuleContext(path, source, tree)
+    for rule in _select_rules(rules):
+        if rule.applies_to(path):
+            rule.check(ctx)
+    ctx.findings = _apply_suppressions(ctx)
+    ctx.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return ctx.findings
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if not os.path.exists(p):
+            # a typo'd path must not report "0 files, clean, exit 0"
+            raise ValueError(f"path does not exist: {p}")
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return out
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]
+    files_scanned: int
+    parse_errors: List[str]
+
+
+def lint_paths(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> LintReport:
+    selected = _select_rules(rules)
+    findings: List[Finding] = []
+    errors: List[str] = []
+    files = iter_py_files(paths)
+    for fpath in files:
+        # Canonicalize to a cwd-relative path when the file is under the
+        # cwd: `lint ray_tpu` (CLI) and `lint_paths([/abs/pkg])` (the
+        # test gate) must produce identical finding paths, or baseline
+        # fingerprints written by one invocation never match the other.
+        rel = fpath
+        if os.path.isabs(fpath):
+            candidate = os.path.relpath(fpath)
+            if not candidate.startswith(".."):
+                rel = candidate
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # An unparseable file is itself a finding (RT000): it means
+            # the module cannot even be imported on this interpreter.
+            errors.append(f"{rel}: {e}")
+            findings.append(Finding(
+                path=rel,
+                line=getattr(e, "lineno", None) or 1,
+                col=getattr(e, "offset", None) or 1,
+                rule="RT000",
+                message=f"file does not parse: {e}",
+                hint="fix the syntax for the supported interpreter",
+                line_text=str(e),
+            ))
+            continue
+        ctx = ModuleContext(rel, source, tree)
+        for rule in selected:
+            if rule.applies_to(rel):
+                rule.check(ctx)
+        findings.extend(_apply_suppressions(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintReport(findings, len(files), errors)
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Counter:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return Counter()
+    return Counter(data.get("findings", {}))
+
+
+def split_baselined(
+    findings: List[Finding], baseline: Counter
+) -> Tuple[List[Finding], List[Finding]]:
+    """(new, grandfathered); each baseline fingerprint absorbs up to its
+    recorded count of identical findings."""
+    budget = Counter(baseline)
+    new, old = [], []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget[fp] > 0:
+            budget[fp] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(findings: List[Finding], path: str) -> None:
+    counts = Counter(f.fingerprint() for f in findings)
+    payload = {
+        "comment": (
+            "rtlint grandfathered findings; regenerate with "
+            "python -m ray_tpu.devtools.lint <paths> --write-baseline"
+        ),
+        "version": 1,
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description="rtlint: distributed-correctness static analysis",
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories (default: ray_tpu)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON path")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from this run")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            scope = ", ".join(rule.path_markers) or "all files"
+            print(f"{rule.id}  {rule.name}  [{scope}]")
+            print(f"    {rule.description}")
+            print(f"    hint: {rule.hint}")
+        return 0
+
+    paths = args.paths or ["ray_tpu"]
+    only = args.rules.split(",") if args.rules else None
+    if args.write_baseline and only:
+        # a subset-rule run would overwrite (and drop) every other
+        # rule's grandfathered fingerprints
+        print(
+            "rtlint: --write-baseline cannot be combined with --rules "
+            "(it would discard baselined findings of unselected rules)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        report = lint_paths(paths, rules=only)
+    except ValueError as e:
+        print(f"rtlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(report.findings, args.baseline)
+        print(
+            f"rtlint: wrote {len(report.findings)} finding(s) to "
+            f"{args.baseline}"
+        )
+        return 0
+
+    baseline = (
+        Counter() if args.no_baseline else load_baseline(args.baseline)
+    )
+    new, grandfathered = split_baselined(report.findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "files_scanned": report.files_scanned,
+                "parse_errors": report.parse_errors,
+                "new_findings": [f.to_dict() for f in new],
+                "baselined_findings": [
+                    f.to_dict() for f in grandfathered
+                ],
+                "counts": dict(Counter(f.rule for f in new)),
+            },
+            indent=2,
+        ))
+    else:
+        for f in new:
+            print(f.render())
+        summary = (
+            f"rtlint: {report.files_scanned} files, "
+            f"{len(new)} new finding(s), "
+            f"{len(grandfathered)} baselined"
+        )
+        if report.parse_errors:
+            summary += f", {len(report.parse_errors)} unparseable"
+            for e in report.parse_errors:
+                print(f"rtlint: parse error: {e}", file=sys.stderr)
+        print(summary)
+
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""rtlint rule registry.
+
+Rule ids are stable and grouped by family:
+
+- RT101 blocking-call-in-async     (async_rules)
+- RT102 non-atomic-write           (persistence)
+- RT103 impure-traced-fn           (traced)
+- RT104 nested-blocking-get        (remote_api)
+- RT105 unawaited-coroutine        (async_rules)
+- RT106 mutable-default-arg        (remote_api)
+- RT107 swallowed-cancellation     (async_rules)
+- RT108 unlocked-lazy-init         (concurrency)
+"""
+
+from ray_tpu.devtools.rules.async_rules import (
+    BlockingCallInAsync,
+    SwallowedCancellation,
+    UnawaitedCoroutine,
+)
+from ray_tpu.devtools.rules.concurrency import UnlockedLazyInit
+from ray_tpu.devtools.rules.persistence import NonAtomicWrite
+from ray_tpu.devtools.rules.remote_api import (
+    MutableDefaultArg,
+    NestedBlockingGet,
+)
+from ray_tpu.devtools.rules.traced import ImpureTracedFn
+
+ALL_RULES = [
+    BlockingCallInAsync,
+    NonAtomicWrite,
+    ImpureTracedFn,
+    NestedBlockingGet,
+    UnawaitedCoroutine,
+    MutableDefaultArg,
+    SwallowedCancellation,
+    UnlockedLazyInit,
+]

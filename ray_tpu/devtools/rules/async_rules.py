@@ -1,0 +1,260 @@
+"""Event-loop correctness rules: RT101, RT105, RT107.
+
+The whole runtime shares ONE asyncio loop per process (core/runtime.py
+runs it on the rt-io thread; serve replicas and async actors execute on
+it directly).  A single blocking call inside an ``async def`` stalls
+every in-flight RPC, actor call, and stream on that process — the
+deadlock class behind the weak ``actor_calls_async_n_n`` benchmark row.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+# Calls that park the calling thread, resolved through the import map.
+_BLOCKING_EXACT = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "ray_tpu.get": "use `await ref` / `await rt.await_ref(ref)`",
+    "ray_tpu.wait": "use `asyncio.wait` on awaitables or rt async APIs",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "socket.getaddrinfo": "use `loop.getaddrinfo`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec`",
+    "subprocess.call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
+    "os.system": "use `asyncio.create_subprocess_shell`",
+}
+_BLOCKING_PREFIX = ("requests.", "urllib.request.", "http.client.")
+
+# Receiver names that conventionally hold the Runtime in this codebase:
+# `rt.get(refs)` inside an async def round-trips through the very loop
+# it is running on — a guaranteed deadlock (runtime.py _run bridges via
+# run_coroutine_threadsafe and blocks on fut.result()).
+_RUNTIME_RECEIVERS = {"rt"}
+_RUNTIME_BLOCKING_ATTRS = {"get", "wait"}
+
+
+class _BlockingVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def visit_Call(self, node: ast.Call):
+        if self.in_async_function:
+            resolved = self.ctx.imports.resolve(node.func)
+            if resolved in _BLOCKING_EXACT:
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"blocking call `{resolved}` inside `async "
+                            f"def` stalls the shared event loop",
+                    hint=_BLOCKING_EXACT[resolved],
+                )
+            elif resolved is not None and resolved.startswith(
+                _BLOCKING_PREFIX
+            ):
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"blocking I/O call `{resolved}` inside "
+                            f"`async def` stalls the shared event loop",
+                )
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                base = node.func.value
+                if attr == "result":
+                    self.ctx.add(
+                        self.rule, node,
+                        message="`.result()` on a future inside `async "
+                                "def` blocks the loop the result may "
+                                "need to arrive on",
+                        hint="await the coroutine/future directly, or "
+                             "wrap with `asyncio.wrap_future`",
+                    )
+                elif (
+                    attr in _RUNTIME_BLOCKING_ATTRS
+                    and isinstance(base, ast.Name)
+                    and base.id in _RUNTIME_RECEIVERS
+                ):
+                    self.ctx.add(
+                        self.rule, node,
+                        message=f"blocking runtime call `{base.id}."
+                                f"{attr}(...)` inside `async def` "
+                                f"deadlocks the io loop it runs on",
+                        hint="use `await rt.await_ref(ref)` / the async "
+                             "runtime APIs",
+                    )
+        self.generic_visit(node)
+
+
+class BlockingCallInAsync(Rule):
+    id = "RT101"
+    name = "blocking-call-in-async"
+    description = (
+        "blocking call (sleep / sync get / sync I/O / future.result) "
+        "inside an `async def` body"
+    )
+    hint = "use the asyncio-native equivalent or asyncio.to_thread"
+    visitor_cls = _BlockingVisitor
+
+
+class _UnawaitedVisitor(astutil.ScopedVisitor):
+    """RT105: coroutine called as a bare statement (never awaited — the
+    body silently never runs) and `.remote()` calls whose ObjectRef is
+    dropped on the floor (task errors become invisible and the result is
+    freed under the caller)."""
+
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        # name -> True for every `async def` in the file, plus
+        # (class, method) pairs for `self.<m>()` resolution
+        self.async_names = set()
+        self.async_methods = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self.async_names.add(node.name)
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.AsyncFunctionDef):
+                        self.async_methods.add((node.name, item.name))
+
+    def visit_Expr(self, node: ast.Expr):
+        call = node.value
+        if isinstance(call, ast.Call):
+            func = call.func
+            if (
+                isinstance(func, ast.Name)
+                and func.id in self.async_names
+            ):
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"coroutine `{func.id}(...)` is never "
+                            f"awaited — its body will not run",
+                    hint="await it, or schedule it with "
+                         "`loop.create_task` and keep the handle",
+                )
+            elif isinstance(func, ast.Attribute):
+                if (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id == "self"
+                    and self.current_class is not None
+                    and (self.current_class.name, func.attr)
+                    in self.async_methods
+                ):
+                    self.ctx.add(
+                        self.rule, node,
+                        message=f"coroutine `self.{func.attr}(...)` is "
+                                f"never awaited — its body will not run",
+                        hint="await it, or schedule it with "
+                             "`loop.create_task` and keep the handle",
+                    )
+                elif func.attr == "remote":
+                    self.ctx.add(
+                        self.rule, node,
+                        message="`.remote()` result dropped — task "
+                                "errors become invisible and the "
+                                "ObjectRef is freed immediately",
+                        hint="keep the ref (and eventually get/wait "
+                             "it), even for fire-and-forget calls",
+                    )
+        self.generic_visit(node)
+
+
+class UnawaitedCoroutine(Rule):
+    id = "RT105"
+    name = "unawaited-coroutine"
+    description = "coroutine never awaited or ObjectRef dropped"
+    hint = "await the coroutine / keep the ObjectRef"
+    visitor_cls = _UnawaitedVisitor
+
+
+class _CancellationVisitor(astutil.ScopedVisitor):
+    """RT107: handlers that eat cancellation/teardown signals on
+    supervision paths.  `except BaseException` (or an explicit
+    `except asyncio.CancelledError`) without a re-raise converts task
+    cancellation into silent success — gang restarts and shutdown paths
+    then hang waiting on work that will never finish."""
+
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def _handler_names(self, type_node):
+        if type_node is None:
+            return []
+        if isinstance(type_node, ast.Tuple):
+            elts = type_node.elts
+        else:
+            elts = [type_node]
+        out = []
+        for e in elts:
+            resolved = self.ctx.imports.resolve(e)
+            if resolved is not None:
+                out.append(resolved)
+        return out
+
+    def _exception_used(self, node: ast.ExceptHandler) -> bool:
+        """The handler binds the exception and the body actually reads
+        it (error-reply conversion, ``session.error = e``, ...) — that's
+        supervision reporting, not swallowing: the failure stays
+        observable somewhere."""
+        if node.name is None:
+            return False
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name) and sub.id == node.name:
+                    return True
+        return False
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        observed = astutil.body_contains_raise(
+            node.body
+        ) or self._exception_used(node)
+        if node.type is None:
+            if not observed:
+                self.ctx.add(
+                    self.rule, node,
+                    message="bare `except:` swallows "
+                            "CancelledError/SystemExit on this path",
+                    hint="catch `Exception` (or the specific errors); "
+                         "re-raise BaseException",
+                )
+        else:
+            names = self._handler_names(node.type)
+            # NOTE: exact names only — this repo's TaskCancelledError is
+            # a task *result* (a remote call was cancelled), and catching
+            # it is normal control flow, not swallowed loop cancellation.
+            swallowed = [
+                n for n in names
+                if n in (
+                    "BaseException",
+                    "CancelledError",
+                    "asyncio.CancelledError",
+                    "concurrent.futures.CancelledError",
+                )
+            ]
+            if swallowed and not observed:
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"`except {swallowed[0]}` without re-raise "
+                            f"swallows cancellation",
+                    hint="re-raise after cleanup (`raise`), or narrow "
+                         "to `Exception`",
+                )
+        self.generic_visit(node)
+
+
+class SwallowedCancellation(Rule):
+    id = "RT107"
+    name = "swallowed-cancellation"
+    description = (
+        "bare except / BaseException / CancelledError handler without "
+        "re-raise"
+    )
+    hint = "re-raise cancellation after cleanup, or narrow the handler"
+    visitor_cls = _CancellationVisitor

@@ -1,0 +1,167 @@
+"""RT108: thread-unsafe lazy init (check-then-set without a lock).
+
+Scoped to the two files where caller threads, the rt-io loop thread,
+and worker executor threads all touch shared state:
+``core/runtime.py`` and ``core/gcs.py``.  Two arms:
+
+- a function that declares ``global X`` and does ``if X is None: X =
+  ...`` outside any ``with <lock>`` — two threads race the init and one
+  of the two constructed objects leaks half-initialized;
+- ``if self._x is None: self._x = ...`` outside a lock in a class that
+  OWNS a ``threading.Lock/RLock/Condition`` (i.e. a class that has
+  already admitted it is shared across threads).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+_LOCK_CTORS = (
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+)
+
+
+def _lazy_check_target(test: ast.AST):
+    """The checked expression for `if X is None:` / `if not X:` shapes,
+    else None."""
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.Is)
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ):
+        return test.left
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return test.operand
+    return None
+
+
+def _is_assign_to(node: ast.AST, target_text: str) -> bool:
+    if isinstance(node, ast.Assign):
+        return any(
+            astutil.dotted_text(t) == target_text for t in node.targets
+        )
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return astutil.dotted_text(node.target) == target_text
+    return False
+
+
+def _assigns_target_unlocked(body, target_text: str) -> bool:
+    """Any assignment to ``target_text`` in the statement list that is
+    NOT under a lock-ish ``with``?  Assignments inside ``with <lock>:``
+    don't count — ``if X is None: with lock: if X is None: X = ...`` is
+    the canonical double-checked pattern this rule's hint recommends,
+    and must stay silent."""
+    for stmt in body:
+        if _is_assign_to(stmt, target_text):
+            return True
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            if any(astutil.is_lockish(i.context_expr) for i in stmt.items):
+                continue  # locked subtree: compliant by definition
+            if _assigns_target_unlocked(stmt.body, target_text):
+                return True
+        elif isinstance(stmt, ast.If):
+            if _assigns_target_unlocked(
+                stmt.body, target_text
+            ) or _assigns_target_unlocked(stmt.orelse, target_text):
+                return True
+        elif isinstance(stmt, ast.Try):
+            for sub in (
+                stmt.body, stmt.orelse, stmt.finalbody,
+                *[h.body for h in stmt.handlers],
+            ):
+                if _assigns_target_unlocked(sub, target_text):
+                    return True
+        elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if _assigns_target_unlocked(
+                stmt.body, target_text
+            ) or _assigns_target_unlocked(stmt.orelse, target_text):
+                return True
+    return False
+
+
+class _LazyInitVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        # classes that construct a threading lock anywhere in their body
+        self.lock_owning_classes = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and ctx.imports.resolve(
+                        sub.func
+                    ) in _LOCK_CTORS:
+                        self.lock_owning_classes.add(node.name)
+                        break
+
+    def _globals_declared(self):
+        fn = self.current_function
+        if fn is None:
+            return set()
+        names = set()
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Global):
+                names.update(stmt.names)
+        return names
+
+    def visit_If(self, node: ast.If):
+        if not self.lock_held:
+            target = _lazy_check_target(node.test)
+            if target is not None:
+                text = astutil.dotted_text(target)
+                if text is not None and _assigns_target_unlocked(
+                    node.body, text
+                ):
+                    self._classify(node, target, text)
+        self.generic_visit(node)
+
+    def _classify(self, node, target, text):
+        if isinstance(target, ast.Name):
+            if target.id in self._globals_declared():
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"check-then-set on module global "
+                            f"`{text}` without holding a lock — "
+                            f"concurrent initializers race",
+                )
+        elif (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self.current_class is not None
+            and self.current_class.name in self.lock_owning_classes
+            and self.current_function is not None
+            and self.current_function.name != "__init__"
+        ):
+            self.ctx.add(
+                self.rule, node,
+                message=f"check-then-set on `{text}` without a lock in "
+                        f"a class that owns one — if this state is "
+                        f"reachable from more than one thread the init "
+                        f"races",
+            )
+
+
+class UnlockedLazyInit(Rule):
+    id = "RT108"
+    name = "unlocked-lazy-init"
+    description = (
+        "check-then-set lazy initialization of shared state without a "
+        "lock"
+    )
+    hint = (
+        "hold the owning lock around the check AND the set (or "
+        "double-check inside it); single-thread-confined state can "
+        "suppress with a comment saying which thread owns it"
+    )
+    path_markers = ("core/runtime.py", "core/gcs.py")
+    visitor_cls = _LazyInitVisitor

@@ -1,0 +1,101 @@
+"""RT102: non-atomic checkpoint/sidecar writes.
+
+The persist-race family fixed by hand in the gang-restart hardening PR:
+a crash between ``open(path, "w")`` and the final ``write()`` leaves a
+truncated file that recovery code then trusts.  Durable state must be
+written to a temp sibling and ``os.replace``d into place (see
+``workflow/storage.py::_atomic_write`` for the canonical shape).
+
+Scoped to the persistence-bearing trees: ``train/``, ``tune/``,
+``workflow/``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+_ATOMIC_MOVES = ("os.replace", "os.rename", "shutil.move")
+
+
+def _expr_mentions_tmp(node: ast.AST) -> bool:
+    """Does the filename expression visibly route through a temp path
+    (`path + ".tmp"`, a `tmp` variable, `tempfile.*`)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            if "tmp" in sub.value.lower() or "temp" in sub.value.lower():
+                return True
+        elif isinstance(sub, ast.Name):
+            if "tmp" in sub.id.lower() or "temp" in sub.id.lower():
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if "tmp" in sub.attr.lower() or "temp" in sub.attr.lower():
+                return True
+    return False
+
+
+class _AtomicWriteVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def _write_mode(self, call: ast.Call):
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if (
+            isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and "w" in mode.value
+        ):
+            return mode.value
+        return None
+
+    def _enclosing_is_atomic(self) -> bool:
+        fn = self.current_function
+        if fn is None:
+            return False
+        if "atomic" in fn.name.lower():
+            return True
+        return astutil.body_contains_call(
+            fn.body, self.ctx.imports, _ATOMIC_MOVES,
+            suffixes=("_atomic_write", "atomic_write"),
+        )
+
+    def visit_Call(self, node: ast.Call):
+        resolved = self.ctx.imports.resolve(node.func)
+        if resolved == "open" and node.args:
+            mode = self._write_mode(node)
+            if mode is not None:
+                target = node.args[0]
+                if not _expr_mentions_tmp(target) and (
+                    not self._enclosing_is_atomic()
+                ):
+                    self.ctx.add(
+                        self.rule, node,
+                        message=f"non-atomic write: `open(..., "
+                                f"\"{mode}\")` straight to the final "
+                                f"path — a crash mid-write leaves a "
+                                f"truncated file recovery will trust",
+                    )
+        self.generic_visit(node)
+
+
+class NonAtomicWrite(Rule):
+    id = "RT102"
+    name = "non-atomic-write"
+    description = (
+        "durable file written in place instead of temp-file + rename"
+    )
+    hint = (
+        "write to `<path>.tmp`, flush+fsync, then `os.replace(tmp, "
+        "path)` (see workflow/storage.py::_atomic_write)"
+    )
+    path_markers = ("train/", "tune/", "workflow/")
+    visitor_cls = _AtomicWriteVisitor

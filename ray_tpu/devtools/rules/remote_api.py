@@ -1,0 +1,166 @@
+"""Remote-surface rules: RT104 (nested blocking get) and RT106
+(mutable default arguments on remote functions / actor classes).
+
+RT104: a remote function or actor method that calls ``ray_tpu.get()``
+occupies its leased worker while waiting on a task that may need that
+same worker — the nested-get deadlock (reference: Ray's long-standing
+"don't block in tasks" guidance; this runtime's leases make it a hard
+hang once the pool saturates).
+
+RT106: a remote function's defaults are captured ONCE when the function
+is exported (cloudpickled); a mutable default then aliases one object
+across every execution on a worker — cross-call state leakage that only
+shows up under load.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+_BLOCKING_GET = {"ray_tpu.get", "ray_tpu.wait"}
+_RUNTIME_RECEIVERS = {"rt"}
+
+
+class _NestedGetVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.remote_stack = []
+
+    def _in_remote_body(self) -> bool:
+        return bool(self.remote_stack) and self.remote_stack[-1]
+
+    def enter_function(self, node):
+        remote = astutil.is_remote_decorated(node, self.ctx.imports)
+        if (
+            not remote
+            and self.current_class is not None
+            and len(self.func_stack) == 1
+            and astutil.is_remote_decorated(
+                self.current_class, self.ctx.imports
+            )
+        ):
+            remote = True  # actor method
+        self.remote_stack.append(
+            remote or bool(self.remote_stack and self.remote_stack[-1])
+        )
+
+    def visit_FunctionDef(self, node):
+        super().visit_FunctionDef(node)
+        self.remote_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node):
+        super().visit_AsyncFunctionDef(node)
+        self.remote_stack.pop()
+
+    def _has_bounded_timeout(self, node: ast.Call) -> bool:
+        """An explicit non-None ``timeout=`` bounds the wait: the call
+        degrades to latency instead of deadlock, which is the documented
+        pattern for supervision actors (serve controller health probes,
+        route polls).  ``timeout=None`` spelled out still flags."""
+        for kw in node.keywords:
+            if kw.arg == "timeout":
+                return not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None
+                )
+        return False
+
+    def visit_Call(self, node: ast.Call):
+        if self._in_remote_body() and not self._has_bounded_timeout(node):
+            resolved = self.ctx.imports.resolve(node.func)
+            flagged = resolved in _BLOCKING_GET
+            if (
+                not flagged
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "wait")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in _RUNTIME_RECEIVERS
+            ):
+                flagged = True
+                resolved = (
+                    f"{node.func.value.id}.{node.func.attr}"
+                )
+            if flagged:
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"blocking `{resolved}(...)` inside a "
+                            f"remote function / actor method holds the "
+                            f"leased worker while waiting — nested-get "
+                            f"deadlock once the pool saturates",
+                )
+        self.generic_visit(node)
+
+
+class NestedBlockingGet(Rule):
+    id = "RT104"
+    name = "nested-blocking-get"
+    description = (
+        "ray_tpu.get()/wait() inside a remote function or actor method"
+    )
+    hint = (
+        "pass ObjectRefs through as arguments (the scheduler resolves "
+        "them before dispatch), or make the actor async and await"
+    )
+    visitor_cls = _NestedGetVisitor
+
+
+_MUTABLE_CTORS = {"dict", "list", "set", "collections.defaultdict",
+                  "collections.OrderedDict", "collections.deque"}
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        resolved = astutil.dotted_text(node.func)
+        return resolved in _MUTABLE_CTORS
+    return False
+
+
+class _MutableDefaultVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def enter_function(self, node):
+        remote = astutil.is_remote_decorated(node, self.ctx.imports)
+        if (
+            not remote
+            and self.current_class is not None
+            and len(self.func_stack) == 1
+        ):
+            remote = astutil.is_remote_decorated(
+                self.current_class, self.ctx.imports
+            )
+        if not remote:
+            return
+        args = node.args
+        defaults = list(args.defaults) + [
+            d for d in args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            if _is_mutable_default(d):
+                self.ctx.add(
+                    self.rule, d,
+                    message=f"mutable default on remote "
+                            f"`{node.name}(...)` is captured once at "
+                            f"export and shared across every execution "
+                            f"on a worker",
+                )
+
+
+class MutableDefaultArg(Rule):
+    id = "RT106"
+    name = "mutable-default-arg"
+    description = (
+        "mutable default argument on a remote function or actor method"
+    )
+    hint = "default to None and construct inside the body"
+    visitor_cls = _MutableDefaultVisitor

@@ -1,0 +1,148 @@
+"""RT103: host-side impurity inside jit/pjit/shard_map-traced functions.
+
+A traced function runs ONCE at trace time; `time.time()`, `np.random`,
+`.item()` and friends bake a single host value into the compiled
+program (or silently force a device sync), so every later step reuses
+the trace-time value — the classic "my noise is identical every step"
+bug.  Scoped to the compiled-model trees: ``models/``, ``ops/``,
+``parallel/``, ``train/``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+_JIT_EXACT = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+_JIT_SUFFIX = ("shard_map",)
+
+_IMPURE_EXACT = {
+    "time.time": "thread a step counter / use jax.lax primitives",
+    "time.monotonic": "time outside the traced function",
+    "time.perf_counter": "time outside the traced function",
+    "time.time_ns": "time outside the traced function",
+    "datetime.datetime.now": "timestamp outside the traced function",
+    "jax.device_get": "return the array; transfer outside the trace",
+    "print": "use `jax.debug.print` (runs per-execution, not per-trace)",
+}
+_IMPURE_PREFIX = ("numpy.random.", "random.")
+_IMPURE_ATTRS = {
+    "item": "forces a device sync and bakes in the trace-time value",
+    "block_until_ready": "host sync inside a trace is a no-op footgun",
+}
+
+
+def _is_jit_name(resolved) -> bool:
+    if resolved is None:
+        return False
+    return resolved in _JIT_EXACT or resolved.endswith(_JIT_SUFFIX)
+
+
+class _TracedVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        # functions wrapped by assignment (`step = jax.jit(train_step)`)
+        # or passed straight into a jit call anywhere in the module
+        self.jitted_names = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_jit_name(
+                ctx.imports.resolve(node.func)
+            ):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    self.jitted_names.add(node.args[0].id)
+                for kw in node.keywords:
+                    if kw.arg in ("fun", "f") and isinstance(
+                        kw.value, ast.Name
+                    ):
+                        self.jitted_names.add(kw.value.id)
+        self.traced_stack = []
+
+    def _is_traced_def(self, node) -> bool:
+        if node.name in self.jitted_names:
+            return True
+        for resolved, dec in astutil.resolved_decorators(
+            node, self.ctx.imports
+        ):
+            if _is_jit_name(resolved):
+                return True
+            # @partial(jax.jit, static_argnums=...) / @partial(shard_map, ...)
+            if resolved in ("functools.partial", "partial") and isinstance(
+                dec, ast.Call
+            ) and dec.args:
+                inner = self.ctx.imports.resolve(dec.args[0])
+                if _is_jit_name(inner):
+                    return True
+        return False
+
+    def enter_function(self, node):
+        # a def nested inside a traced function is traced with it
+        traced = self._is_traced_def(node) or bool(
+            self.traced_stack and self.traced_stack[-1]
+        )
+        self.traced_stack.append(traced)
+
+    def visit_FunctionDef(self, node):
+        super().visit_FunctionDef(node)
+        self.traced_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node):
+        super().visit_AsyncFunctionDef(node)
+        self.traced_stack.pop()
+
+    @property
+    def in_traced(self) -> bool:
+        return bool(self.traced_stack) and self.traced_stack[-1]
+
+    def visit_Call(self, node: ast.Call):
+        if self.in_traced:
+            resolved = self.ctx.imports.resolve(node.func)
+            if resolved in _IMPURE_EXACT:
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"host-side `{resolved}` inside a traced "
+                            f"function runs once at trace time, not "
+                            f"per step",
+                    hint=_IMPURE_EXACT[resolved],
+                )
+            elif resolved is not None and resolved.startswith(
+                _IMPURE_PREFIX
+            ):
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"host RNG `{resolved}` inside a traced "
+                            f"function is frozen at trace time",
+                    hint="use `jax.random` with an explicit threaded key",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _IMPURE_ATTRS
+                and not node.args
+            ):
+                self.ctx.add(
+                    self.rule, node,
+                    message=f"`.{node.func.attr}()` inside a traced "
+                            f"function: "
+                            f"{_IMPURE_ATTRS[node.func.attr]}",
+                    hint="keep values on-device inside the trace",
+                )
+        self.generic_visit(node)
+
+
+class ImpureTracedFn(Rule):
+    id = "RT103"
+    name = "impure-traced-fn"
+    description = (
+        "host-side impurity (wall clock / host RNG / device sync) "
+        "inside a jit/pjit/shard_map-traced function"
+    )
+    hint = "traced code must be pure; move host effects outside the trace"
+    path_markers = ("models/", "ops/", "parallel/", "train/")
+    visitor_cls = _TracedVisitor

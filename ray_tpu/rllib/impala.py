@@ -205,6 +205,11 @@ class IMPALA(Algorithm):
             # fresh weights to THIS runner only; relaunch immediately —
             # other runners keep sampling under their slightly-stale
             # policies (that lag is exactly what V-trace corrects)
+            # dropped ref is safe: per-caller actor-call ordering runs
+            # set_weights BEFORE the sample.remote below on the same
+            # runner, and a set_weights failure surfaces through that
+            # tracked sample ref
+            # rtlint: disable-next=RT105
             runner.set_weights.remote(self._ray.put(self.learner.params))
             self._inflight[
                 runner.sample.remote(c.rollout_fragment_length)

@@ -44,6 +44,17 @@ def _ckpt_round(path: str) -> Optional[int]:
     return None
 
 
+def _atomic_write(path: str, blob: bytes) -> None:
+    """tmp + fsync + rename (same shape as workflow/storage.py): durable
+    files must never be readable half-written."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def _write_metrics_sidecar(ckpt_path: str, metrics: Dict[str, Any]) -> None:
     """Best-effort: written AFTER persist() returns, so its presence also
     marks the checkpoint directory as completely persisted.  Serialized
@@ -51,11 +62,10 @@ def _write_metrics_sidecar(ckpt_path: str, metrics: Dict[str, Any]) -> None:
     mid-write crash must not leave a truncated sidecar that wins the
     completeness tie-break while being unreadable."""
     try:
-        blob = pickle.dumps(dict(metrics))
-        tmp = os.path.join(ckpt_path, _METRICS_FILE + ".tmp")
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, os.path.join(ckpt_path, _METRICS_FILE))
+        _atomic_write(
+            os.path.join(ckpt_path, _METRICS_FILE),
+            pickle.dumps(dict(metrics)),
+        )
     except Exception:
         pass  # unpicklable metrics must not fail report()
 
@@ -91,8 +101,11 @@ class Checkpoint:
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
         d = tempfile.mkdtemp(prefix="rt_ckpt_")
-        with open(os.path.join(d, _DICT_FILE), "wb") as f:
-            pickle.dump(data, f)
+        # atomic even inside the fresh scratch dir: persist() may later
+        # shutil.move() it across filesystems (copy, not rename), and
+        # recovery must never see a truncated pickle win a completeness
+        # tie-break
+        _atomic_write(os.path.join(d, _DICT_FILE), pickle.dumps(data))
         return cls(d, _temp=True)
 
     # -- accessors -------------------------------------------------------

@@ -92,6 +92,10 @@ class TuneController:
             experiment_name=self.experiment_name,
             trial_dir=f"{self.experiment_dir}/{trial.trial_id}",
         )
+        # dropped ref is safe: the run loop tracks this trial through
+        # next_report refs on the same actor — a failed start kills the
+        # actor and surfaces as an errored report there
+        # rtlint: disable-next=RT105
         trial.actor.start_training.remote(
             self.trainable, trial.config, ctx, from_checkpoint
         )

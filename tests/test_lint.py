@@ -1,0 +1,603 @@
+"""rtlint: per-rule fixture pairs + the whole-package clean gate.
+
+Every rule must flag its positive fixture and stay silent on the
+compliant twin — the twin pairs are the precision contract, so a rule
+change that starts flagging idiomatic code fails here before it fails
+on the tree.  The final test runs the real linter over the installed
+package and is what keeps the tree clean going forward.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.devtools.lint import (
+    DEFAULT_BASELINE,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    split_baselined,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+
+
+def findings(src, path="pkg/mod.py", rules=None):
+    return lint_source(textwrap.dedent(src), path=path, rules=rules)
+
+
+def rule_ids(src, path="pkg/mod.py", rules=None):
+    return [f.rule for f in findings(src, path=path, rules=rules)]
+
+
+# ---------------------------------------------------------------------------
+# RT101 blocking-call-in-async
+# ---------------------------------------------------------------------------
+
+
+class TestBlockingCallInAsync:
+    def test_flags_time_sleep_in_async_def(self):
+        src = """
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+        """
+        assert rule_ids(src, rules=["RT101"]) == ["RT101"]
+
+    def test_flags_aliased_sleep_and_future_result(self):
+        src = """
+        from time import sleep
+
+        async def handler(fut):
+            sleep(1)
+            x = fut.result(5)
+        """
+        assert rule_ids(src, rules=["RT101"]) == ["RT101", "RT101"]
+
+    def test_flags_sync_runtime_get_in_async(self):
+        src = """
+        import ray_tpu
+
+        async def handler(ref, rt):
+            ray_tpu.get(ref)
+            rt.get(ref)
+        """
+        assert rule_ids(src, rules=["RT101"]) == ["RT101", "RT101"]
+
+    def test_silent_on_awaited_equivalents(self):
+        src = """
+        import asyncio
+
+        async def handler(rt, ref):
+            await asyncio.sleep(0.1)
+            return await rt.await_ref(ref)
+        """
+        assert rule_ids(src, rules=["RT101"]) == []
+
+    def test_silent_on_sync_def_nested_in_async(self):
+        # helpers defined inside an async def but shipped to an
+        # executor thread may block freely
+        src = """
+        import subprocess, asyncio
+
+        async def ensure_env():
+            def build():
+                subprocess.run(["pip", "install", "x"], check=True)
+
+            await asyncio.to_thread(build)
+        """
+        assert rule_ids(src, rules=["RT101"]) == []
+
+    def test_silent_in_plain_sync_function(self):
+        src = """
+        import time
+
+        def driver():
+            time.sleep(0.1)
+        """
+        assert rule_ids(src, rules=["RT101"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT102 non-atomic-write
+# ---------------------------------------------------------------------------
+
+
+class TestNonAtomicWrite:
+    PATH = "pkg/train/ckpt.py"
+
+    def test_flags_in_place_write(self):
+        src = """
+        def save(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT102"]) == ["RT102"]
+
+    def test_silent_on_tmp_plus_replace(self):
+        src = """
+        import os
+
+        def save(path, blob):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT102"]) == []
+
+    def test_silent_on_reads_and_outside_persistence_dirs(self):
+        read_src = """
+        def load(path):
+            with open(path, "rb") as f:
+                return f.read()
+        """
+        assert rule_ids(read_src, path=self.PATH, rules=["RT102"]) == []
+        write_src = """
+        def save(path, blob):
+            with open(path, "w") as f:
+                f.write(blob)
+        """
+        # same write outside train/tune/workflow is out of scope
+        assert rule_ids(
+            write_src, path="pkg/util/misc.py", rules=["RT102"]
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# RT103 impure-traced-fn
+# ---------------------------------------------------------------------------
+
+
+class TestImpureTracedFn:
+    PATH = "pkg/models/net.py"
+
+    def test_flags_wall_clock_and_host_rng_under_jit(self):
+        src = """
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            noise = np.random.normal(size=3)
+            return x + t + noise
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT103"]) == [
+            "RT103", "RT103",
+        ]
+
+    def test_flags_item_in_partial_jit_and_assignment_form(self):
+        src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=0)
+        def decorated(n, x):
+            return x.item()
+
+        def wrapped(x):
+            return x.item()
+
+        fast = jax.jit(wrapped)
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT103"]) == [
+            "RT103", "RT103",
+        ]
+
+    def test_silent_on_pure_jit_and_untraced_host_code(self):
+        src = """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, key):
+            return x * jax.random.normal(key, x.shape)
+
+        def host_loop(x):
+            t0 = time.time()
+            return float(x.sum()), time.time() - t0
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT103"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT104 nested-blocking-get
+# ---------------------------------------------------------------------------
+
+
+class TestNestedBlockingGet:
+    def test_flags_unbounded_get_in_remote_fn_and_actor_method(self):
+        src = """
+        import ray_tpu
+
+        @ray_tpu.remote
+        def task(ref):
+            return ray_tpu.get(ref)
+
+        @ray_tpu.remote
+        class Actor:
+            def method(self, ref):
+                return ray_tpu.get(ref)
+        """
+        assert rule_ids(src, rules=["RT104"]) == ["RT104", "RT104"]
+
+    def test_silent_with_bounded_timeout_or_outside_remote(self):
+        src = """
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Supervisor:
+            def probe(self, refs):
+                return ray_tpu.wait(refs, timeout=10.0)
+
+        def driver(ref):
+            return ray_tpu.get(ref)
+        """
+        assert rule_ids(src, rules=["RT104"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT105 unawaited-coroutine / dropped ObjectRef
+# ---------------------------------------------------------------------------
+
+
+class TestUnawaitedCoroutine:
+    def test_flags_bare_coroutine_calls(self):
+        src = """
+        async def notify():
+            ...
+
+        class Svc:
+            async def push(self):
+                ...
+
+            async def run(self):
+                notify()
+                self.push()
+        """
+        assert rule_ids(src, rules=["RT105"]) == ["RT105", "RT105"]
+
+    def test_flags_dropped_object_ref(self):
+        src = """
+        def kick(actor):
+            actor.step.remote()
+        """
+        assert rule_ids(src, rules=["RT105"]) == ["RT105"]
+
+    def test_silent_when_awaited_scheduled_or_kept(self):
+        src = """
+        import asyncio
+
+        async def notify():
+            ...
+
+        async def run(actor, loop):
+            await notify()
+            task = loop.create_task(notify())
+            ref = actor.step.remote()
+            return task, ref
+        """
+        assert rule_ids(src, rules=["RT105"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT106 mutable-default-arg
+# ---------------------------------------------------------------------------
+
+
+class TestMutableDefaultArg:
+    def test_flags_remote_fn_and_actor_method_defaults(self):
+        src = """
+        import ray_tpu
+
+        @ray_tpu.remote
+        def task(acc=[]):
+            return acc
+
+        @ray_tpu.remote
+        class Actor:
+            def method(self, opts={}):
+                return opts
+        """
+        assert rule_ids(src, rules=["RT106"]) == ["RT106", "RT106"]
+
+    def test_silent_on_none_default_and_plain_functions(self):
+        src = """
+        import ray_tpu
+
+        @ray_tpu.remote
+        def task(acc=None):
+            return acc or []
+
+        def local_helper(acc=[]):
+            return acc
+        """
+        assert rule_ids(src, rules=["RT106"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT107 swallowed-cancellation
+# ---------------------------------------------------------------------------
+
+
+class TestSwallowedCancellation:
+    def test_flags_bare_except_and_swallowed_base_exception(self):
+        src = """
+        import asyncio
+
+        def supervise(fn):
+            try:
+                fn()
+            except:
+                pass
+
+        async def pump(fn):
+            try:
+                await fn()
+            except asyncio.CancelledError:
+                return None
+        """
+        assert rule_ids(src, rules=["RT107"]) == ["RT107", "RT107"]
+
+    def test_silent_on_reraise_or_reported_exception(self):
+        src = """
+        def supervise(fn, session):
+            try:
+                fn()
+            except BaseException as e:
+                session.error = e
+
+        async def pump(fn):
+            try:
+                await fn()
+            except BaseException:
+                raise
+        """
+        assert rule_ids(src, rules=["RT107"]) == []
+
+    def test_silent_on_task_cancelled_error_result_handling(self):
+        # this repo's TaskCancelledError is a task *result*, not loop
+        # cancellation — catching it is normal control flow
+        src = """
+        from ray_tpu.core.errors import TaskCancelledError
+
+        def collect(ref, get):
+            try:
+                return get(ref)
+            except TaskCancelledError:
+                return None
+        """
+        assert rule_ids(src, rules=["RT107"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT108 unlocked-lazy-init
+# ---------------------------------------------------------------------------
+
+
+class TestUnlockedLazyInit:
+    PATH = "pkg/core/runtime.py"
+
+    def test_flags_global_check_then_set_without_lock(self):
+        src = """
+        _singleton = None
+
+        def get_singleton():
+            global _singleton
+            if _singleton is None:
+                _singleton = object()
+            return _singleton
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT108"]) == ["RT108"]
+
+    def test_flags_self_attr_lazy_init_in_lock_owning_class(self):
+        src = """
+        import threading
+
+        class Runtime:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._conn = None
+
+            def conn(self):
+                if self._conn is None:
+                    self._conn = connect()
+                return self._conn
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT108"]) == ["RT108"]
+
+    def test_silent_when_lock_held_or_out_of_scope(self):
+        src = """
+        import threading
+
+        _singleton = None
+        _init_lock = threading.Lock()
+
+        class Runtime:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._conn = None
+
+            def conn(self):
+                with self._lock:
+                    if self._conn is None:
+                        self._conn = connect()
+                return self._conn
+
+        def get_singleton():
+            global _singleton
+            with _init_lock:
+                if _singleton is None:
+                    _singleton = object()
+            return _singleton
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT108"]) == []
+        # local-variable lazy init anywhere is fine
+        local = """
+        def f(ev=None):
+            if ev is None:
+                ev = object()
+            return ev
+        """
+        assert rule_ids(local, path=self.PATH, rules=["RT108"]) == []
+
+    def test_silent_on_double_checked_locking(self):
+        # the exact pattern the rule's hint recommends (and that
+        # _native/store.py::_get_lib uses) must not be flagged
+        src = """
+        import threading
+
+        _lib = None
+        _lib_lock = threading.Lock()
+
+        def get_lib():
+            global _lib
+            if _lib is None:
+                with _lib_lock:
+                    if _lib is None:
+                        _lib = object()
+            return _lib
+        """
+        assert rule_ids(src, path=self.PATH, rules=["RT108"]) == []
+
+
+# ---------------------------------------------------------------------------
+# Framework: suppressions, baseline, parse errors
+# ---------------------------------------------------------------------------
+
+
+class TestFramework:
+    SRC = """
+    import time
+
+    async def handler():
+        time.sleep(0.1)
+    """
+
+    def test_same_line_suppression(self):
+        src = """
+        import time
+
+        async def handler():
+            time.sleep(0.1)  # rtlint: disable=RT101
+        """
+        assert rule_ids(src) == []
+
+    def test_disable_next_and_disable_file(self):
+        src = """
+        import time
+
+        async def handler():
+            # rtlint: disable-next=RT101
+            time.sleep(0.1)
+        """
+        assert rule_ids(src) == []
+        src_file = "# rtlint: disable-file=RT101\n" + textwrap.dedent(
+            self.SRC
+        )
+        assert lint_source(src_file) == []
+
+    def test_directives_in_docstrings_do_not_suppress(self):
+        # only real COMMENT tokens arm suppressions — docs QUOTING the
+        # syntax (like this repo's own lint.py docstring) must not
+        src = '''
+        """Docs: suppress with `# rtlint: disable-file=RT101`."""
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+        '''
+        assert rule_ids(src, rules=["RT101"]) == ["RT101"]
+
+    def test_write_baseline_refuses_rule_subset(self, tmp_path, capsys):
+        from ray_tpu.devtools.lint import main
+
+        rc = main([
+            str(tmp_path), "--rules", "RT101", "--write-baseline",
+            "--baseline", str(tmp_path / "b.json"),
+        ])
+        assert rc == 2
+        assert not (tmp_path / "b.json").exists()
+
+    def test_suppression_is_per_rule(self):
+        src = """
+        import time
+
+        async def handler():
+            time.sleep(0.1)  # rtlint: disable=RT999
+        """
+        assert rule_ids(src) == ["RT101"]
+
+    def test_baseline_absorbs_exact_findings_only(self):
+        fs = findings(self.SRC)
+        assert [f.rule for f in fs] == ["RT101"]
+        from collections import Counter
+
+        baseline = Counter(f.fingerprint() for f in fs)
+        new, old = split_baselined(fs, baseline)
+        assert new == [] and len(old) == 1
+        # a different finding is NOT absorbed
+        other = findings(
+            self.SRC.replace("time.sleep(0.1)", "time.sleep(99)")
+        )
+        new, old = split_baselined(other, baseline)
+        assert len(new) == 1 and old == []
+
+    def test_unknown_rule_id_raises(self):
+        with pytest.raises(ValueError):
+            lint_source("x = 1", rules=["RT999"])
+
+    def test_unparseable_file_is_a_finding(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        report = lint_paths([str(bad)])
+        assert [f.rule for f in report.findings] == ["RT000"]
+        assert report.parse_errors
+
+    def test_nonexistent_path_raises_instead_of_reporting_clean(self):
+        with pytest.raises(ValueError, match="does not exist"):
+            lint_paths(["no/such/dir"])
+
+    def test_absolute_and_relative_invocations_share_fingerprints(
+        self, tmp_path, monkeypatch
+    ):
+        # `--write-baseline` from the CLI (relative paths) must produce
+        # fingerprints the absolute-path test gate can consume
+        pkg = tmp_path / "proj" / "mod"
+        pkg.mkdir(parents=True)
+        (pkg / "m.py").write_text(
+            "import time\n\nasync def h():\n    time.sleep(1)\n"
+        )
+        monkeypatch.chdir(tmp_path / "proj")
+        rel = lint_paths(["mod"]).findings
+        ab = lint_paths([str(pkg)]).findings
+        assert [f.fingerprint() for f in rel] == [
+            f.fingerprint() for f in ab
+        ]
+        assert rel[0].path == "mod/m.py"
+
+
+# ---------------------------------------------------------------------------
+# The gate: the installed package stays clean
+# ---------------------------------------------------------------------------
+
+
+def test_whole_package_has_no_non_baselined_findings():
+    report = lint_paths([PKG])
+    assert report.files_scanned > 100
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new, _old = split_baselined(report.findings, baseline)
+    assert new == [], (
+        "rtlint found new issues (fix them, suppress with a justified "
+        "`# rtlint: disable=...`, or — for grandfathered debt — "
+        "regenerate the baseline):\n"
+        + "\n".join(f.render() for f in new)
+    )

@@ -21,14 +21,39 @@ DRIVER = os.path.join(REPO, "tests", "native", "stress_shm_store.cc")
 
 @pytest.fixture(scope="module")
 def stress_bin(tmp_path_factory):
+    # Skip LOUDLY (not silently pass, not fail) when the toolchain can't
+    # produce a sanitized binary — a host without g++ or without
+    # libasan/libubsan must report "sanitizer coverage did not run", so
+    # a green suite can never be mistaken for a clean sanitizer pass.
+    # Build flags are documented in docs/architecture.md ("Static
+    # analysis" → sanitizer harness).
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("sanitizer stress build unavailable: no g++ on PATH")
     out = str(tmp_path_factory.mktemp("san") / "stress_shm_store")
-    build = subprocess.run(
-        ["g++", "-O1", "-g", "-std=c++17", "-pthread",
-         "-fsanitize=address,undefined", "-fno-omit-frame-pointer",
-         DRIVER, SRC, "-o", out],
-        capture_output=True, text=True, timeout=300,
-    )
-    assert build.returncode == 0, build.stderr[-2000:]
+    try:
+        build = subprocess.run(
+            ["g++", "-O1", "-g", "-std=c++17", "-pthread",
+             "-fsanitize=address,undefined", "-fno-omit-frame-pointer",
+             DRIVER, SRC, "-o", out],
+            capture_output=True, text=True, timeout=300,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("sanitizer stress build unavailable: g++ timed out")
+    if build.returncode != 0:
+        err = build.stderr or ""
+        missing_rt = any(
+            s in err for s in ("cannot find -lasan", "cannot find -lubsan",
+                               "unrecognized argument to '-fsanitize'",
+                               "unrecognized command line option")
+        )
+        if missing_rt:
+            pytest.skip(
+                "sanitizer stress build unavailable: toolchain lacks "
+                f"ASan/UBSan runtimes — {err.strip().splitlines()[-1]}"
+            )
+        pytest.fail(f"sanitizer stress build failed:\n{err[-2000:]}")
     return out
 
 
