@@ -99,6 +99,15 @@ D("pip_env_install_timeout_s", float, 600.0)
 D("conda_exe", str, "")
 D("container_runtime", str, "")
 
+# --- runtime collectives (util/collective; reference: ray.util.collective)
+D("collective_chunk_bytes", int, 4 * 1024 * 1024)  # ring transfer chunk
+# co-hosted ranks hand chunks through the shm arena past this size
+# (below it, the pickle5 oob-buffer wire path is cheaper than an
+# arena create/seal/delete round trip)
+D("collective_shm_min_bytes", int, 64 * 1024)
+D("collective_op_timeout_s", float, 120.0)  # per-wait peer-traffic budget
+D("collective_rendezvous_timeout_s", float, 60.0)
+
 # --- streaming generator returns (reference: num_returns="streaming")
 D("streaming_backpressure_items", int, 64)  # unacked items before the
 #   producing worker pauses the generator

@@ -248,6 +248,16 @@ class Runtime:
         self._lineage: Dict[bytes, dict] = {}          # task_id -> entry
         self._lineage_by_return: Dict[bytes, bytes] = {}  # oid -> task_id
 
+        # subsystem RPC methods: method name -> async handler(conn, payload).
+        # Libraries (util.collective is the first) claim a method name and
+        # receive every inbound request/notify for it, whichever channel it
+        # arrived on — the worker's server or a caller→worker connection.
+        self._rpc_subhandlers: Dict[str, Any] = {}
+        # peer-connection lifecycle observers: callback(conn) fired on the
+        # io loop when any worker-peer connection (dialed or accepted)
+        # closes — the liveness signal group-membership code keys off
+        self._peer_close_watchers: List[Any] = []
+
         # pubsub: channel -> callback (driver log streaming rides this)
         self._subscriptions: Dict[str, Any] = {}
         # job attribution for log streaming: drivers use job_id; workers
@@ -774,7 +784,42 @@ class Runtime:
         if method == "stream_item":
             self._deliver_stream_item(conn, p)
             return True
+        sub = self._rpc_subhandlers.get(method)
+        if sub is not None:
+            return await sub(conn, p)
         raise rpc.RpcError(f"unexpected inbound {method!r} on worker conn")
+
+    # ---- subsystem RPC + peer channels ---------------------------------
+    def register_rpc_handler(self, method: str, handler) -> None:
+        """Claim an RPC method name for a subsystem.  ``handler`` is an
+        ``async (conn, payload) -> result`` invoked on the io loop for
+        every inbound request/notify carrying that method (on the worker
+        server and on caller→worker connections alike)."""
+        existing = self._rpc_subhandlers.get(method)
+        if existing is not None and existing is not handler:
+            raise ValueError(f"rpc method {method!r} already registered")
+        self._rpc_subhandlers[method] = handler
+
+    def add_peer_close_watcher(self, cb) -> None:
+        """Observe worker-peer connection closures (io loop callback)."""
+        if cb not in self._peer_close_watchers:
+            self._peer_close_watchers.append(cb)
+
+    def _notify_peer_closed(self, conn) -> None:
+        for cb in list(self._peer_close_watchers):
+            try:
+                cb(conn)
+            except Exception:
+                logger.exception("peer close watcher failed")
+
+    async def peer_connection(self, addr: str) -> rpc.Connection:
+        """Peer channel acquisition: a (cached) duplex connection to
+        another worker's RPC server, usable from inside actors for
+        direct worker↔worker traffic (the runtime-collective data
+        plane).  Shares the cache with the task-dispatch path, so a
+        collective group and a task stream to the same peer ride one
+        TCP connection."""
+        return await self._connect_worker(addr)
 
     def _deliver_stream_item(self, conn, p: dict):
         tid = p["task_id"]
@@ -1504,10 +1549,18 @@ class Runtime:
         conn = self._worker_conns.get(addr)
         if conn is None or conn.closed:
             conn = await rpc.connect(
-                addr, self._worker_inbound, name=f"->worker@{addr}"
+                addr, self._worker_inbound, name=f"->worker@{addr}",
+                on_close=self._on_worker_conn_closed,
             )
+            conn.peer_info["addr"] = addr
             self._worker_conns[addr] = conn
         return conn
+
+    def _on_worker_conn_closed(self, conn) -> None:
+        addr = conn.peer_info.get("addr")
+        if addr is not None and self._worker_conns.get(addr) is conn:
+            self._worker_conns.pop(addr, None)
+        self._notify_peer_closed(conn)
 
     async def _dispatch(self, class_key, lease: Lease, task: PendingTask,
                         resources, strategy):

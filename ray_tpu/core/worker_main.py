@@ -38,7 +38,10 @@ logger = logging.getLogger(__name__)
 class WorkerServer:
     def __init__(self, runtime: Runtime):
         self.rt = runtime
-        self.server = rpc.Server(self._handle, host="127.0.0.1", port=0)
+        self.server = rpc.Server(
+            self._handle, host="127.0.0.1", port=0,
+            on_close=runtime._notify_peer_closed,
+        )
         self._exec = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rt-exec")
         self._exec_thread_id: Optional[int] = None
         self.actor_instance: Any = None
@@ -64,6 +67,12 @@ class WorkerServer:
         # that has proven consistently fast runs directly on the io loop.
         # method name -> [fast_streak, demoted]
         self._method_stats: Dict[str, list] = {}
+        # subsystems whose sync ops BRIDGE through the io loop (runtime
+        # collectives) must never have their calling methods promoted
+        # onto that loop — promotion would park the loop on itself.
+        # Set via disable_inline_execution(); checked by both inline
+        # fast paths.
+        self._inline_disabled_reason: Optional[str] = None
         self._sync_exec_inflight = 0  # sync methods currently on the pool
         self._exec_counts = [0, 0]    # [inline runs, pool runs] (status RPC)
         # in-flight streaming generator tasks: task_id -> credit state
@@ -142,6 +151,9 @@ class WorkerServer:
                     "pool": self._exec_counts[1],
                 },
             }
+        sub = self.rt._rpc_subhandlers.get(method)
+        if sub is not None:
+            return await sub(conn, p)
         raise rpc.RpcError(f"worker: unknown method {method!r}")
 
     # ---- normal tasks --------------------------------------------------
@@ -195,13 +207,20 @@ class WorkerServer:
             self._sync_exec_inflight -= 1
         return reply
 
+    def disable_inline_execution(self, reason: str) -> None:
+        """Permanently route this worker's sync methods through the
+        executor pool.  Called by subsystems whose blocking ops await
+        io-loop traffic (util.collective): a loop-inlined caller would
+        deadlock the loop it bridges into."""
+        self._inline_disabled_reason = reason
+
     def _maybe_execute_task_inline(self, fn, key: str, spec):
         """Plain-task twin of _maybe_execute_inline: run a proven-fast
         sync function directly on the io loop.  Same safety conditions —
         nothing on the executor (serial semantics preserved), ref-free
         args, sub-2ms streak; same tail-risk bound (one slow run demotes
         permanently past 50 ms)."""
-        if self._sync_exec_inflight:
+        if self._sync_exec_inflight or self._inline_disabled_reason:
             return None
         st = self._method_stats.get(key)
         if (
@@ -759,9 +778,19 @@ class WorkerServer:
         _INLINE_DEMOTE_S (50 ms) bans the method from inline permanently,
         and a sustained slowdown drags the EMA over the bar.
         Returns None when the pool must be used."""
-        if self._actor_thread_pool is not None or self._sync_exec_inflight:
+        if (
+            self._actor_thread_pool is not None
+            or self._sync_exec_inflight
+            or self._inline_disabled_reason
+        ):
             return None
         mname = spec["method"]
+        if mname == "__rt_apply__":
+            # generic apply carries a DIFFERENT callable per call under
+            # one stats key: past sub-2ms calls predict nothing about
+            # the next one (e.g. collective init bridging into this
+            # very loop) — promotion is unsound here by construction
+            return None
         st = self._method_stats.get(mname)
         if (
             st is None or st[1] or st[0] < self._INLINE_AFTER

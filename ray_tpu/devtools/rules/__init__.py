@@ -10,10 +10,12 @@ Rule ids are stable and grouped by family:
 - RT106 mutable-default-arg        (remote_api)
 - RT107 swallowed-cancellation     (async_rules)
 - RT108 unlocked-lazy-init         (concurrency)
+- RT109 blocking-collective-in-async (async_rules)
 """
 
 from ray_tpu.devtools.rules.async_rules import (
     BlockingCallInAsync,
+    BlockingCollectiveInAsync,
     SwallowedCancellation,
     UnawaitedCoroutine,
 )
@@ -34,4 +36,5 @@ ALL_RULES = [
     MutableDefaultArg,
     SwallowedCancellation,
     UnlockedLazyInit,
+    BlockingCollectiveInAsync,
 ]

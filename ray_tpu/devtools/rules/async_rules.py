@@ -100,6 +100,86 @@ class BlockingCallInAsync(Rule):
     visitor_cls = _BlockingVisitor
 
 
+_COLLECTIVE_PKG = "ray_tpu.util.collective"
+# the blocking op surface of util.collective; each op has an awaitable
+# `<op>_async` twin that is the in-loop-legal spelling
+_COLLECTIVE_BLOCKING_OPS = {
+    "allreduce",
+    "allgather",
+    "reducescatter",
+    "broadcast",
+    "broadcast_object",
+    "barrier",
+    "send",
+    "recv",
+}
+# lifecycle calls block too but have NO *_async twin: the only legal
+# async-context spelling is an executor handoff
+_COLLECTIVE_BLOCKING_LIFECYCLE = {
+    "init_collective_group",
+    "create_collective_group",
+    "destroy_collective_group",
+}
+
+
+class _BlockingCollectiveVisitor(astutil.ScopedVisitor):
+    """RT109: blocking runtime-collective calls inside ``async def``.
+
+    The sync collective ops bridge into the runtime's io loop and BLOCK
+    until peer traffic completes — called from a coroutine they park
+    the very loop the chunks must arrive on (best case they stall every
+    in-flight RPC on the process; on the loop thread itself they
+    deadlock).  Legal spellings from async code: the ``*_async`` twins,
+    or an executor handoff (``await asyncio.to_thread(col.allreduce,
+    ...)`` — the op is then a function *reference*, not a call, so this
+    visitor never sees it)."""
+
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def visit_Call(self, node: ast.Call):
+        if self.in_async_function:
+            resolved = self.ctx.imports.resolve(node.func)
+            if resolved is not None and resolved.startswith(
+                _COLLECTIVE_PKG + "."
+            ):
+                op = resolved.rsplit(".", 1)[1]
+                if op in _COLLECTIVE_BLOCKING_OPS:
+                    self.ctx.add(
+                        self.rule, node,
+                        message=f"blocking collective op `{op}(...)` "
+                                f"inside `async def` parks the io loop "
+                                f"its own chunks arrive on",
+                        hint=f"`await {op}_async(...)`, or hand the "
+                             f"sync op to a thread: `await asyncio."
+                             f"to_thread(collective.{op}, ...)`",
+                    )
+                elif op in _COLLECTIVE_BLOCKING_LIFECYCLE:
+                    self.ctx.add(
+                        self.rule, node,
+                        message=f"blocking collective lifecycle call "
+                                f"`{op}(...)` inside `async def` parks "
+                                f"the io loop rendezvous rides on",
+                        hint=f"hand it to a thread: `await asyncio."
+                             f"to_thread(collective.{op}, ...)` "
+                             f"(lifecycle calls have no *_async twin)",
+                    )
+        self.generic_visit(node)
+
+
+class BlockingCollectiveInAsync(Rule):
+    id = "RT109"
+    name = "blocking-collective-in-async"
+    description = (
+        "blocking runtime-collective call (allreduce/send/recv/barrier/"
+        "...) inside an `async def` body without await/executor handoff"
+    )
+    hint = "use the *_async twin or asyncio.to_thread"
+    visitor_cls = _BlockingCollectiveVisitor
+
+
 class _UnawaitedVisitor(astutil.ScopedVisitor):
     """RT105: coroutine called as a bare statement (never awaited — the
     body silently never runs) and `.remote()` calls whose ObjectRef is

@@ -74,3 +74,57 @@ def barrier(x, axis: AxisName):
     tick = lax.psum(jnp.ones((), jnp.int32), axis)
     # (tick - tick) == 0 always, but keeps the psum live in the graph.
     return jax.tree.map(lambda a: a + (tick - tick).astype(a.dtype), x)
+
+
+class XlaInProgramBackend:
+    """The in-program face of the shared collective-backend registry
+    (``ray_tpu.util.collective.backend``, registered as ``"xla"``).
+
+    Same op *names* as the runtime backends, different regime: these
+    take jax arrays + a mesh axis name and MUST be called inside
+    ``shard_map``/pjit-manual contexts — they compile into the program
+    and execute over ICI, they do not move runtime tensors between
+    actors.  ``init_collective_group`` refuses this backend for runtime
+    groups and points here instead; library code that wants one
+    namespace for both regimes dispatches on
+    ``ray_tpu.util.collective.available_backends()`` kinds.
+    """
+
+    kind = "in_program"
+
+    @staticmethod
+    def allreduce(x, axis: AxisName, op: str = "sum"):
+        if op == "sum":
+            return allreduce_sum(x, axis)
+        if op == "mean":
+            return allreduce_mean(x, axis)
+        if op == "max":
+            return lax.pmax(x, axis)
+        if op == "min":
+            return lax.pmin(x, axis)
+        raise ValueError(f"unsupported in-program reduce op {op!r}")
+
+    @staticmethod
+    def allgather(x, axis: str, *, dim: int = 0, tiled: bool = True):
+        return allgather(x, axis, dim=dim, tiled=tiled)
+
+    @staticmethod
+    def reducescatter(x, axis: str, *, dim: int = 0):
+        return reducescatter_sum(x, axis, dim=dim)
+
+    @staticmethod
+    def broadcast(x, axis: str, *, root: int = 0):
+        return broadcast_from(x, axis, root=root)
+
+    @staticmethod
+    def barrier(x, axis: AxisName):
+        return barrier(x, axis)
+
+    @staticmethod
+    def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int):
+        return all_to_all(x, axis, split_dim=split_dim,
+                          concat_dim=concat_dim)
+
+    @staticmethod
+    def ring_permute(x, axis: str, *, shift: int = 1):
+        return ring_permute(x, axis, shift=shift)

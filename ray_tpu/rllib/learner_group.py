@@ -14,6 +14,7 @@ case, and what the reference calls a "local learner").
 
 from __future__ import annotations
 
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -111,6 +112,24 @@ def _tree_mean(trees: List[Any]):
     return jax.tree.map(lambda *xs: sum(xs) / len(xs), *trees)
 
 
+def _bcast_weights(inst, group_name: str, root: int):
+    """Runs INSIDE each LearnerWorker (via ``_apply``): one collective
+    broadcast replaces the driver's N per-actor weight puts — the
+    driver ships weights to rank ``root`` once (or not at all, for the
+    init sync) and the group fans them out over the RPC+shm plane."""
+    from ray_tpu.util import collective as col
+
+    rank = col.get_rank(group_name)
+    w = col.broadcast_object(
+        inst.learner.get_weights() if rank == root else None,
+        src_rank=root,
+        group_name=group_name,
+    )
+    if rank != root:
+        inst.learner.set_weights(w)
+    return True
+
+
 class LearnerGroup:
     """N-way data-parallel sgd steps with averaged gradients."""
 
@@ -120,18 +139,32 @@ class LearnerGroup:
             self.local: Optional[Learner] = factory()
             self.workers: List[Any] = []
         else:
+            from ray_tpu.util import collective as col
+
             self.local = None
             self.workers = [
                 LearnerWorker.options(num_cpus=1).remote(factory)
                 for _ in range(num_learners)
             ]
-            # all replicas must start from identical weights: broadcast
-            # replica 0's init
-            w0 = ray_tpu.get(self.workers[0].get_weights.remote(), timeout=None)
-            ray_tpu.get(
-                [w.set_weights.remote(w0) for w in self.workers[1:]],
-                timeout=None,
+            # weight sync rides a runtime collective group over the
+            # learner actors (rpc ring backend: shm handoff co-hosted,
+            # oob wire cross-host) instead of per-actor object puts
+            self._col_group = f"learner-group-{uuid.uuid4().hex[:8]}"
+            col.create_collective_group(
+                self.workers, group_name=self._col_group
             )
+            # all replicas must start from identical weights: collective
+            # broadcast of replica 0's init
+            self._broadcast_from_rank0()
+
+    def _broadcast_from_rank0(self):
+        ray_tpu.get(
+            [
+                w._apply(_bcast_weights, self._col_group, 0)
+                for w in self.workers
+            ],
+            timeout=None,
+        )
 
     @property
     def is_local(self) -> bool:
@@ -185,11 +218,22 @@ class LearnerGroup:
         if self.local is not None:
             self.local.set_weights(w)
         else:
+            # ship once to rank 0, then collective-broadcast to the rest
             ray_tpu.get(
-                [wk.set_weights.remote(w) for wk in self.workers], timeout=None
+                self.workers[0].set_weights.remote(w), timeout=None
             )
+            self._broadcast_from_rank0()
 
     def stop(self):
+        if self.workers and getattr(self, "_col_group", None):
+            from ray_tpu.util import collective as col
+
+            try:
+                col.destroy_collective_group(
+                    self._col_group, actors=self.workers
+                )
+            except Exception:
+                pass  # a dead member mustn't block teardown
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

@@ -1,0 +1,65 @@
+"""ray_tpu.util.collective: runtime actor-group collectives.
+
+Role-equivalent of ray: python/ray/util/collective/ — allreduce /
+allgather / reducescatter / broadcast / barrier / send / recv between
+arbitrary actor groups AT RUNTIME (out-of-program), complementing the
+in-program XLA/ICI collectives of ``ray_tpu.parallel.collectives``.
+
+Quick shape::
+
+    from ray_tpu.util import collective as col
+
+    # inside each member actor (or col.create_collective_group(actors)
+    # from the driver):
+    col.init_collective_group(world_size=4, rank=r, backend="rpc")
+    reduced = col.allreduce(my_grads)          # numpy in, numpy out
+    w = col.broadcast_object(w if r == 0 else None, src_rank=0)
+    col.destroy_collective_group()
+
+Backends: ``"rpc"`` (default; ring algorithms over the duplex worker
+RPC plane, zero-copy shm-arena handoff between co-hosted ranks),
+``"jax"`` (delegates to a shared ``jax.distributed`` gang), and the
+in-program ``"xla"`` adapter registered by ``parallel.collectives``
+(same op names, jax arrays + mesh axes inside ``shard_map``).
+
+The module-level ops BLOCK and are for sync actor methods; from
+``async def`` bodies use the ``*_async`` twins or hand the call to a
+thread — rtlint rule RT109 enforces this.
+"""
+
+from ray_tpu.util.collective.backend import (  # noqa: F401
+    available_backends,
+    register_backend,
+)
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    barrier,
+    barrier_async,
+    broadcast,
+    broadcast_async,
+    broadcast_object,
+    broadcast_object_async,
+    create_collective_group,
+    destroy_collective_group,
+    get_backend,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    recv_async,
+    reducescatter,
+    reducescatter_async,
+    send,
+    send_async,
+)
+from ray_tpu.util.collective.types import (  # noqa: F401
+    CollectiveError,
+    CollectiveGroupError,
+    CollectiveTimeoutError,
+    ReduceOp,
+    RendezvousTimeoutError,
+)

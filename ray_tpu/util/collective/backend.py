@@ -1,0 +1,141 @@
+"""Collective backend registry: one name → implementation table shared by
+runtime (out-of-program) and in-program collectives.
+
+Role-equivalent of ray: python/ray/util/collective/collective.py's
+backend dispatch (nccl/gloo), generalized: entries are lazy
+``"module:attr"`` strings so registering the in-program XLA adapter does
+not import jax, and registering the RPC ring backend does not import
+numpy until a group is actually created.
+
+Two execution regimes share the table:
+
+- ``runtime`` backends implement the async op surface of
+  :class:`RuntimeBackend` and move data between processes at runtime
+  (RPC plane + shm arena, or a jax.distributed gang);
+- ``in_program`` backends (``"xla"``, registered by
+  ``ray_tpu.parallel.collectives``) expose the same op *names* but take
+  jax arrays + mesh axis names and must be called inside
+  ``shard_map``/pjit-manual contexts — the ops compile into the program
+  and execute over ICI.  ``init_collective_group`` refuses them with a
+  pointer to the right usage.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict
+
+from ray_tpu.util.collective.types import CollectiveError, GroupSpec
+
+
+class RuntimeBackend:
+    """Op surface every runtime backend implements (async, numpy in/out).
+
+    Instances are per-group, created by the manager on the runtime's io
+    loop; all methods run on that loop.
+    """
+
+    kind = "runtime"
+
+    def __init__(self, spec: GroupSpec, manager: Any):
+        self.spec = spec
+        self.manager = manager
+
+    # -- collective ops --------------------------------------------------
+    async def allreduce(self, arr, op):
+        raise NotImplementedError
+
+    async def allgather(self, arr):
+        raise NotImplementedError
+
+    async def reducescatter(self, arr, op):
+        raise NotImplementedError
+
+    async def broadcast(self, arr, root: int):
+        raise NotImplementedError
+
+    async def broadcast_object(self, obj, root: int):
+        raise NotImplementedError
+
+    async def barrier(self):
+        raise NotImplementedError
+
+    # -- point to point --------------------------------------------------
+    async def send(self, arr, dst: int):
+        raise NotImplementedError
+
+    async def recv(self, arr, src: int):
+        raise NotImplementedError
+
+    async def shutdown(self):
+        pass
+
+
+class _Entry:
+    __slots__ = ("target", "kind", "resolved")
+
+    def __init__(self, target, kind):
+        self.target = target  # "module:attr" string or a callable/class
+        self.kind = kind
+        self.resolved = None
+
+
+_REGISTRY: Dict[str, _Entry] = {}
+_ALIASES: Dict[str, str] = {}
+
+
+def register_backend(name: str, target, *, kind: str = "runtime",
+                     aliases: tuple = ()) -> None:
+    """Register a backend under ``name``.  ``target`` is either the class
+    itself or a lazy ``"module:attr"`` string resolved on first use."""
+    _REGISTRY[name] = _Entry(target, kind)
+    for a in aliases:
+        _ALIASES[a] = name
+
+
+def available_backends() -> Dict[str, str]:
+    """name → kind for everything registered (built-ins included)."""
+    return {name: e.kind for name, e in _REGISTRY.items()}
+
+
+def resolve_backend(name: str):
+    """The backend class/adapter for ``name``; raises with the full menu
+    on an unknown name."""
+    canonical = _ALIASES.get(name, name)
+    entry = _REGISTRY.get(canonical)
+    if entry is None:
+        raise CollectiveError(
+            f"unknown collective backend {name!r}; registered: "
+            f"{sorted(set(_REGISTRY) | set(_ALIASES))}"
+        )
+    if entry.resolved is None:
+        if isinstance(entry.target, str):
+            mod_name, _, attr = entry.target.partition(":")
+            mod = importlib.import_module(mod_name)
+            entry.resolved = getattr(mod, attr)
+        else:
+            entry.resolved = entry.target
+    return entry.resolved
+
+
+def backend_kind(name: str) -> str:
+    canonical = _ALIASES.get(name, name)
+    entry = _REGISTRY.get(canonical)
+    if entry is None:
+        raise CollectiveError(f"unknown collective backend {name!r}")
+    return entry.kind
+
+
+# Built-ins (lazy: nothing heavy imports until a group is created).
+register_backend(
+    "rpc", "ray_tpu.util.collective.rpc_backend:RpcRingBackend",
+    aliases=("gloo",),
+)
+register_backend(
+    "jax", "ray_tpu.util.collective.jax_backend:JaxGangBackend",
+    aliases=("mesh",),
+)
+register_backend(
+    "xla", "ray_tpu.parallel.collectives:XlaInProgramBackend",
+    kind="in_program", aliases=("ici",),
+)

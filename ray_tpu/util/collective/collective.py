@@ -1,0 +1,669 @@
+"""Runtime actor-group collectives: group lifecycle + op dispatch.
+
+Role-equivalent of ray: python/ray/util/collective/collective.py
+(init_collective_group:120, allreduce:258, declare/teardown) rebuilt on
+this runtime's own planes: rendezvous rides the GCS KV table, the data
+plane is the duplex worker RPC framing (``core/rpc.py``) with
+zero-copy shm-arena handoff between co-hosted ranks
+(``_native/store.py``), and backends are pluggable through
+``util/collective/backend.py`` (the "rpc" ring backend here, a
+``jax.distributed`` gang delegate, and the in-program XLA adapter
+registered by ``parallel/collectives.py``).
+
+Threading contract: the async core runs on the runtime's io loop; the
+public module-level ops are **blocking** and must be called from a sync
+context (sync actor methods run on executor threads, which is the
+intended call site).  From ``async def`` bodies use the ``*_async``
+twins or hand the sync op to a thread — calling a blocking op on the io
+loop would deadlock it, which is exactly what rtlint rule RT109 flags.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.common.config import cfg
+from ray_tpu.core.runtime import get_runtime
+from ray_tpu.util.collective import rendezvous
+from ray_tpu.util.collective.backend import (
+    backend_kind,
+    resolve_backend,
+)
+from ray_tpu.util.collective.types import (
+    DEFAULT_GROUP_NAME,
+    CollectiveError,
+    CollectiveGroupError,
+    CollectiveTimeoutError,
+    GroupSpec,
+    ReduceOp,
+)
+
+logger = logging.getLogger(__name__)
+
+RPC_METHOD = "collective"  # the one method name the subsystem claims
+
+
+class _Mailbox:
+    """Arrived-but-unconsumed chunks for one (group, src, tag) stream.
+
+    Created on demand by WHICHEVER side gets there first — delivery may
+    beat the local op (a fast peer), or the op may park before any
+    traffic arrives.  All access is on the io loop; no locks.
+    """
+
+    __slots__ = ("chunks", "event", "failed")
+
+    def __init__(self):
+        self.chunks: list = []
+        self.event = asyncio.Event()
+        self.failed: Optional[Exception] = None
+
+
+class GroupHandle:
+    """Per-process state of one initialized group."""
+
+    def __init__(self, spec: GroupSpec, backend_impl):
+        self.spec = spec
+        self.backend = backend_impl
+        self.failed: Optional[Exception] = None
+        self.op_lock = asyncio.Lock()  # collectives are one-at-a-time
+        self.op_seq = 0
+        self.p2p_send_seq: Dict[int, int] = {}
+        self.p2p_recv_seq: Dict[int, int] = {}
+
+    def check_alive(self):
+        if self.failed is not None:
+            raise CollectiveGroupError(
+                f"collective group {self.spec.name!r} is poisoned: "
+                f"{self.failed}.  Call destroy_collective_group and "
+                f"re-init with live members."
+            ) from self.failed
+
+
+class CollectiveManager:
+    """One per process; owns group table, mailboxes, and the RPC hook."""
+
+    def __init__(self, rt):
+        self.rt = rt
+        self.groups: Dict[str, GroupHandle] = {}
+        self._inbox: Dict[tuple, _Mailbox] = {}
+        # conn → {(group, peer_rank)}: every connection known to carry
+        # a group's traffic, for death detection (inbound recorded at
+        # delivery, outbound at peer-channel acquisition)
+        self._conn_groups: Dict[Any, set] = {}
+        rt.register_rpc_handler(RPC_METHOD, self._handle)
+        rt.add_peer_close_watcher(self._on_conn_closed)
+
+    # ---- RPC plane -----------------------------------------------------
+    async def _handle(self, conn, payload: dict):
+        op = payload.get("op")
+        if op == "chunk":
+            # deliver synchronously (no await before the mailbox write):
+            # the rpc recv loop creates handler tasks in frame order, so
+            # in-order delivery per connection is preserved
+            key = (
+                payload["group"], payload.get("inc", ""),
+                payload["src"], payload["tag"],
+            )
+            gh = self.groups.get(payload["group"])
+            box = self._inbox.get(key)
+            if (
+                gh is not None
+                and (
+                    gh.failed is not None
+                    or gh.spec.incarnation != payload.get("inc", "")
+                )
+            ) or (box is not None and box.failed is not None):
+                # poisoned group/stream — or traffic from a DIFFERENT
+                # incarnation of this name (a destroyed predecessor):
+                # nobody will consume; reclaim the shm chunk instead of
+                # buffering it (a fresh mailbox would outlive the group,
+                # and a stale-tag chunk consumed by a re-initialized
+                # group would corrupt it)
+                self._drop_chunk_shm(payload)
+                return True
+            if box is None:
+                box = self._inbox[key] = _Mailbox()
+            box.chunks.append(payload)
+            box.event.set()
+            self._track_conn(conn, payload["group"], payload["src"])
+            return True
+        if op == "fail":
+            # re-propagate: the detector only reaches its own dialed
+            # conns (ring successor), so a received failure must travel
+            # on — fail_group no-ops on an already-poisoned group, so
+            # the relay terminates after one lap of the ring
+            self.fail_group(
+                payload["group"],
+                CollectiveGroupError(payload["reason"]),
+                propagate=True,
+            )
+            return True
+        if op == "ping":
+            return True
+        raise CollectiveError(f"unknown collective wire op {op!r}")
+
+    def _track_conn(self, conn, group: str, peer_rank: int):
+        s = self._conn_groups.get(conn)
+        if s is None:
+            s = self._conn_groups[conn] = set()
+        s.add((group, peer_rank))
+
+    def _on_conn_closed(self, conn):
+        pairs = self._conn_groups.pop(conn, None)
+        if not pairs or self.rt._closed:
+            return
+        for group, peer_rank in pairs:
+            gh = self.groups.get(group)
+            if gh is None or gh.failed is not None:
+                continue
+            err = CollectiveGroupError(
+                f"{gh.spec.describe_member(peer_rank)} lost its "
+                f"connection (member died?) during group "
+                f"{group!r} traffic"
+            )
+            self.fail_group(group, err, propagate=True)
+
+    # ---- failure -------------------------------------------------------
+    def _drop_chunk_shm(self, msg: dict):
+        """Reclaim the arena object of an unconsumed co-hosted chunk."""
+        oid = msg.get("shm")
+        if oid is not None:
+            try:
+                self.rt.store.delete(oid)
+            except Exception:
+                pass
+
+    def _drop_box(self, box: "_Mailbox", err: Exception):
+        """Mark a mailbox failed and reclaim its buffered shm chunks —
+        a failed stream is never consumed, and sealed+protected chunks
+        would otherwise pin arena capacity forever."""
+        if box.failed is None:
+            box.failed = err
+        for msg in box.chunks:
+            self._drop_chunk_shm(msg)
+        box.chunks.clear()
+        box.event.set()
+
+    def _fail_group_local(self, group: str, err: Exception):
+        gh = self.groups.get(group)
+        if gh is not None:
+            if gh.failed is not None:
+                return
+            gh.failed = err
+        for key, box in self._inbox.items():
+            if key[0] == group and box.failed is None:
+                self._drop_box(box, err)
+
+    def fail_group(self, group: str, err: Exception, propagate: bool):
+        """Poison the group locally; optionally fan the failure out to
+        every member we already have a live channel to, so ranks not
+        adjacent to the dead member learn immediately instead of timing
+        out."""
+        gh = self.groups.get(group)
+        already = gh is not None and gh.failed is not None
+        self._fail_group_local(group, err)
+        if not propagate or gh is None or already:
+            return
+        for m in gh.spec.members:
+            if m.rank == gh.spec.rank:
+                continue
+            conn = self.rt._worker_conns.get(m.addr)
+            if conn is not None and not conn.closed:
+                self.rt._spawn(
+                    conn.notify(
+                        RPC_METHOD,
+                        {"op": "fail", "group": group, "reason": str(err)},
+                    )
+                )
+
+    # ---- mailbox consumption (backends call these) ---------------------
+    async def recv_chunks(self, group: str, src: int, tag: str,
+                          expected_bytes: int,
+                          timeout: Optional[float] = None) -> List[dict]:
+        """Await chunk messages on (group, src, tag) until their payload
+        bytes sum to ``expected_bytes``; returns them in arrival order."""
+        if timeout is None:
+            timeout = cfg.collective_op_timeout_s
+        gh = self.groups.get(group)
+        inc = gh.spec.incarnation if gh is not None else ""
+        key = (group, inc, src, tag)
+        box = self._inbox.get(key)
+        if box is None:
+            box = self._inbox[key] = _Mailbox()
+        got: List[dict] = []
+        nbytes = 0
+        try:
+            while nbytes < expected_bytes:
+                if box.failed is not None:
+                    raise box.failed
+                if not box.chunks:
+                    box.event.clear()
+                    try:
+                        await asyncio.wait_for(box.event.wait(), timeout)
+                    except asyncio.TimeoutError:
+                        raise self._timeout_error(
+                            group, src, tag, timeout, nbytes, expected_bytes
+                        ) from None
+                    continue
+                msg = box.chunks.pop(0)
+                got.append(msg)
+                nbytes += msg["nbytes"]
+        except BaseException:
+            # popped-but-unconsumed chunks die with the op: reclaim
+            # their protected arena objects (failed streams never
+            # resume; leaving them sealed+protected pins the arena)
+            for msg in got:
+                self._drop_chunk_shm(msg)
+            raise
+        finally:
+            if not box.chunks and box.failed is None:
+                self._inbox.pop(key, None)
+        return got
+
+    def _timeout_error(self, group, src, tag, timeout, got, want):
+        gh = self.groups.get(group)
+        who = (
+            gh.spec.describe_member(src)
+            if gh is not None and src < len(gh.spec.members)
+            else f"rank {src}"
+        )
+        return CollectiveTimeoutError(
+            f"collective op on group {group!r} timed out after "
+            f"{timeout:.0f}s waiting for {who} "
+            f"(tag {tag}, {got}/{want} bytes arrived).  The member is "
+            f"likely dead or wedged; kill the group's actors, call "
+            f"destroy_collective_group, and re-init."
+        )
+
+    # ---- lifecycle -----------------------------------------------------
+    async def init_group(self, group_name: str, world_size: int, rank: int,
+                         backend_name: str) -> GroupHandle:
+        if not (0 <= rank < world_size):
+            raise CollectiveError(
+                f"rank {rank} out of range for world_size {world_size}"
+            )
+        if group_name in self.groups:
+            raise CollectiveError(
+                f"collective group {group_name!r} already initialized in "
+                f"this process; destroy_collective_group first"
+            )
+        if backend_kind(backend_name) != "runtime":
+            raise CollectiveError(
+                f"backend {backend_name!r} is an in-program backend: its "
+                f"ops take jax arrays + mesh axis names inside "
+                f"shard_map, not runtime tensors; use it via "
+                f"ray_tpu.util.collective.get_backend({backend_name!r}) "
+                f"or pick 'rpc'/'jax' for runtime groups"
+            )
+        actor_id = self.rt.actor_id.hex() if self.rt.actor_id else None
+        me = await rendezvous.declare(
+            self.rt, group_name, world_size, rank, actor_id
+        )
+        try:
+            members, incarnation = await rendezvous.await_members(
+                self.rt, group_name, world_size, rank, me
+            )
+            spec = GroupSpec(
+                name=group_name, world_size=world_size, rank=rank,
+                backend=backend_name, members=members,
+                incarnation=incarnation,
+            )
+            backend_cls = resolve_backend(backend_name)
+            impl = backend_cls(spec, self)
+            setup = getattr(impl, "setup", None)
+            if setup is not None:
+                await setup()
+        except BaseException:
+            # a failed init never reaches self.groups, so destroy_group
+            # would not retract for it — take the declared key back here
+            # or a later same-name group reads this rank's stale record
+            await rendezvous.retract(self.rt, group_name, rank)
+            raise
+        gh = GroupHandle(spec, impl)
+        self.groups[group_name] = gh
+        # blocking sync methods bridge through the io loop; a
+        # proven-fast collective call must never be promoted onto the
+        # loop itself (it would park the loop it needs) — disable the
+        # inline-execution fast path for this worker outright
+        server = getattr(self.rt, "_worker_server", None)
+        if server is not None:
+            server.disable_inline_execution(
+                f"collective group {group_name!r} member"
+            )
+        return gh
+
+    async def destroy_group(self, group_name: str):
+        gh = self.groups.pop(group_name, None)
+        for key in [k for k in self._inbox if k[0] == group_name]:
+            box = self._inbox.pop(key)
+            self._drop_box(
+                box, CollectiveGroupError(f"group {group_name!r} destroyed")
+            )
+        # forget the group's connection tracking: a later close of a
+        # conn that once carried this group's traffic must not poison a
+        # re-initialized same-name group
+        for pairs in self._conn_groups.values():
+            pairs.difference_update(
+                {p for p in pairs if p[0] == group_name}
+            )
+        if gh is not None:
+            try:
+                await gh.backend.shutdown()
+            except Exception:
+                pass
+            await rendezvous.retract(self.rt, group_name, gh.spec.rank)
+
+    def get_group(self, group_name: str) -> GroupHandle:
+        gh = self.groups.get(group_name)
+        if gh is None:
+            raise CollectiveError(
+                f"collective group {group_name!r} is not initialized in "
+                f"this process; call init_collective_group first "
+                f"(initialized here: {sorted(self.groups)})"
+            )
+        return gh
+
+
+# --------------------------------------------------------------------------
+# module-level API (the ray.util.collective-shaped surface)
+# --------------------------------------------------------------------------
+
+_managers: Dict[int, CollectiveManager] = {}
+_mgr_lock = threading.Lock()
+
+
+def _manager() -> CollectiveManager:
+    rt = get_runtime()
+    key = id(rt)
+    mgr = _managers.get(key)
+    if mgr is None or mgr.rt is not rt:
+        with _mgr_lock:
+            mgr = _managers.get(key)
+            if mgr is None or mgr.rt is not rt:
+                _managers.clear()  # previous runtime's manager is dead
+                mgr = CollectiveManager(rt)
+                _managers[key] = mgr
+    return mgr
+
+
+def _run_blocking(coro):
+    """Bridge a collective coroutine from a sync caller onto the io
+    loop.  Refuses to run ON the loop (that would deadlock it): async
+    actor methods must use the *_async twins (rtlint RT109)."""
+    rt = get_runtime()
+    if threading.current_thread() is rt._thread:
+        raise CollectiveError(
+            "blocking collective op called on the runtime io loop; "
+            "use the *_async twin (e.g. `await allreduce_async(...)`) "
+            "or hand the sync op to a thread with asyncio.to_thread"
+        )
+    return rt._run(coro, timeout=None)
+
+
+def init_collective_group(world_size: int, rank: int, *,
+                          backend: str = "rpc",
+                          group_name: str = DEFAULT_GROUP_NAME) -> None:
+    """Join a collective group (call from inside each member actor)."""
+    mgr = _manager()
+    _run_blocking(mgr.init_group(group_name, world_size, rank, backend))
+
+
+def _init_in_actor(inst, group_name, world_size, rank, backend):
+    init_collective_group(
+        world_size, rank, backend=backend, group_name=group_name
+    )
+    return True
+
+
+def _destroy_in_actor(inst, group_name):
+    destroy_collective_group(group_name=group_name)
+    return True
+
+
+def create_collective_group(actors, *, world_size: Optional[int] = None,
+                            ranks: Optional[List[int]] = None,
+                            backend: str = "rpc",
+                            group_name: str = DEFAULT_GROUP_NAME,
+                            timeout: Optional[float] = None) -> None:
+    """Driver-side declarative form: make ``actors`` a collective group
+    (actor i gets ``ranks[i]``, default i).  Blocks until every member
+    finished rendezvous — afterwards ops may be issued on any member.
+
+    ``world_size`` may exceed ``len(actors)``: the remaining ranks then
+    join from their own processes via ``init_collective_group`` (the
+    mixed declaration pattern) — this call blocks until THEY arrive too,
+    since rendezvous completes only at full membership."""
+    import ray_tpu
+
+    if world_size is None:
+        world_size = len(actors)
+    if ranks is None:
+        if world_size != len(actors):
+            raise CollectiveError(
+                f"world_size {world_size} != len(actors) "
+                f"{len(actors)}: pass explicit ranks for the declared "
+                f"subset (the rest join via init_collective_group)"
+            )
+        ranks = list(range(len(actors)))
+    if len(ranks) != len(actors):
+        raise CollectiveError(
+            f"{len(ranks)} ranks for {len(actors)} actors"
+        )
+    if len(set(ranks)) != len(ranks) or not all(
+        0 <= r < world_size for r in ranks
+    ):
+        raise CollectiveError(
+            f"ranks {ranks} must be distinct and within "
+            f"0..{world_size - 1}"
+        )
+    refs = [
+        a._apply(_init_in_actor, group_name, world_size, rk, backend)
+        for a, rk in zip(actors, ranks)
+    ]
+    ray_tpu.get(
+        refs,
+        timeout=timeout
+        if timeout is not None
+        else cfg.collective_rendezvous_timeout_s + 30.0,
+    )
+
+
+def destroy_collective_group(group_name: str = DEFAULT_GROUP_NAME,
+                             actors=None) -> None:
+    """Tear the group down.  In-actor: drops this rank's state.  With
+    ``actors`` (driver side): tears down every member."""
+    if actors is not None:
+        import ray_tpu
+
+        refs = [a._apply(_destroy_in_actor, group_name) for a in actors]
+        ray_tpu.get(refs, timeout=60.0)
+        return
+    mgr = _manager()
+    _run_blocking(mgr.destroy_group(group_name))
+
+
+def is_group_initialized(group_name: str = DEFAULT_GROUP_NAME) -> bool:
+    try:
+        return group_name in _manager().groups
+    except Exception:
+        return False
+
+
+def get_rank(group_name: str = DEFAULT_GROUP_NAME) -> int:
+    return _manager().get_group(group_name).spec.rank
+
+
+def get_collective_group_size(group_name: str = DEFAULT_GROUP_NAME) -> int:
+    return _manager().get_group(group_name).spec.world_size
+
+
+def get_backend(name: str):
+    """The registered backend class/adapter for ``name`` (used for the
+    in-program 'xla' adapter; runtime groups go through init)."""
+    return resolve_backend(name)
+
+
+# ---- async op twins (awaitable on the io loop: async actor methods) ----
+
+async def _collective_op(group_name, fn):
+    gh = _manager().get_group(group_name)
+    gh.check_alive()
+    async with gh.op_lock:
+        gh.check_alive()
+        try:
+            return await fn(gh)
+        except asyncio.CancelledError:
+            raise
+        except CollectiveGroupError as e:
+            # already actionable (poisoned group / member timeout);
+            # make sure this process's group state agrees
+            _manager().fail_group(group_name, e, propagate=True)
+            raise
+        except CollectiveError:
+            # usage error (bad root/rank, unsupported op) raised before
+            # any ring traffic: the op fails, the group stays usable
+            raise
+        except Exception as e:
+            # a mid-op transport error (peer conn refused/reset) poisons
+            # the group: partial ring state is unrecoverable (peers hold
+            # partial sums) — surface the actionable wrapper
+            err = CollectiveGroupError(
+                f"collective op on group {group_name!r} failed "
+                f"mid-flight ({e!r}); a member is likely dead.  The "
+                f"group is poisoned — destroy_collective_group and "
+                f"re-init with live members."
+            )
+            _manager().fail_group(group_name, err, propagate=True)
+            raise err from e
+
+
+async def allreduce_async(tensor, group_name: str = DEFAULT_GROUP_NAME,
+                          op: ReduceOp = ReduceOp.SUM):
+    return await _collective_op(
+        group_name, lambda gh: gh.backend.allreduce(tensor, op)
+    )
+
+
+async def allgather_async(tensor, group_name: str = DEFAULT_GROUP_NAME):
+    return await _collective_op(
+        group_name, lambda gh: gh.backend.allgather(tensor)
+    )
+
+
+async def reducescatter_async(tensor, group_name: str = DEFAULT_GROUP_NAME,
+                              op: ReduceOp = ReduceOp.SUM):
+    return await _collective_op(
+        group_name, lambda gh: gh.backend.reducescatter(tensor, op)
+    )
+
+
+async def broadcast_async(tensor, src_rank: int = 0,
+                          group_name: str = DEFAULT_GROUP_NAME):
+    return await _collective_op(
+        group_name, lambda gh: gh.backend.broadcast(tensor, src_rank)
+    )
+
+
+async def broadcast_object_async(obj=None, src_rank: int = 0,
+                                 group_name: str = DEFAULT_GROUP_NAME):
+    return await _collective_op(
+        group_name, lambda gh: gh.backend.broadcast_object(obj, src_rank)
+    )
+
+
+async def barrier_async(group_name: str = DEFAULT_GROUP_NAME):
+    return await _collective_op(group_name, lambda gh: gh.backend.barrier())
+
+
+async def _p2p_op(group_name, peer_rank, fn):
+    """Like _collective_op but WITHOUT the per-group op lock: pairwise
+    traffic from concurrent threads must not serialize against group
+    collectives (a PS server recv parked under the lock while a worker
+    thread needs to send would deadlock the pattern, not the loop)."""
+    gh = _manager().get_group(group_name)
+    gh.check_alive()
+    try:
+        return await fn(gh)
+    except asyncio.CancelledError:
+        raise
+    except CollectiveGroupError as e:
+        _manager().fail_group(group_name, e, propagate=True)
+        raise
+    except CollectiveError:
+        raise  # usage error (self-send, bad rank): op fails, group lives
+    except Exception as e:
+        err = CollectiveGroupError(
+            f"p2p op with rank {peer_rank} on group {group_name!r} "
+            f"failed ({e!r}); the peer is likely dead.  The group is "
+            f"poisoned — destroy_collective_group and re-init."
+        )
+        _manager().fail_group(group_name, err, propagate=True)
+        raise err from e
+
+
+async def send_async(tensor, dst_rank: int,
+                     group_name: str = DEFAULT_GROUP_NAME):
+    return await _p2p_op(
+        group_name, dst_rank, lambda gh: gh.backend.send(tensor, dst_rank)
+    )
+
+
+async def recv_async(tensor, src_rank: int,
+                     group_name: str = DEFAULT_GROUP_NAME):
+    return await _p2p_op(
+        group_name, src_rank, lambda gh: gh.backend.recv(tensor, src_rank)
+    )
+
+
+# ---- blocking ops (sync actor methods; NOT for async def — RT109) ------
+
+def allreduce(tensor, group_name: str = DEFAULT_GROUP_NAME,
+              op: ReduceOp = ReduceOp.SUM):
+    """Ring allreduce; returns the reduced array (same shape/dtype)."""
+    return _run_blocking(allreduce_async(tensor, group_name, op))
+
+
+def allgather(tensor, group_name: str = DEFAULT_GROUP_NAME):
+    """Returns [array from rank 0, ..., array from rank n-1]."""
+    return _run_blocking(allgather_async(tensor, group_name))
+
+
+def reducescatter(tensor, group_name: str = DEFAULT_GROUP_NAME,
+                  op: ReduceOp = ReduceOp.SUM):
+    """Reduce then scatter: returns THIS rank's segment of the reduced
+    flat tensor (numpy array_split segmentation)."""
+    return _run_blocking(reducescatter_async(tensor, group_name, op))
+
+
+def broadcast(tensor, src_rank: int = 0,
+              group_name: str = DEFAULT_GROUP_NAME):
+    """Root's tensor replicated to all; non-root tensors are filled
+    in place (shapes/dtypes must match) and returned."""
+    return _run_blocking(broadcast_async(tensor, src_rank, group_name))
+
+
+def broadcast_object(obj=None, src_rank: int = 0,
+                     group_name: str = DEFAULT_GROUP_NAME):
+    """Pickle-broadcast an arbitrary object from ``src_rank``; non-root
+    callers pass obj=None and get the root's object back."""
+    return _run_blocking(broadcast_object_async(obj, src_rank, group_name))
+
+
+def barrier(group_name: str = DEFAULT_GROUP_NAME):
+    """Block until every rank has entered the barrier."""
+    return _run_blocking(barrier_async(group_name))
+
+
+def send(tensor, dst_rank: int, group_name: str = DEFAULT_GROUP_NAME):
+    """Point-to-point send to ``dst_rank`` (pairs with its recv)."""
+    return _run_blocking(send_async(tensor, dst_rank, group_name))
+
+
+def recv(tensor, src_rank: int, group_name: str = DEFAULT_GROUP_NAME):
+    """Receive into ``tensor`` (shape/dtype must match the send);
+    returns the filled array."""
+    return _run_blocking(recv_async(tensor, src_rank, group_name))

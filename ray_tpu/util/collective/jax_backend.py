@@ -1,0 +1,146 @@
+"""Runtime collectives delegated to an in-program ``jax.distributed`` gang.
+
+When every rank of a group is a process of one ``jax.distributed``
+gang (the SPMD trainer shape: one process per TPU host, all sharing a
+global mesh), the runtime op surface can ride jax's own cross-host
+machinery instead of the RPC ring: ``multihost_utils`` collectives
+compile tiny XLA programs that execute over ICI/DCN — the fast path
+the RPC backend exists to approximate on CPU-only control planes.
+
+Constraints (checked at setup): ``world_size`` must equal
+``jax.process_count()`` and ``rank`` must equal ``jax.process_index()``
+— group membership IS gang membership here; arbitrary sub-groups need
+the "rpc" backend.  Point-to-point send/recv is not expressible over
+the gang surface and raises with that pointer.
+
+Reduction order note: allreduce reduces the gathered stack in rank
+order 0..n-1, so results are bit-stable across calls for a fixed gang.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ray_tpu.util.collective.backend import RuntimeBackend
+from ray_tpu.util.collective.types import (
+    CollectiveError,
+    ReduceOp,
+)
+
+
+class JaxGangBackend(RuntimeBackend):
+    kind = "runtime"
+
+    async def setup(self):
+        import jax
+
+        n = jax.process_count()
+        if self.spec.world_size != n or self.spec.rank != jax.process_index():
+            raise CollectiveError(
+                f"jax backend requires group membership == gang "
+                f"membership: world_size {self.spec.world_size} / rank "
+                f"{self.spec.rank} vs jax process_count {n} / "
+                f"process_index {jax.process_index()}.  Initialize "
+                f"jax.distributed across exactly the member hosts, or "
+                f"use backend='rpc' for arbitrary actor sub-groups."
+            )
+
+    def _reduce_stack(self, stacked, op: ReduceOp):
+        import numpy as np
+
+        if op in (ReduceOp.SUM, ReduceOp.MEAN):
+            out = stacked[0].copy()
+            for part in stacked[1:]:
+                np.add(out, part, out=out)  # rank order: bit-stable
+            if op is ReduceOp.MEAN:
+                np.divide(out, len(stacked), out=out, casting="unsafe")
+            return out
+        if op is ReduceOp.PRODUCT:
+            return np.prod(stacked, axis=0)
+        if op is ReduceOp.MIN:
+            return np.min(stacked, axis=0)
+        if op is ReduceOp.MAX:
+            return np.max(stacked, axis=0)
+        raise CollectiveError(f"unsupported reduce op {op!r}")
+
+    async def allgather(self, arr):
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        a = np.asarray(arr)
+        if self.spec.world_size == 1:
+            return [a.copy()]
+        # gang ops block until every process arrives: run off-loop so a
+        # straggler host cannot stall this process's rpc/event plane
+        gathered = await asyncio.to_thread(
+            multihost_utils.process_allgather, a
+        )
+        return [np.asarray(gathered[i]) for i in range(self.spec.world_size)]
+
+    async def allreduce(self, arr, op: ReduceOp):
+        import numpy as np
+
+        parts = await self.allgather(arr)
+        return self._reduce_stack(np.stack(parts), op).reshape(
+            np.asarray(arr).shape
+        )
+
+    async def reducescatter(self, arr, op: ReduceOp):
+        import numpy as np
+
+        reduced = (await self.allreduce(arr, op)).reshape(-1)
+        splits = np.array_split(reduced, self.spec.world_size)
+        return splits[self.spec.rank].copy()
+
+    async def broadcast(self, arr, root: int):
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        a = np.asarray(arr)
+        if self.spec.world_size == 1:
+            return a
+        out = await asyncio.to_thread(
+            multihost_utils.broadcast_one_to_all, a,
+            is_source=self.spec.rank == root,
+        )
+        return np.asarray(out)
+
+    async def broadcast_object(self, obj, root: int):
+        import pickle
+
+        import numpy as np
+
+        if self.spec.world_size == 1:
+            return obj
+        if self.spec.rank == root:
+            blob = pickle.dumps(obj, protocol=5)
+            await self.broadcast(np.array([len(blob)], np.int64), root)
+            await self.broadcast(np.frombuffer(blob, np.uint8).copy(), root)
+            return obj
+        size = await self.broadcast(np.zeros(1, np.int64), root)
+        payload = await self.broadcast(
+            np.zeros(int(size[0]), np.uint8), root
+        )
+        return pickle.loads(memoryview(payload))
+
+    async def barrier(self):
+        from jax.experimental import multihost_utils
+
+        if self.spec.world_size > 1:
+            await asyncio.to_thread(
+                multihost_utils.sync_global_devices,
+                f"rt-collective-{self.spec.name}",
+            )
+        return True
+
+    async def send(self, arr, dst: int):
+        raise CollectiveError(
+            "point-to-point send/recv is not expressible over the jax "
+            "gang surface; use backend='rpc' for p2p patterns"
+        )
+
+    async def recv(self, arr, src: int):
+        raise CollectiveError(
+            "point-to-point send/recv is not expressible over the jax "
+            "gang surface; use backend='rpc' for p2p patterns"
+        )

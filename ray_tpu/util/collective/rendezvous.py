@@ -1,0 +1,132 @@
+"""GCS-KV rendezvous for collective groups.
+
+Role-equivalent of ray: python/ray/util/collective/collective.py's
+``_group_mgr`` + the named-actor "Info" rendezvous
+(collective_group/... Rendezvous classes), collapsed onto the GCS KV
+table this runtime already has: each rank publishes its identity under
+``collective:<group>:<rank>`` and polls until the full membership table
+is visible.  Teardown deletes the keys so a group name can be reused
+after ``destroy_collective_group``.
+
+All coroutines here run on the runtime's io loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import time
+from typing import Optional
+
+from ray_tpu.common.config import cfg
+from ray_tpu.util.collective.types import (
+    CollectiveError,
+    MemberInfo,
+    RendezvousTimeoutError,
+)
+
+
+def _key(group_name: str, rank: int) -> str:
+    return f"collective:{group_name}:{rank}"
+
+
+async def declare(rt, group_name: str, world_size: int, rank: int,
+                  actor_id_hex: Optional[str]) -> MemberInfo:
+    """Publish this rank's identity.  Overwrites any stale key from a
+    previous same-named group (names are reusable only after destroy —
+    concurrent same-named groups are user error and detected below by
+    world_size/identity mismatches).  Rank 0's record also carries the
+    group's incarnation nonce; every rank adopts it at await_members,
+    and wire chunks are keyed by it so stale traffic from a previous
+    incarnation is dropped, never consumed."""
+    server = getattr(rt, "_worker_server", None)
+    if server is None:
+        raise CollectiveError(
+            "runtime collectives need a worker-hosted RPC server; call "
+            "init_collective_group from inside an actor (the driver "
+            "process has no peer-reachable endpoint)"
+        )
+    me = MemberInfo(
+        rank=rank,
+        addr=server.server.address,
+        node_id=rt.node_id,
+        worker_id=rt.worker_id.hex(),
+        actor_id=actor_id_hex,
+    )
+    record = {"world_size": world_size, "member": me.to_dict()}
+    if rank == 0:
+        record["incarnation"] = os.urandom(8).hex()
+    await rt.gcs.call(
+        "kv_put",
+        {
+            "key": _key(group_name, rank),
+            "value": pickle.dumps(record),
+            "overwrite": True,
+        },
+    )
+    return me
+
+
+async def await_members(rt, group_name: str, world_size: int, rank: int,
+                        me: MemberInfo,
+                        timeout: Optional[float] = None):
+    """Poll the KV table until every rank has declared; returns
+    ``(members in rank order, incarnation nonce)``.  Raises
+    RendezvousTimeoutError naming the missing ranks — the actionable
+    shape ("rank 2 never arrived") rather than a bare hang.
+
+    The incarnation is taken from a FINAL re-read of rank 0's record
+    once the table is complete: destroy deletes the keys, so stale
+    records only exist on the crash-without-destroy path, and the
+    re-read shrinks the adopt-an-old-nonce window to a single GCS
+    round trip."""
+    if timeout is None:
+        timeout = cfg.collective_rendezvous_timeout_s
+    deadline = time.monotonic() + timeout
+    members: dict = {rank: me}
+    delay = 0.02
+    while True:
+        for i in range(world_size):
+            if i in members:
+                continue
+            blob = await rt.gcs.call("kv_get", {"key": _key(group_name, i)})
+            if blob is None:
+                continue
+            rec = pickle.loads(blob)
+            if rec["world_size"] != world_size:
+                raise CollectiveError(
+                    f"collective group {group_name!r}: rank {i} declared "
+                    f"world_size={rec['world_size']} but this rank expects "
+                    f"{world_size} — two groups are using the same name"
+                )
+            members[i] = MemberInfo.from_dict(rec["member"])
+        if len(members) == world_size:
+            blob = await rt.gcs.call("kv_get", {"key": _key(group_name, 0)})
+            rec = pickle.loads(blob) if blob is not None else {}
+            incarnation = rec.get("incarnation", "")
+            members[0] = (
+                MemberInfo.from_dict(rec["member"])
+                if "member" in rec and rank != 0
+                else members[0]
+            )
+            return [members[i] for i in range(world_size)], incarnation
+        if time.monotonic() >= deadline:
+            missing = sorted(set(range(world_size)) - set(members))
+            raise RendezvousTimeoutError(
+                f"collective group {group_name!r} rendezvous timed out "
+                f"after {timeout:.0f}s: rank(s) {missing} never declared "
+                f"(got {len(members)}/{world_size}).  Check that every "
+                f"member actor is alive and called init_collective_group "
+                f"with the same group_name and world_size."
+            )
+        await asyncio.sleep(delay)
+        delay = min(delay * 2, 0.25)
+
+
+async def retract(rt, group_name: str, rank: int) -> None:
+    """Delete this rank's key (teardown half of the lifecycle)."""
+    try:
+        await rt.gcs.call("kv_del", {"key": _key(group_name, rank)})
+    except Exception:
+        pass  # best-effort: the GCS may already be gone at shutdown

@@ -470,6 +470,88 @@ class TestUnlockedLazyInit:
 
 
 # ---------------------------------------------------------------------------
+# RT109 blocking-collective-in-async
+# ---------------------------------------------------------------------------
+
+
+class TestBlockingCollectiveInAsync:
+    def test_flags_module_alias_allreduce_in_async_def(self):
+        src = """
+        from ray_tpu.util import collective as col
+
+        async def train_tick(grads):
+            return col.allreduce(grads, group_name="dp")
+        """
+        assert rule_ids(src, rules=["RT109"]) == ["RT109"]
+
+    def test_flags_from_imported_send_recv_barrier(self):
+        src = """
+        from ray_tpu.util.collective import barrier, recv, send
+
+        async def ps_tick(g, out):
+            send(g, 0)
+            recv(out, 0)
+            barrier()
+        """
+        assert rule_ids(src, rules=["RT109"]) == [
+            "RT109", "RT109", "RT109",
+        ]
+
+    def test_flags_blocking_init_in_async_def(self):
+        src = """
+        import ray_tpu.util.collective as col
+
+        async def setup(rank):
+            col.init_collective_group(4, rank, group_name="g")
+        """
+        assert rule_ids(src, rules=["RT109"]) == ["RT109"]
+
+    def test_silent_on_async_twins_and_executor_handoff(self):
+        # the compliant twin: *_async awaited on the loop, or the sync
+        # op handed to a thread as a function REFERENCE (no call node)
+        src = """
+        import asyncio
+
+        from ray_tpu.util import collective as col
+
+        async def train_tick(grads, out):
+            reduced = await col.allreduce_async(grads, group_name="dp")
+            await col.barrier_async(group_name="dp")
+            await asyncio.to_thread(col.recv, out, 0)
+            return reduced
+        """
+        assert rule_ids(src, rules=["RT109"]) == []
+
+    def test_silent_in_sync_def_and_nested_sync_helper(self):
+        src = """
+        from ray_tpu.util import collective as col
+
+        def learner_step(grads):
+            return col.allreduce(grads, group_name="dp")
+
+        async def outer():
+            def helper(g):
+                return col.allreduce(g)
+
+            import asyncio
+            return await asyncio.to_thread(helper, [1])
+        """
+        assert rule_ids(src, rules=["RT109"]) == []
+
+    def test_silent_on_unrelated_allreduce_names(self):
+        # in-program lax wrappers and arbitrary objects sharing the op
+        # name are not runtime-collective calls
+        src = """
+        from ray_tpu.parallel import collectives
+
+        async def body(x, comm):
+            comm.allreduce(x)
+            return collectives.allreduce_sum(x, "dp")
+        """
+        assert rule_ids(src, rules=["RT109"]) == []
+
+
+# ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, parse errors
 # ---------------------------------------------------------------------------
 

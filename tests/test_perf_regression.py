@@ -174,6 +174,70 @@ def test_tasks_async_single_client_throughput_floor(cluster):
     )
 
 
+@ray_tpu.remote
+class _ColRank:
+    """One co-hosted collective rank for the allreduce floor."""
+
+    def init(self, world, rank, group):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world, rank, group_name=group)
+        return True
+
+    def allreduce_rounds(self, nbytes, rounds, group):
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+
+        x = np.ones(nbytes // 4, dtype=np.float32)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            out = col.allreduce(x, group_name=group)
+        dt = time.perf_counter() - t0
+        return dt, float(out[0])
+
+
+def test_cohosted_4rank_allreduce_throughput_floor(cluster):
+    """Wall-clock floor for the runtime-collective shm path: 4 co-hosted
+    ranks ring-allreduce 4 MiB fp32 tensors (above the shm handoff
+    threshold, so chunks move through the arena, not the wire).  The
+    floor is set ~10x below an unloaded 1-core steady state so only a
+    structural regression — shm path silently falling back to wire
+    pickling, per-chunk copies multiplying, ring steps serializing —
+    trips it, not CI host load."""
+    world, nbytes, rounds = 4, 4 * 1024 * 1024, 6
+    group = "perf-ar"
+    ranks = [_ColRank.remote() for _ in range(world)]
+    ray_tpu.get(
+        [r.init.remote(world, i, group) for i, r in enumerate(ranks)],
+        timeout=120,
+    )
+    # one warmup round (conn dial + first-chunk arena setup)
+    ray_tpu.get(
+        [r.allreduce_rounds.remote(nbytes, 1, group) for r in ranks],
+        timeout=120,
+    )
+    outs = ray_tpu.get(
+        [r.allreduce_rounds.remote(nbytes, rounds, group) for r in ranks],
+        timeout=240,
+    )
+    for _, val in outs:
+        assert val == float(world)  # ones summed across 4 ranks
+    slowest = max(dt for dt, _ in outs)
+    # algorithm bandwidth: each rank moves 2*(n-1)/n * nbytes per round
+    moved = 2 * (world - 1) / world * nbytes * rounds
+    rate_mb_s = moved / slowest / 1e6
+    print(f"\ncohosted 4-rank allreduce: {rate_mb_s:.0f} MB/s/rank "
+          f"algo bandwidth ({rounds} rounds of {nbytes >> 20} MiB)")
+    for r in ranks:
+        ray_tpu.kill(r)
+    assert rate_mb_s > 20, (
+        f"co-hosted allreduce at {rate_mb_s:.0f} MB/s/rank fell through "
+        "the 20 MB/s floor — the shm handoff path regressed (unloaded "
+        "steady state is >10x this)"
+    )
+
+
 def test_drained_queue_leaves_no_parked_lease_requests(cluster):
     """After a burst of tasks completes, the scheduling class must cancel
     its parked lease requests; otherwise every freed slot ping-pongs

@@ -200,6 +200,74 @@ def test_smoke_64_nodes_5k_queued_backlog(tmp_path, monkeypatch):
         proc.wait(timeout=10)
 
 
+def test_tier3_scaled_2k_nodes_100k_queued_10k_actors(tmp_path, monkeypatch):
+    """Scaled-down tier 3 in the DEFAULT suite (VERDICT next #8: the
+    2k-node envelope claim was re-proven only behind RT_SCALE_TIER3):
+    the full tier-3 machinery — 2,000 stub nodes, a held beyond-capacity
+    backlog, dead-driver abandonment, an actor FSM storm — scaled to a
+    ~5-minute budget (measured solo: fleet 16s + backlog 179s + actor
+    storm 60s).  100k queued (1/10 of tier 3) is the deepest that fits:
+    submit alone paces at ~1k/s on 1 core, so 200k would blow the
+    budget.  Full tier 3 (1M queued / 40k actors) stays behind
+    RT_SCALE_TIER3."""
+    from ray_tpu.util import sched_bench as sb
+
+    # 2000 stub heartbeat loops share this test's one asyncio loop with
+    # the request storm; failure detection is not the envelope under
+    # test, and queued entries must HOLD rather than expire into client
+    # retries for the backlog to be genuinely ~170k deep on the server
+    monkeypatch.setenv("RT_NODE_DEATH_TIMEOUT_S", "3600")
+    monkeypatch.setenv("RT_SCHED_MAX_PENDING_LEASE_S", "7200")
+    proc, address = node_mod.start_gcs(str(tmp_path))
+    try:
+        async def main():
+            out = {}
+            stubs, hb = await sb.start_fleet(address, 2000)
+            clients = await sb.connect_clients(address, 8)
+            (out["submit_wall"], out["peak_depth"], out["drain_wall"],
+             out["abandon_wall"]) = await sb.queued_backlog_hold(
+                address, clients, 100_000, drain_n=10_000
+            )
+            # backlog_hold closed its clients (the dead-driver abandon
+            # path); the actor storm gets fresh connections
+            clients = await sb.connect_clients(address, 8)
+            reg_wall, kill_wall = await sb.actor_lifecycle_storm(
+                clients, 10_000, concurrency=512
+            )
+            out["actor_reg_rate"] = 10_000 / reg_wall
+            out["actor_kill_rate"] = 10_000 / kill_wall
+            t0 = time.perf_counter()
+            st = await clients[0].call("scheduler_stats", {}, timeout=60)
+            out["probe_ms"] = (time.perf_counter() - t0) * 1e3
+            out["nodes_alive"] = st["nodes_alive"]
+            out["pending"] = st["pending_leases"]
+            await sb.close_clients(clients)
+            await sb.stop_fleet(stubs, hb)
+            return out
+
+        out = asyncio.run(main())
+        print(
+            f"\n2k-node scaled tier: 100k tasks submitted in "
+            f"{out['submit_wall']:.0f}s, peak queue depth "
+            f"{out['peak_depth']}, 10k drained in "
+            f"{out['drain_wall']:.0f}s, 90k abandoned in "
+            f"{out['abandon_wall']:.0f}s; 10k actors reg "
+            f"{out['actor_reg_rate']:.0f}/s kill "
+            f"{out['actor_kill_rate']:.0f}/s; post-storm stats probe "
+            f"{out['probe_ms']:.0f}ms, {out['nodes_alive']} nodes alive"
+        )
+        assert out["nodes_alive"] == 2000
+        # 2k nodes x 16 CPU = 32k slots; the held backlog must really
+        # have been beyond-capacity deep on the server (~68k observed)
+        assert out["peak_depth"] > 60_000, out["peak_depth"]
+        assert out["probe_ms"] < 5_000
+        assert out["actor_reg_rate"] > 150
+        assert out["pending"] == 0, "abandoned backlog not compacted"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 # ---------------------------------------------------------------------------
 # Tier 2: 1,000 nodes / 20k actors / 100k queued tasks / 1k concurrent PGs
 # (10x tier 1; reference published envelope: 2,000 nodes, 40k actors,
