@@ -73,7 +73,8 @@ def record_stage(run_id: str, stage: str, t0: float, block) -> None:
         nbytes = int(getattr(block, "nbytes", 0) or 0)
         # fire-and-forget BY DESIGN: stats are advisory, the enclosing
         # try swallows every failure, and holding refs would pin one
-        # object per block task
+        # object per block task (rtflow RT202 audit: the ref is dropped,
+        # never stored, so nothing pins the arena)
         # rtlint: disable-next=RT105
         stats_handle().record.remote(
             run_id, stage, time.perf_counter() - t0, rows, nbytes

@@ -1,0 +1,36 @@
+"""rtflow: whole-program call-graph + actor-boundary dataflow analysis.
+
+The per-file tier (``ray_tpu.devtools.lint``, RT1xx) catches bug
+families visible inside one module.  This package is the second tier:
+it indexes an entire package into a symbol table + call graph that
+models the framework's remote surface — ``@ray_tpu.remote`` functions
+and actor classes, ``.remote()`` submissions, ``get()``/``wait()``
+blocking edges, ``util.collective`` op sites, ObjectRef-producing and
+-consuming expressions — and runs interprocedural rules on top:
+
+- RT201 actor-deadlock: cycles over blocking remote-call edges between
+  actors (including self-calls).
+- RT202 objectref-leak: refs stored into long-lived containers or
+  attributes that no reachable code ever drains — they pin shm arena
+  capacity forever.
+- RT203 unserializable-capture: remote closures capturing locks, event
+  loops, sockets/clients, open files, or live jax Arrays.
+- RT204 rank-divergent-collective: symmetric collective op sequences
+  that differ across rank-conditional branches (the mismatched
+  allreduce hang), resolved through helper calls.
+
+Findings ride the same ``Finding`` type, suppression comments, and
+baseline machinery as the per-file tier; run both with::
+
+    python -m ray_tpu.devtools.lint --flow ray_tpu
+"""
+
+from ray_tpu.devtools.flow.engine import (  # noqa: F401
+    DEFAULT_FLOW_BASELINE,
+    FlowReport,
+    all_flow_rules,
+    analyze_paths,
+    analyze_sources,
+    flow_rule_ids,
+)
+from ray_tpu.devtools.flow.index import ProgramIndex  # noqa: F401

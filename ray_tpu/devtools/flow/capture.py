@@ -1,0 +1,183 @@
+"""RT203 unserializable-capture: remote closures over process-local
+resources.
+
+A remote function is cloudpickled at submission.  A closure (or
+argument) that drags along a ``threading.Lock``, an event loop, an open
+socket/file, an HTTP/grpc client, or a live jax Array either fails to
+pickle outright (TypeError at submission — the lucky case) or pickles a
+*copy* whose semantics are silently wrong on the worker: a copied lock
+guards nothing across processes, a copied client reconnects per task,
+a captured device Array pins device memory on the driver and ships a
+stale snapshot.
+
+Three capture channels are checked:
+
+- module-level globals constructed from a known process-local ctor and
+  read (free-variable) inside a ``@remote`` function or actor method;
+- locals of an enclosing function captured by a *nested* ``@remote``
+  definition (true closure cells — always serialized);
+- values with process-local provenance passed as arguments to a
+  ``.remote(...)`` submission (jax Arrays are exempt here: passing an
+  array as an argument is the supported path, it is the *closure*
+  capture that pins the device buffer).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.flow.engine import FlowRule
+from ray_tpu.devtools.flow.index import ProgramIndex, free_names
+
+# resolved ctor -> category label
+_BAD_CTORS: Dict[str, str] = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Condition": "lock",
+    "threading.Semaphore": "lock",
+    "threading.BoundedSemaphore": "lock",
+    "threading.Event": "lock",
+    "threading.Barrier": "lock",
+    "threading.local": "thread-local state",
+    "multiprocessing.Lock": "lock",
+    "multiprocessing.RLock": "lock",
+    "asyncio.Lock": "asyncio primitive",
+    "asyncio.Event": "asyncio primitive",
+    "asyncio.Condition": "asyncio primitive",
+    "asyncio.Semaphore": "asyncio primitive",
+    "asyncio.Queue": "asyncio primitive",
+    "asyncio.get_event_loop": "event loop",
+    "asyncio.new_event_loop": "event loop",
+    "asyncio.get_running_loop": "event loop",
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "open": "open file handle",
+    "io.open": "open file handle",
+    "grpc.insecure_channel": "grpc channel",
+    "grpc.secure_channel": "grpc channel",
+    "requests.Session": "http client",
+    "httpx.Client": "http client",
+    "httpx.AsyncClient": "http client",
+    "jax.device_put": "live jax Array",
+    "jax.numpy.array": "live jax Array",
+    "jax.numpy.asarray": "live jax Array",
+    "jax.numpy.zeros": "live jax Array",
+    "jax.numpy.ones": "live jax Array",
+    "jax.numpy.full": "live jax Array",
+    "jax.numpy.arange": "live jax Array",
+    "jax.random.PRNGKey": "live jax Array",
+}
+
+# categories that are fine as *arguments* (serialized via the object
+# store by design) but not as closure captures
+_ARG_EXEMPT_CATEGORIES = {"live jax Array"}
+
+
+class UnserializableCapture(FlowRule):
+    id = "RT203"
+    name = "unserializable-capture"
+    description = (
+        "remote closure captures (or remote call ships) a process-local "
+        "resource: lock, event loop, socket, open file, client, or "
+        "live jax Array"
+    )
+    hint = (
+        "construct the resource inside the remote body (or in the "
+        "actor's __init__ on the worker); pass plain data across the "
+        "boundary"
+    )
+
+    def _classify(
+        self, module, expr: Optional[ast.AST]
+    ) -> Optional[Tuple[str, str]]:
+        """(category, ctor name) when the expr constructs a known
+        process-local resource."""
+        if not isinstance(expr, ast.Call):
+            return None
+        resolved = module.resolve(expr.func)
+        if resolved is None:
+            return None
+        cat = _BAD_CTORS.get(resolved)
+        if cat is None:
+            return None
+        return cat, resolved
+
+    def check(self, index: ProgramIndex) -> None:
+        for fq in sorted(index.functions):
+            fn = index.functions[fq]
+            module = fn.module
+
+            # channel 1: module-global resources read from remote bodies
+            if fn.is_remote:
+                self._check_captures(
+                    index, module, fn.node, module.top_assigns,
+                    where=f"remote `{fn.short}`",
+                )
+
+            facts = index.facts(fn)
+
+            # channel 2: nested @remote defs closing over enclosing
+            # locals (true closure cells)
+            for nested in facts.nested_defs:
+                if not isinstance(
+                    nested, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if not astutil.is_remote_decorated(
+                    nested, module.imports
+                ):
+                    continue
+                enclosing: Dict[str, ast.expr] = dict(module.top_assigns)
+                enclosing.update(facts.local_assigns)
+                self._check_captures(
+                    index, module, nested, enclosing,
+                    where=f"nested remote `{nested.name}`",
+                )
+
+            # channel 3: process-local values shipped as .remote() args
+            for call, _target in facts.remote_calls:
+                args = list(call.args) + [
+                    kw.value for kw in call.keywords
+                ]
+                for arg in args:
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    prov = facts.local_assigns.get(arg.id)
+                    if prov is None:
+                        prov = module.top_assigns.get(arg.id)
+                    hit = self._classify(module, prov)
+                    if hit is None:
+                        continue
+                    cat, ctor = hit
+                    if cat in _ARG_EXEMPT_CATEGORIES:
+                        continue
+                    self.add(
+                        module, call,
+                        message=(
+                            f"unserializable-capture: `{arg.id}` "
+                            f"(a {cat} from `{ctor}(...)`) is shipped "
+                            f"as a `.remote()` argument — it either "
+                            f"fails to pickle or arrives as a useless "
+                            f"process-local copy"
+                        ),
+                    )
+
+    def _check_captures(
+        self, index, module, fn_node, provenance, where: str
+    ) -> None:
+        for name in sorted(free_names(fn_node)):
+            hit = self._classify(module, provenance.get(name))
+            if hit is None:
+                continue
+            cat, ctor = hit
+            self.add(
+                module, fn_node,
+                message=(
+                    f"unserializable-capture: {where} captures "
+                    f"`{name}` (a {cat} from `{ctor}(...)`) — "
+                    f"cloudpickle ships a process-local copy whose "
+                    f"semantics are wrong on the worker"
+                ),
+            )

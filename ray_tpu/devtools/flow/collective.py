@@ -1,0 +1,225 @@
+"""RT204 rank-divergent-collective: collective sequences that differ
+across rank-conditional branches.
+
+Symmetric collectives (allreduce / allgather / reducescatter /
+broadcast / broadcast_object / barrier and their ``*_async`` twins)
+require every rank of the group to make the SAME sequence of calls.  A
+rank-guarded branch that performs one more (or one fewer) collective
+than its sibling leaves the other ranks parked in a ring step that
+never completes — the mismatched-allreduce hang, which surfaces as a
+collective timeout minutes later with no pointer at the guilty branch.
+
+The comparison is interprocedural: each branch's collective sequence is
+computed through helper calls using memoized whole-function summaries
+over the call graph (cycle-safe), so ``if rank == 0: _report()`` is
+flagged when ``_report`` transitively allreduces.  Point-to-point
+``send``/``recv`` are intentionally rank-divergent (the PS pattern) and
+never counted.  Nested rank-conditionals are flagged at their own
+level, not re-reported by enclosing comparisons.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ray_tpu.devtools.flow.engine import FlowRule
+from ray_tpu.devtools.flow.index import (
+    FunctionInfo,
+    ProgramIndex,
+    iter_nodes_skip_nested,
+)
+
+_COLLECTIVE_PKG = "ray_tpu.util.collective"
+_SYMMETRIC_OPS = {
+    "allreduce", "allgather", "reducescatter", "broadcast",
+    "broadcast_object", "barrier",
+}
+
+# a branch whose op sequence is data-dependent (a nested NON-rank
+# conditional diverges internally): participates in branch comparison
+# as an ordinary token, so `if rank == 0: (if debug: barrier())` still
+# compares unequal to the empty else-branch, while two symmetric
+# data-dependent branches compare equal and stay silent
+_UNKNOWN = "?"
+
+# a nested RANK-conditional diverged: that If gets its own finding, so
+# enclosing comparisons skip instead of double-reporting
+_REPORTED = "!"
+
+# call-graph expansion bound: summaries deeper than this contribute
+# nothing (keeps pathological 500-deep helper chains out of the Python
+# recursion limit; real divergence sits within a few hops of the rank
+# conditional)
+_MAX_DEPTH = 16
+
+
+def _op_of(resolved: Optional[str]) -> Optional[str]:
+    if not resolved or not resolved.startswith(_COLLECTIVE_PKG + "."):
+        return None
+    op = resolved.rsplit(".", 1)[1]
+    if op.endswith("_async"):
+        op = op[: -len("_async")]
+    return op if op in _SYMMETRIC_OPS else None
+
+
+def _is_rank_conditional(test: ast.AST, module, index) -> bool:
+    """The branch condition depends on the caller's rank: an identifier
+    mentioning ``rank`` or a ``get_rank()`` / ``process_index()`` call."""
+    for node in ast.walk(test):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident is not None:
+            low = ident.lower()
+            if "rank" in low or low == "process_index":
+                return True
+    return False
+
+
+class RankDivergentCollective(FlowRule):
+    id = "RT204"
+    name = "rank-divergent-collective"
+    description = (
+        "symmetric collective op sequence differs across a "
+        "rank-conditional branch — non-participating ranks hang"
+    )
+    hint = (
+        "make every rank issue the same collective sequence (hoist the "
+        "op out of the branch, or use broadcast with src= in both arms)"
+    )
+
+    def check(self, index: ProgramIndex) -> None:
+        self._summaries: Dict[str, Tuple[str, ...]] = {}
+        self._in_progress: Set[str] = set()
+        self._index = index
+        for fq in sorted(index.functions):
+            fn = index.functions[fq]
+            for node in iter_nodes_skip_nested(fn.node.body):
+                if not isinstance(node, ast.If):
+                    continue
+                if not _is_rank_conditional(node.test, fn.module, index):
+                    continue
+                body_seq = self._seq(fn, node.body, 0)
+                else_seq = self._seq(fn, node.orelse, 0)
+                if _REPORTED in body_seq or _REPORTED in else_seq:
+                    continue  # nested rank-divergence has its own finding
+                if body_seq == else_seq:
+                    continue
+                self.add(
+                    fn.module, node,
+                    message=(
+                        f"rank-divergent-collective: in `{fn.short}` "
+                        f"the rank-conditional branches issue different "
+                        f"collective sequences "
+                        f"([{', '.join(body_seq) or 'none'}] vs "
+                        f"[{', '.join(else_seq) or 'none'}]) — the "
+                        f"ranks taking the poorer branch hang the group"
+                    ),
+                )
+
+    # -- sequence computation --------------------------------------------
+
+    def _summary(self, fn: FunctionInfo, depth: int) -> Tuple[str, ...]:
+        cached = self._summaries.get(fn.qualname)
+        if cached is not None:
+            return cached
+        if depth > _MAX_DEPTH:
+            return ()  # over the expansion bound: uncached, contribute nothing
+        if fn.qualname in self._in_progress:
+            return ()  # recursion: contribute nothing (cycle-safe)
+        self._in_progress.add(fn.qualname)
+        try:
+            seq = self._seq(fn, fn.node.body, depth)
+        finally:
+            self._in_progress.discard(fn.qualname)
+        self._summaries[fn.qualname] = seq
+        return seq
+
+    def _seq(
+        self, fn: FunctionInfo, stmts: Sequence[ast.stmt], depth: int
+    ) -> Tuple[str, ...]:
+        out: List[str] = []
+        for stmt in stmts:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            if isinstance(stmt, ast.If):
+                a = self._seq(fn, stmt.body, depth)
+                b = self._seq(fn, stmt.orelse, depth)
+                if a == b:
+                    out.extend(a)
+                elif a or b:
+                    out.append(
+                        _REPORTED
+                        if _is_rank_conditional(
+                            stmt.test, fn.module, self._index
+                        )
+                        else _UNKNOWN
+                    )
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                out.extend(self._expr_ops(fn, getattr(stmt, "iter", None)
+                                          or getattr(stmt, "test", None),
+                                          depth))
+                out.extend(self._seq(fn, stmt.body, depth))
+                out.extend(self._seq(fn, stmt.orelse, depth))
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    out.extend(
+                        self._expr_ops(fn, item.context_expr, depth)
+                    )
+                out.extend(self._seq(fn, stmt.body, depth))
+                continue
+            if isinstance(stmt, ast.Try):
+                out.extend(self._seq(fn, stmt.body, depth))
+                for handler in stmt.handlers:
+                    out.extend(self._seq(fn, handler.body, depth))
+                out.extend(self._seq(fn, stmt.orelse, depth))
+                out.extend(self._seq(fn, stmt.finalbody, depth))
+                continue
+            out.extend(self._expr_ops(fn, stmt, depth))
+        return tuple(out)
+
+    def _expr_ops(
+        self, fn: FunctionInfo, node: Optional[ast.AST], depth: int
+    ) -> Tuple[str, ...]:
+        """Ops performed by the expressions of one simple statement,
+        expanding calls to indexed functions via their summaries."""
+        if node is None:
+            return ()
+        out: List[str] = []
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            resolved = self._index.resolve_name(fn.module, sub.func)
+            op = _op_of(resolved)
+            if op is not None:
+                out.append(op)
+                continue
+            callee = self._callee(fn, sub, resolved)
+            if callee is not None:
+                out.extend(self._summary(callee, depth + 1))
+        return tuple(out)
+
+    def _callee(
+        self, fn: FunctionInfo, call: ast.Call, resolved: Optional[str]
+    ) -> Optional[FunctionInfo]:
+        if resolved is not None:
+            callee = self._index.functions.get(resolved)
+            if callee is not None:
+                return callee
+        func = call.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and fn.owner is not None
+        ):
+            return fn.owner.methods.get(func.attr)
+        return None

@@ -1,0 +1,210 @@
+"""RT201 actor-deadlock: cycles over blocking remote-call edges.
+
+An actor processes one message at a time.  If a method of actor A
+blocking-``get()``s a ref submitted into actor B, A's mailbox is frozen
+until B replies; if B (transitively) blocking-waits on a submission
+back into A, both mailboxes are frozen forever — the classic
+distributed deadlock, which at runtime looks like a silent hang until a
+lease or collective timeout fires minutes later.
+
+The rule builds an actor-level digraph: an edge A -> B for every
+*unbounded* blocking get in a method of A whose argument's provenance
+is a resolved ``<B-handle>.<meth>.remote(...)`` submission.  Every edge
+inside a strongly connected component (including self-loops — an actor
+blocking on a submission into itself can never serve it) is flagged at
+its get site.  Bounded ``timeout=`` waits degrade deadlock to latency
+and are exempt, matching RT104.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ray_tpu.devtools.flow.engine import FlowRule
+from ray_tpu.devtools.flow.index import FunctionFacts, ProgramIndex
+
+
+def _arg_ref_targets(
+    index: ProgramIndex, fn, facts: FunctionFacts, call: ast.Call
+) -> List[tuple]:
+    """Ref targets flowing into a get/wait call's arguments."""
+    out: List[tuple] = []
+    exprs = list(call.args) + [kw.value for kw in call.keywords]
+    flat: List[ast.AST] = []
+    for e in exprs:
+        if isinstance(e, (ast.List, ast.Tuple, ast.Set)):
+            flat.extend(e.elts)
+        else:
+            flat.append(e)
+    for e in flat:
+        if isinstance(e, ast.Name):
+            t = facts.ref_targets.get(e.id)
+            if t is not None:
+                out.append(t)
+            continue
+        t = index.remote_target(fn.module, e, facts.env, fn.owner)
+        if t is not None and t[0] != "handle":
+            out.append(t)
+            continue
+        t = index.container_ref_target(fn.module, e, facts.env, fn.owner)
+        if t is not None:
+            out.append(t)
+    return out
+
+
+def _sccs(nodes: List[str], adj: Dict[str, List[str]]) -> Dict[str, int]:
+    """Iterative Tarjan; returns node -> component id."""
+    index_of: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    comp: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    counter = [0]
+    comp_id = [0]
+
+    for root in nodes:
+        if root in index_of:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, ei = work[-1]
+            if ei == 0:
+                index_of[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack[node] = True
+            succs = adj.get(node, [])
+            advanced = False
+            while ei < len(succs):
+                succ = succs[ei]
+                ei += 1
+                if succ not in index_of:
+                    work[-1] = (node, ei)
+                    work.append((succ, 0))
+                    advanced = True
+                    break
+                if on_stack.get(succ):
+                    low[node] = min(low[node], index_of[succ])
+            if advanced:
+                continue
+            work[-1] = (node, ei)
+            if ei >= len(succs):
+                work.pop()
+                if low[node] == index_of[node]:
+                    while True:
+                        top = stack.pop()
+                        on_stack[top] = False
+                        comp[top] = comp_id[0]
+                        if top == node:
+                            break
+                    comp_id[0] += 1
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+    return comp
+
+
+def _cycle_path(
+    src: str, dst: str, adj: Dict[str, List[str]]
+) -> List[str]:
+    """Shortest dst -> src walk (BFS) to render the cycle back-edge."""
+    if dst == src:
+        return [dst, src]
+    frontier = [dst]
+    came: Dict[str, str] = {dst: ""}
+    while frontier:
+        nxt: List[str] = []
+        for node in frontier:
+            for succ in adj.get(node, []):
+                if succ in came:
+                    continue
+                came[succ] = node
+                if succ == src:
+                    path = [succ]
+                    while path[-1] != dst:
+                        path.append(came[path[-1]])
+                    return list(reversed(path))
+                nxt.append(succ)
+        frontier = nxt
+    return [dst, src]
+
+
+class ActorDeadlock(FlowRule):
+    id = "RT201"
+    name = "actor-deadlock"
+    description = (
+        "blocking get of a remote call that can cycle back through the "
+        "same actor"
+    )
+    hint = (
+        "break the wait cycle: make one side async (await the ref), "
+        "pass refs through as task arguments, or bound the wait with "
+        "timeout="
+    )
+
+    def check(self, index: ProgramIndex) -> None:
+        # actor qualname -> actor qualname -> [(fn, get node, target)]
+        edges: Dict[str, Dict[str, list]] = {}
+        for cq in sorted(index.classes):
+            cls = index.classes[cq]
+            if not cls.is_actor:
+                continue
+            for mname in sorted(cls.methods):
+                fn = cls.methods[mname]
+                facts = index.facts(fn)
+                for site in facts.gets:
+                    if site.bounded:
+                        continue
+                    for t in _arg_ref_targets(index, fn, facts, site.node):
+                        if t[0] != "ref-actor":
+                            continue
+                        callee = index.classes.get(t[1])
+                        if callee is None or not callee.is_actor:
+                            continue
+                        edges.setdefault(cq, {}).setdefault(
+                            t[1], []
+                        ).append((fn, site.node, t))
+
+        nodes = sorted(
+            set(edges) | {d for dsts in edges.values() for d in dsts}
+        )
+        adj = {n: sorted(edges.get(n, {})) for n in nodes}
+        comp = _sccs(nodes, adj)
+        scc_sizes: Dict[int, int] = {}
+        for node in nodes:
+            scc_sizes[comp[node]] = scc_sizes.get(comp[node], 0) + 1
+
+        for src in sorted(edges):
+            for dst in sorted(edges[src]):
+                if comp[src] != comp[dst]:
+                    continue
+                if src == dst:
+                    cyclic = True  # self-loop edge
+                else:
+                    cyclic = scc_sizes[comp[src]] > 1
+                if not cyclic:
+                    continue
+                path = _cycle_path(src, dst, adj)
+                shorts = [index.classes[n].short for n in path]
+                for fn, node, t in edges[src][dst]:
+                    callee = index.classes[t[1]]
+                    if src == dst:
+                        msg = (
+                            f"actor-deadlock: `{fn.short}` blocking-gets "
+                            f"`{callee.short}.{t[2]}.remote()` on its own "
+                            f"actor class — the single-threaded actor "
+                            f"can never serve the call it is waiting on"
+                        )
+                    else:
+                        msg = (
+                            f"actor-deadlock: `{fn.short}` blocking-gets "
+                            f"`{callee.short}.{t[2]}.remote()` and the "
+                            f"callee can block back into "
+                            f"`{index.classes[src].short}` (cycle: "
+                            + " -> ".join(
+                                [index.classes[src].short] + shorts
+                            )
+                            + ")"
+                        )
+                    self.add(fn.module, node, message=msg)

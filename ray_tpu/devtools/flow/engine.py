@@ -1,0 +1,179 @@
+"""rtflow engine: builds the program index over a path set / source
+dict, runs the RT2xx rules, and funnels findings through the SAME
+suppression + fingerprint machinery as the per-file tier, so
+``# rtlint: disable-next=RT201`` comments and baseline entries behave
+identically across both tiers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.devtools.lint import (
+    Finding,
+    _apply_suppressions,
+    iter_py_files,
+)
+
+DEFAULT_FLOW_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "flow_baseline.json"
+)
+
+
+class FlowRule:
+    """Whole-program rule: ``check(index)`` walks the index and reports
+    through ``add`` into the owning module's context (so per-module
+    suppression comments apply)."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    hint: str = ""
+
+    def check(self, index) -> None:
+        raise NotImplementedError
+
+    def add(self, module, node, message=None, hint=None) -> None:
+        module.ctx.add(self, node, message=message, hint=hint)
+
+
+def all_flow_rules() -> List[FlowRule]:
+    # imported here: the rule modules import FlowRule from this module
+    from ray_tpu.devtools.flow.capture import UnserializableCapture
+    from ray_tpu.devtools.flow.collective import RankDivergentCollective
+    from ray_tpu.devtools.flow.deadlock import ActorDeadlock
+    from ray_tpu.devtools.flow.refleak import ObjectRefLeak
+
+    return [
+        ActorDeadlock(),
+        ObjectRefLeak(),
+        UnserializableCapture(),
+        RankDivergentCollective(),
+    ]
+
+
+def flow_rule_ids() -> Tuple[str, ...]:
+    return tuple(r.id for r in all_flow_rules())
+
+
+@dataclasses.dataclass
+class FlowReport:
+    findings: List[Finding]
+    files_indexed: int
+    parse_errors: List[str]
+
+
+def _select(rules: Optional[Sequence[str]]) -> List[FlowRule]:
+    selected = all_flow_rules()
+    if rules is not None:
+        wanted = set(rules)
+        unknown = wanted - {r.id for r in selected}
+        if unknown:
+            raise ValueError(f"unknown flow rule id(s): {sorted(unknown)}")
+        selected = [r for r in selected if r.id in wanted]
+    return selected
+
+
+def analyze_index(index, rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    for rule in _select(rules):
+        rule.check(index)
+    findings: List[Finding] = []
+    for mname in sorted(index.modules):
+        findings.extend(_apply_suppressions(index.modules[mname].ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_sources(
+    files: Dict[str, str], rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Fixture/test entry point: ``files`` maps package-relative paths
+    (``pkg/mod.py``) to sources; paths double as module names."""
+    from ray_tpu.devtools.flow.index import (
+        build_index,
+        module_name_from_relpath,
+    )
+
+    entries = []
+    for path in sorted(files):
+        norm = path.replace(os.sep, "/")
+        tree = ast.parse(files[path], filename=norm)
+        entries.append(
+            (norm, module_name_from_relpath(norm), files[path], tree)
+        )
+    index = build_index(entries)
+    return analyze_index(index, rules=rules)
+
+
+def _package_base(path: str) -> str:
+    """Walk up from a scanned root past every ``__init__.py``-bearing
+    directory, so ``lint --flow ray_tpu/rllib`` (or a single
+    ``ray_tpu/rllib/impala.py``) still derives the real
+    ``ray_tpu.rllib.*`` module names — anything else breaks qualnames
+    and relative-import resolution and the tier silently under-reports."""
+    base = os.path.dirname(os.path.abspath(path))
+    while base and os.path.isfile(os.path.join(base, "__init__.py")):
+        parent = os.path.dirname(base)
+        if parent == base:
+            break
+        base = parent
+    return base
+
+
+def _collect_entries(paths: Sequence[str]):
+    """(finding_path, module_name, fs_path) per .py file.  Module names
+    are derived relative to each scanned root's enclosing package base,
+    so ``lint ray_tpu`` from the repo root yields real ``ray_tpu.*``
+    names and a tmp-dir package yields ``pkg.*`` names."""
+    out = []
+    seen = set()
+    for p in paths:
+        base = _package_base(p)
+        for fpath in iter_py_files([p]):
+            apath = os.path.abspath(fpath)
+            if apath in seen:
+                continue
+            seen.add(apath)
+            rel_for_name = os.path.relpath(apath, base)
+            finding_path = fpath
+            if os.path.isabs(fpath):
+                candidate = os.path.relpath(fpath)
+                if not candidate.startswith(".."):
+                    finding_path = candidate
+            finding_path = finding_path.replace(os.sep, "/")
+            out.append((finding_path, rel_for_name, apath))
+    return out
+
+
+def analyze_paths(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> FlowReport:
+    from ray_tpu.devtools.flow.index import (
+        build_index,
+        module_name_from_relpath,
+    )
+
+    entries = []
+    errors: List[str] = []
+    for finding_path, rel_for_name, apath in _collect_entries(paths):
+        try:
+            with open(apath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=finding_path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # RT000 is the per-file tier's finding; the flow tier just
+            # indexes what parses and reports the rest as errors
+            errors.append(f"{finding_path}: {e}")
+            continue
+        entries.append((
+            finding_path,
+            module_name_from_relpath(rel_for_name),
+            source,
+            tree,
+        ))
+    index = build_index(entries)
+    findings = analyze_index(index, rules=rules)
+    return FlowReport(findings, len(entries), errors)

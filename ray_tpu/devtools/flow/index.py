@@ -1,0 +1,609 @@
+"""Whole-program symbol table + call graph for the rtflow tier.
+
+The index models the package's *remote surface* rather than full Python
+semantics: which classes are actors, which functions are remote, what
+every ``X.remote(...)`` / ``get()`` / collective call site resolves to,
+and the (cheap, flow-insensitive) types of actor handles held in locals,
+parameters, and ``self`` attributes.  Rules consume these facts instead
+of re-deriving AST shapes.
+
+Known soundness limits (documented in docs/architecture.md): dynamic
+dispatch through ``getattr``/dicts of handles, handles returned from
+un-annotated factories, and re-exports deeper than four hops are not
+resolved — an unresolved site produces *no* edge (precision over
+recall, same contract as the RT1xx tier).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import ModuleContext
+
+
+def module_name_from_relpath(rel: str) -> str:
+    """``pkg/sub/mod.py`` -> ``pkg.sub.mod``; ``pkg/__init__.py`` -> ``pkg``."""
+    rel = rel.replace(os.sep, "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    parts = [p for p in rel.split("/") if p and p != "."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def iter_nodes_skip_nested(body: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    """Preorder, source-ordered walk of a function body that yields (but
+    does not descend into) nested function/class definitions — their
+    bodies are separate scopes and must not contribute facts to the
+    enclosing function."""
+    stack: List[ast.AST] = list(reversed(list(body)))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def free_names(fn_node: ast.AST) -> Set[str]:
+    """Names the function body loads but never binds (params, assigns,
+    imports, defs, ``except .. as``, comprehension targets all bind).
+    Over-approximates bindings across nested scopes, so the result
+    under-reports rather than false-positives."""
+    bound: Set[str] = set()
+    loads: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = (
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            )
+            if a.vararg:
+                params.append(a.vararg)
+            if a.kwarg:
+                params.append(a.kwarg)
+            for arg in params:
+                bound.add(arg.arg)
+            bound.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            bound.add(node.name)
+        elif isinstance(node, ast.Name):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                bound.add(node.id)
+            else:
+                loads.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+    return {n for n in loads - bound if not hasattr(builtins, n)}
+
+
+def has_bounded_timeout(call: ast.Call) -> bool:
+    """Same contract as RT104: an explicit non-None ``timeout=``
+    degrades a potential deadlock to latency."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None
+            )
+    return False
+
+
+_BLOCKING_GET = {"ray_tpu.get", "ray_tpu.wait"}
+_RUNTIME_RECEIVERS = {"rt"}
+
+
+class ModuleInfo:
+    """One source file plus its resolution environment."""
+
+    def __init__(
+        self,
+        name: str,
+        path: str,
+        source: str,
+        tree: ast.AST,
+        is_package: bool,
+    ):
+        self.name = name
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.is_package = is_package
+        self.ctx = ModuleContext(path, source, tree)
+        self.imports = self.ctx.imports
+        # module-level simple assignments + defined names, for global
+        # provenance (RT202/RT203) and local-symbol qualification
+        self.top_assigns: Dict[str, ast.expr] = {}
+        self.top_defs: Set[str] = set()
+        for stmt in tree.body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                self.top_defs.add(stmt.name)
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.top_assigns[t.id] = stmt.value
+                        self.top_defs.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                if stmt.value is not None:
+                    self.top_assigns[stmt.target.id] = stmt.value
+                self.top_defs.add(stmt.target.id)
+
+    def resolve_relative(self, raw: str) -> str:
+        """``.rpc`` seen from ``pkg.core.worker`` -> ``pkg.core.rpc``."""
+        level = len(raw) - len(raw.lstrip("."))
+        rest = raw[level:]
+        parts = self.name.split(".")
+        if not self.is_package:
+            parts = parts[:-1]
+        if level > 1:
+            parts = parts[: max(0, len(parts) - (level - 1))]
+        base = ".".join(parts)
+        if not base:
+            return rest
+        return f"{base}.{rest}" if rest else base
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Absolute dotted name of a Name/Attribute chain: import-alias
+        substitution, relative-import normalization, and qualification
+        of module-local top-level symbols."""
+        raw = self.imports.resolve(node)
+        if raw is None:
+            return None
+        if raw.startswith("."):
+            return self.resolve_relative(raw)
+        head = raw.split(".", 1)[0]
+        if head in self.top_defs and head not in self.imports.aliases:
+            return f"{self.name}.{raw}"
+        return raw
+
+
+class FunctionInfo:
+    def __init__(
+        self,
+        qualname: str,
+        module: ModuleInfo,
+        node: ast.AST,
+        owner: Optional["ClassInfo"] = None,
+    ):
+        self.qualname = qualname
+        self.module = module
+        self.node = node
+        self.owner = owner
+        self.name = node.name
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.is_remote = astutil.is_remote_decorated(
+            node, module.imports
+        ) or (owner is not None and owner.is_actor)
+
+    @property
+    def short(self) -> str:
+        if self.owner is not None:
+            return f"{self.owner.short}.{self.name}"
+        return self.name
+
+
+class ClassInfo:
+    def __init__(self, qualname: str, module: ModuleInfo, node: ast.ClassDef):
+        self.qualname = qualname
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.is_actor = astutil.is_remote_decorated(node, module.imports)
+        self.methods: Dict[str, FunctionInfo] = {}
+        self._attr_types: Optional[Dict[str, str]] = None
+
+    @property
+    def short(self) -> str:
+        return self.name
+
+
+class GetSite:
+    """A blocking ``get``/``wait`` call site inside one function."""
+
+    def __init__(self, node: ast.Call, bounded: bool):
+        self.node = node
+        self.bounded = bounded
+
+
+class FunctionFacts:
+    """Flow-insensitive facts for one function body (nested defs
+    excluded — they are separate scopes / separate index entries)."""
+
+    def __init__(self):
+        # var -> actor class qualname (a held handle)
+        self.env: Dict[str, str] = {}
+        # var -> ('ref-actor', clsqual, meth) | ('ref-task', fnqual)
+        #      | ('ref-unknown',)
+        self.ref_targets: Dict[str, tuple] = {}
+        self.gets: List[GetSite] = []
+        # (call node, target tuple) for every ref-producing .remote()
+        self.remote_calls: List[Tuple[ast.Call, tuple]] = []
+        self.nested_defs: List[ast.AST] = []
+        # var -> last simple-assignment value expr (RT203 provenance)
+        self.local_assigns: Dict[str, ast.expr] = {}
+
+
+class ProgramIndex:
+    """Symbol table + remote-surface facts for a set of modules."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._facts: Dict[str, FunctionFacts] = {}
+
+    # -- construction ----------------------------------------------------
+
+    def add_module(
+        self, name: str, path: str, source: str, tree: ast.AST
+    ) -> ModuleInfo:
+        is_package = path.replace(os.sep, "/").endswith("/__init__.py")
+        mod = ModuleInfo(name, path, source, tree, is_package)
+        self.modules[name] = mod
+        return mod
+
+    def finalize(self) -> None:
+        """Register every top-level class/function after all modules are
+        added, so cross-module resolution sees the full table."""
+        for mname in sorted(self.modules):
+            mod = self.modules[mname]
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.ClassDef):
+                    cls = ClassInfo(f"{mname}.{stmt.name}", mod, stmt)
+                    self.classes[cls.qualname] = cls
+                    for item in stmt.body:
+                        if isinstance(
+                            item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            fi = FunctionInfo(
+                                f"{cls.qualname}.{item.name}",
+                                mod, item, owner=cls,
+                            )
+                            cls.methods[item.name] = fi
+                            self.functions[fi.qualname] = fi
+                elif isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    fi = FunctionInfo(f"{mname}.{stmt.name}", mod, stmt)
+                    self.functions[fi.qualname] = fi
+
+    # -- resolution ------------------------------------------------------
+
+    def canonical(self, dotted: Optional[str]) -> Optional[str]:
+        """Chase re-exports (``from impl import Worker`` in a package
+        ``__init__``) up to four hops to the defining module's name."""
+        if dotted is None:
+            return None
+        for _hop in range(4):
+            if dotted in self.classes or dotted in self.functions:
+                return dotted
+            parts = dotted.split(".")
+            rewritten = False
+            for i in range(len(parts) - 1, 0, -1):
+                prefix = ".".join(parts[:i])
+                mod = self.modules.get(prefix)
+                if mod is None:
+                    continue
+                alias = mod.imports.aliases.get(parts[i])
+                if alias is not None:
+                    if alias.startswith("."):
+                        alias = mod.resolve_relative(alias)
+                    dotted = ".".join([alias] + parts[i + 1:])
+                    rewritten = True
+                break
+            if not rewritten:
+                return dotted
+        return dotted
+
+    def resolve_name(
+        self, module: ModuleInfo, node: ast.AST
+    ) -> Optional[str]:
+        return self.canonical(module.resolve(node))
+
+    def class_from_string(
+        self, module: ModuleInfo, s: str
+    ) -> Optional[ClassInfo]:
+        s = s.strip()
+        if not s or not all(p.isidentifier() for p in s.split(".")):
+            return None
+        if "." not in s:
+            local = self.classes.get(f"{module.name}.{s}")
+            if local is not None:
+                return local
+        else:
+            # already fully qualified ("pkg.b.Beta" in a string ann)
+            direct = self.classes.get(self.canonical(s))
+            if direct is not None:
+                return direct
+        head, _, rest = s.partition(".")
+        base = module.imports.aliases.get(head)
+        if base is None:
+            dotted = f"{module.name}.{s}"
+        else:
+            if base.startswith("."):
+                base = module.resolve_relative(base)
+            dotted = f"{base}.{rest}" if rest else base
+        return self.classes.get(self.canonical(dotted))
+
+    def class_from_annotation(
+        self, module: ModuleInfo, ann: Optional[ast.AST]
+    ) -> Optional[ClassInfo]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return self.class_from_string(module, ann.value)
+        if isinstance(ann, ast.Subscript):
+            base = module.resolve(ann.value)
+            if base in ("typing.Optional", "Optional", "typing.Union"):
+                sl = ann.slice
+                elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+                for e in elts:
+                    cls = self.class_from_annotation(module, e)
+                    if cls is not None:
+                        return cls
+            return None
+        dotted = self.resolve_name(module, ann)
+        return self.classes.get(dotted) if dotted else None
+
+    # -- handle / ref typing ---------------------------------------------
+
+    def param_types(self, fn: FunctionInfo) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        a = fn.node.args
+        for arg in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            cls = self.class_from_annotation(fn.module, arg.annotation)
+            if cls is not None:
+                out[arg.arg] = cls.qualname
+        return out
+
+    def attr_types(self, cls: ClassInfo) -> Dict[str, str]:
+        """``self.<attr>`` -> actor class qualname, gathered across all
+        methods from ``self.x = <annotated param>``, ``self.x =
+        Cls.remote(...)``, and annotated ``self.x: Cls`` assigns."""
+        if cls._attr_types is not None:
+            return cls._attr_types
+        cls._attr_types = out = {}
+        for mname in sorted(cls.methods):
+            meth = cls.methods[mname]
+            params = self.param_types(meth)
+            for node in ast.walk(meth.node):
+                target = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    t = node.target
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        c = self.class_from_annotation(
+                            cls.module, node.annotation
+                        )
+                        if c is not None:
+                            out.setdefault(t.attr, c.qualname)
+                    continue
+                else:
+                    continue
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                if isinstance(value, ast.Name) and value.id in params:
+                    out.setdefault(target.attr, params[value.id])
+                else:
+                    t2 = self.remote_target(cls.module, value, None, cls)
+                    if t2 is not None and t2[0] == "handle":
+                        out.setdefault(target.attr, t2[1])
+        return out
+
+    def receiver_type(
+        self,
+        module: ModuleInfo,
+        expr: ast.AST,
+        env: Optional[Dict[str, str]],
+        cls: Optional[ClassInfo],
+    ) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if env is not None:
+                return env.get(expr.id)
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            return self.attr_types(cls).get(expr.attr)
+        return None
+
+    def remote_target(
+        self,
+        module: ModuleInfo,
+        expr: ast.AST,
+        env: Optional[Dict[str, str]],
+        cls: Optional[ClassInfo],
+    ) -> Optional[tuple]:
+        """Classify a ``....remote(...)`` expression.
+
+        Returns ``('handle', clsqual)`` for actor construction,
+        ``('ref-actor', clsqual, meth)`` for a resolved actor-method
+        submission, ``('ref-task', fnqual)`` for a remote-function
+        submission, ``('ref-unknown',)`` for an unresolvable submission,
+        or None when ``expr`` is not a remote submission at all."""
+        if not (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "remote"
+        ):
+            return None
+        base = expr.func.value
+        if (
+            isinstance(base, ast.Call)
+            and isinstance(base.func, ast.Attribute)
+            and base.func.attr == "options"
+        ):
+            base = base.func.value
+        dotted = self.resolve_name(module, base)
+        if dotted is not None:
+            if dotted in self.classes:
+                return ("handle", dotted)
+            if dotted in self.functions:
+                return ("ref-task", dotted)
+        if isinstance(base, ast.Attribute):
+            recv = self.receiver_type(module, base.value, env, cls)
+            if recv is not None:
+                return ("ref-actor", recv, base.attr)
+            return ("ref-unknown",)
+        if isinstance(base, ast.Name) and env is not None:
+            recv = env.get(base.id)
+            if recv is not None:
+                # a bare handle called .remote() — actor __call__;
+                # treat as a submission into that actor
+                return ("ref-actor", recv, "__call__")
+        return ("ref-unknown",)
+
+    def _is_blocking_get(
+        self, module: ModuleInfo, call: ast.Call
+    ) -> bool:
+        resolved = self.resolve_name(module, call.func)
+        if resolved in _BLOCKING_GET:
+            return True
+        return (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("get", "wait")
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id in _RUNTIME_RECEIVERS
+        )
+
+    # -- per-function facts ----------------------------------------------
+
+    def facts(self, fn: FunctionInfo) -> FunctionFacts:
+        cached = self._facts.get(fn.qualname)
+        if cached is not None:
+            return cached
+        f = FunctionFacts()
+        module, cls = fn.module, fn.owner
+        f.env.update(self.param_types(fn))
+        for node in iter_nodes_skip_nested(fn.node.body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f.nested_defs.append(node)
+                continue
+            if isinstance(node, ast.ClassDef):
+                continue
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                name, value = node.targets[0].id, node.value
+                f.local_assigns[name] = value
+                target = self.remote_target(module, value, f.env, cls)
+                if target is not None:
+                    if target[0] == "handle":
+                        f.env[name] = target[1]
+                    else:
+                        f.ref_targets[name] = target
+                elif isinstance(value, ast.Name):
+                    if value.id in f.env:
+                        f.env[name] = f.env[value.id]
+                    if value.id in f.ref_targets:
+                        f.ref_targets[name] = f.ref_targets[value.id]
+                elif (
+                    isinstance(value, ast.Attribute)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id == "self"
+                    and cls is not None
+                ):
+                    at = self.attr_types(cls).get(value.attr)
+                    if at is not None:
+                        f.env[name] = at
+                else:
+                    ct = self.container_ref_target(module, value, f.env, cls)
+                    if ct is not None:
+                        f.ref_targets[name] = ct
+            elif isinstance(node, ast.Call):
+                if self._is_blocking_get(module, node):
+                    f.gets.append(
+                        GetSite(node, has_bounded_timeout(node))
+                    )
+                else:
+                    target = self.remote_target(module, node, f.env, cls)
+                    if target is not None and target[0] != "handle":
+                        f.remote_calls.append((node, target))
+        self._facts[fn.qualname] = f
+        return f
+
+    def container_ref_target(
+        self,
+        module: ModuleInfo,
+        expr: ast.AST,
+        env: Optional[Dict[str, str]],
+        cls: Optional[ClassInfo],
+    ) -> Optional[tuple]:
+        """First ref target produced anywhere inside a container
+        expression (list/dict/set literal or comprehension) — used to
+        give ``refs = [h.m.remote() for ...]`` a ref provenance."""
+        if not isinstance(
+            expr,
+            (ast.List, ast.Tuple, ast.Set, ast.Dict,
+             ast.ListComp, ast.SetComp, ast.DictComp,
+             ast.GeneratorExp),
+        ):
+            return None
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                target = self.remote_target(module, sub, env, cls)
+                if target is not None and target[0] != "handle":
+                    return target
+        return None
+
+    def is_ref_expr(
+        self,
+        module: ModuleInfo,
+        expr: ast.AST,
+        facts: FunctionFacts,
+        cls: Optional[ClassInfo],
+    ) -> bool:
+        """Does this expression produce (or contain) an ObjectRef?"""
+        if isinstance(expr, ast.Name):
+            return expr.id in facts.ref_targets
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                target = self.remote_target(module, sub, facts.env, cls)
+                if target is not None and target[0] != "handle":
+                    return True
+            elif (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id in facts.ref_targets
+            ):
+                return True
+        return False
+
+
+def build_index(
+    entries: Sequence[Tuple[str, str, str, ast.AST]]
+) -> ProgramIndex:
+    """entries: (finding_path, module_name, source, tree)."""
+    index = ProgramIndex()
+    for path, modname, source, tree in entries:
+        index.add_module(modname, path, source, tree)
+    index.finalize()
+    return index

@@ -1,0 +1,247 @@
+"""RT202 objectref-leak: refs stored into long-lived containers that no
+reachable code ever drains.
+
+Every live ObjectRef pins its object in the shm arena (and its lineage
+in the GCS).  A ref appended into ``self._pending`` that no method of
+the class ever gets / waits / pops / clears / returns is permanently
+pinned — arena capacity shrinks monotonically until puts start
+spilling, which surfaces hours later as a throughput cliff on an
+unrelated workload.
+
+Store sites recognized: mutator calls (``self.x.append(ref)``,
+``.add``, ``.extend``, ``.insert``, ``.setdefault``, ``.update``),
+subscript stores (``self.x[k] = ref``), and whole-container assigns
+whose value contains ref-producing ``.remote()`` calls.  Actor *handle*
+pools are exempt — handles are legitimately long-lived.
+
+A stored attribute counts as consumed if ANY non-store load of the same
+attribute name exists anywhere in the indexed program (drain loops,
+``ray_tpu.get(self.x)``, ``.pop()``, iteration, returns, ``len``...).
+That program-wide check is deliberately conservative: rebinding through
+another alias still suppresses the finding, so the rule only fires on
+attributes that are write-only everywhere.  Module-level globals get
+the same treatment with module-local name loads.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.flow.engine import FlowRule
+from ray_tpu.devtools.flow.index import ProgramIndex
+
+_MUTATORS = {
+    "append", "add", "appendleft", "extend", "insert", "setdefault",
+    "update",
+}
+
+_CONTAINER_LITERALS = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+    ast.SetComp,
+)
+
+
+def _self_attr(expr: ast.AST) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _collect_store_receivers(tree: ast.AST) -> Set[int]:
+    """ids of Attribute/Name nodes that are *receivers of a store
+    shape* (mutator-call receiver, subscript-assign base) — such loads
+    must not count as consumption."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+        ):
+            out.add(id(node.func.value))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    out.add(id(t.value))
+    return out
+
+
+def _consumed_names(index: ProgramIndex) -> Tuple[Set[str], Dict[str, Set[str]]]:
+    """(attr names loaded outside store shapes anywhere in the program,
+    module name -> plain names loaded outside store shapes)."""
+    attrs: Set[str] = set()
+    mod_names: Dict[str, Set[str]] = {}
+    for mname in sorted(index.modules):
+        mod = index.modules[mname]
+        skip = _collect_store_receivers(mod.tree)
+        loads: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                attrs.add(node.attr)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                loads.add(node.id)
+        mod_names[mname] = loads
+    return attrs, mod_names
+
+
+class ObjectRefLeak(FlowRule):
+    id = "RT202"
+    name = "objectref-leak"
+    description = (
+        "ObjectRef stored into a container/attribute that nothing ever "
+        "drains — pins shm arena capacity forever"
+    )
+    hint = (
+        "drain the container somewhere (get/wait then pop/clear), or "
+        "don't retain the ref at all"
+    )
+
+    def check(self, index: ProgramIndex) -> None:
+        consumed_attrs, consumed_mod_names = _consumed_names(index)
+
+        for cq in sorted(index.classes):
+            cls = index.classes[cq]
+            for store_attr, node, detail in self._class_stores(index, cls):
+                if store_attr in consumed_attrs:
+                    continue
+                self.add(
+                    cls.module, node,
+                    message=(
+                        f"objectref-leak: {detail} into "
+                        f"`self.{store_attr}` but no code ever reads or "
+                        f"drains `.{store_attr}` — every stored ref "
+                        f"stays pinned in the shm arena"
+                    ),
+                )
+
+        for mname in sorted(index.modules):
+            mod = index.modules[mname]
+            container_globals = {
+                name for name, value in mod.top_assigns.items()
+                if isinstance(value, _CONTAINER_LITERALS)
+                or (
+                    isinstance(value, ast.Call)
+                    and mod.resolve(value.func) in (
+                        "dict", "list", "set", "collections.deque",
+                        "collections.defaultdict",
+                        "collections.OrderedDict",
+                    )
+                )
+            }
+            if not container_globals:
+                continue
+            loads = consumed_mod_names[mname]
+            for name, node, detail in self._global_stores(
+                index, mod, container_globals
+            ):
+                # the global name read anywhere in this module (outside
+                # store shapes), or accessed as `mod.<name>` elsewhere
+                if name in loads or name in consumed_attrs:
+                    continue
+                self.add(
+                    mod, node,
+                    message=(
+                        f"objectref-leak: {detail} into module global "
+                        f"`{name}` but nothing ever reads or drains it "
+                        f"— every stored ref stays pinned in the shm "
+                        f"arena"
+                    ),
+                )
+
+    # -- store-site discovery --------------------------------------------
+
+    def _class_stores(self, index: ProgramIndex, cls):
+        """Yields (attr, node, detail) ref-store sites across methods."""
+        for mname in sorted(cls.methods):
+            fn = cls.methods[mname]
+            facts = index.facts(fn)
+            for node, attr, value in self._stores_in(
+                fn.node, lambda e: _self_attr(e)
+            ):
+                if self._stored_value_is_ref(index, fn, facts, value):
+                    yield attr, node, self._detail(node)
+
+    def _global_stores(self, index: ProgramIndex, mod, names):
+        def global_name(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Name) and expr.id in names:
+                return expr.id
+            return None
+
+        for stmt in mod.tree.body:
+            if not isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            owner = None
+            fns: List = []
+            if isinstance(stmt, ast.ClassDef):
+                qual = f"{mod.name}.{stmt.name}"
+                owner = index.classes.get(qual)
+                if owner is not None:
+                    fns = [
+                        owner.methods[m] for m in sorted(owner.methods)
+                    ]
+            else:
+                fn = index.functions.get(f"{mod.name}.{stmt.name}")
+                if fn is not None:
+                    fns = [fn]
+            for fn in fns:
+                facts = index.facts(fn)
+                for node, name, value in self._stores_in(
+                    fn.node, global_name
+                ):
+                    if self._stored_value_is_ref(index, fn, facts, value):
+                        yield name, node, self._detail(node)
+
+    def _stores_in(self, fn_node, key_of):
+        """(node, key, stored-value-expr) for every store shape whose
+        receiver matches ``key_of`` (self-attr or module-global)."""
+        for node in ast.walk(fn_node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+            ):
+                key = key_of(node.func.value)
+                if key is not None and node.args:
+                    for arg in node.args:
+                        yield node, key, arg
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        key = key_of(t.value)
+                        if key is not None:
+                            yield node, key, node.value
+                            # dict stores can hold the ref as the KEY
+                            # (ref -> metadata maps)
+                            yield node, key, t.slice
+                    else:
+                        key = key_of(t)
+                        if key is not None and isinstance(
+                            node.value, _CONTAINER_LITERALS
+                        ):
+                            yield node, key, node.value
+
+    def _stored_value_is_ref(self, index, fn, facts, value) -> bool:
+        return index.is_ref_expr(fn.module, value, facts, fn.owner)
+
+    @staticmethod
+    def _detail(node: ast.AST) -> str:
+        if isinstance(node, ast.Call):
+            return "`.remote()` ref appended"
+        return "`.remote()` ref stored"

@@ -6,10 +6,19 @@ this codebase has actually shipped (event-loop blocking, non-atomic
 persists, impure traced functions, ...).  Findings carry ``file:line``,
 a stable rule id, and a fix hint.
 
+Two tiers share this CLI: the per-file rules below (RT1xx), and the
+whole-program ``rtflow`` tier (RT2xx, ``ray_tpu.devtools.flow``) which
+indexes the full package into a call graph and runs interprocedural
+rules (actor deadlock cycles, ObjectRef leaks, unserializable captures,
+rank-divergent collectives).  ``--flow`` runs both.
+
 CLI::
 
     python -m ray_tpu.devtools.lint ray_tpu            # text report
+    python -m ray_tpu.devtools.lint --flow ray_tpu     # + RT2xx tier
     python -m ray_tpu.devtools.lint ray_tpu --format json
+    python -m ray_tpu.devtools.lint ray_tpu --format sarif  # CI annotations
+    python -m ray_tpu.devtools.lint --flow ray_tpu --changed-only
     python -m ray_tpu.devtools.lint --list-rules
     python -m ray_tpu.devtools.lint ray_tpu --write-baseline
 
@@ -270,12 +279,19 @@ class LintReport:
 
 
 def lint_paths(
-    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    file_filter: Optional[set] = None,
 ) -> LintReport:
+    """``file_filter``, when given, is a set of ABSOLUTE paths to keep
+    (the ``--changed-only`` edit-loop mode); other files are skipped
+    entirely."""
     selected = _select_rules(rules)
     findings: List[Finding] = []
     errors: List[str] = []
     files = iter_py_files(paths)
+    if file_filter is not None:
+        files = [f for f in files if os.path.abspath(f) in file_filter]
     for fpath in files:
         # Canonicalize to a cwd-relative path when the file is under the
         # cwd: `lint ray_tpu` (CLI) and `lint_paths([/abs/pkg])` (the
@@ -365,6 +381,43 @@ def write_baseline(findings: List[Finding], path: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+def git_changed_files() -> Optional[set]:
+    """Absolute paths of .py files that are dirty (``git diff
+    --name-only HEAD``) or untracked (``git ls-files --others
+    --exclude-standard`` — a brand-new module is the MOST important
+    file in the edit loop), or None when git (or a repo) is
+    unavailable — callers fall back to the whole package so the mode
+    degrades safely."""
+    import subprocess
+
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=10,
+        )
+        if top.returncode != 0:
+            return None
+        root = top.stdout.strip()
+        out: set = set()
+        for cmd in (
+            ["git", "diff", "--name-only", "HEAD"],
+            ["git", "ls-files", "--others", "--exclude-standard"],
+        ):
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=10,
+            )
+            if proc.returncode != 0:
+                return None
+            out.update(
+                os.path.abspath(os.path.join(root, line.strip()))
+                for line in proc.stdout.splitlines()
+                if line.strip().endswith(".py")
+            )
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu.devtools.lint",
@@ -372,23 +425,42 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     parser.add_argument("paths", nargs="*", default=None,
                         help="files or directories (default: ray_tpu)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run")
+    parser.add_argument("--flow", action="store_true",
+                        help="also run the whole-program rtflow tier "
+                             "(RT2xx interprocedural rules)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report only on files dirty per `git diff "
+                             "--name-only HEAD` (flow still indexes the "
+                             "whole tree for cross-module edges); falls "
+                             "back to everything when git is unavailable")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
-                        help="baseline JSON path")
+                        help="baseline JSON path (RT1xx tier)")
+    parser.add_argument("--flow-baseline", default=None,
+                        help="baseline JSON path for the flow tier "
+                             "(default: flow/flow_baseline.json)")
     parser.add_argument("--no-baseline", action="store_true",
-                        help="ignore the baseline file")
+                        help="ignore the baseline file(s)")
     parser.add_argument("--write-baseline", action="store_true",
-                        help="regenerate the baseline from this run")
+                        help="regenerate the baseline(s) from this run")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
+
+    flow_mod = None
+    if args.flow or args.list_rules:
+        from ray_tpu.devtools import flow as flow_mod  # lazy: index cost
 
     if args.list_rules:
         for rule in all_rules():
             scope = ", ".join(rule.path_markers) or "all files"
             print(f"{rule.id}  {rule.name}  [{scope}]")
+            print(f"    {rule.description}")
+            print(f"    hint: {rule.hint}")
+        for rule in flow_mod.all_flow_rules():
+            print(f"{rule.id}  {rule.name}  [whole-program, --flow]")
             print(f"    {rule.description}")
             print(f"    hint: {rule.hint}")
         return 0
@@ -404,30 +476,106 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.write_baseline and args.changed_only:
+        print(
+            "rtlint: --write-baseline cannot be combined with "
+            "--changed-only (it would discard findings of unchanged "
+            "files)",
+            file=sys.stderr,
+        )
+        return 2
+
+    file_filter = None
+    if args.changed_only:
+        file_filter = git_changed_files()
+        if file_filter is None:
+            print(
+                "rtlint: --changed-only: git unavailable, scanning "
+                "everything", file=sys.stderr,
+            )
+
+    # partition --rules between the tiers when --flow is active
+    only_file = only
+    only_flow = None
+    if args.flow:
+        flow_ids = set(flow_mod.flow_rule_ids())
+        if only is not None:
+            only_file = [r for r in only if r not in flow_ids]
+            only_flow = [r for r in only if r in flow_ids]
+
+    findings: List[Finding] = []
+    files_scanned = 0
+    parse_errors: List[str] = []
+
+    run_file_tier = only is None or only_file
     try:
-        report = lint_paths(paths, rules=only)
+        if run_file_tier:
+            report = lint_paths(
+                paths, rules=only_file, file_filter=file_filter
+            )
+            findings.extend(report.findings)
+            files_scanned = report.files_scanned
+            parse_errors.extend(report.parse_errors)
+        if args.flow and (only is None or only_flow):
+            flow_report = flow_mod.analyze_paths(paths, rules=only_flow)
+            flow_findings = flow_report.findings
+            if file_filter is not None:
+                # the index stays whole-program (edges need every
+                # module); only the *reporting* narrows to dirty files
+                flow_findings = [
+                    f for f in flow_findings
+                    if os.path.abspath(f.path) in file_filter
+                ]
+            findings.extend(flow_findings)
+            files_scanned = max(files_scanned, flow_report.files_indexed)
+            parse_errors.extend(
+                e for e in flow_report.parse_errors
+                if e not in parse_errors
+            )
     except ValueError as e:
         print(f"rtlint: {e}", file=sys.stderr)
         return 2
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    flow_baseline_path = args.flow_baseline
+    if flow_baseline_path is None and args.flow:
+        flow_baseline_path = flow_mod.DEFAULT_FLOW_BASELINE
 
     if args.write_baseline:
-        write_baseline(report.findings, args.baseline)
-        print(
-            f"rtlint: wrote {len(report.findings)} finding(s) to "
-            f"{args.baseline}"
-        )
+        if args.flow:
+            file_findings = [
+                f for f in findings if not f.rule.startswith("RT2")
+            ]
+            flow_findings = [
+                f for f in findings if f.rule.startswith("RT2")
+            ]
+            write_baseline(file_findings, args.baseline)
+            write_baseline(flow_findings, flow_baseline_path)
+            print(
+                f"rtlint: wrote {len(file_findings)} finding(s) to "
+                f"{args.baseline} and {len(flow_findings)} to "
+                f"{flow_baseline_path}"
+            )
+        else:
+            write_baseline(findings, args.baseline)
+            print(
+                f"rtlint: wrote {len(findings)} finding(s) to "
+                f"{args.baseline}"
+            )
         return 0
 
-    baseline = (
-        Counter() if args.no_baseline else load_baseline(args.baseline)
-    )
-    new, grandfathered = split_baselined(report.findings, baseline)
+    baseline: Counter = Counter()
+    if not args.no_baseline:
+        baseline += load_baseline(args.baseline)
+        if args.flow:
+            baseline += load_baseline(flow_baseline_path)
+    new, grandfathered = split_baselined(findings, baseline)
 
     if args.format == "json":
         print(json.dumps(
             {
-                "files_scanned": report.files_scanned,
-                "parse_errors": report.parse_errors,
+                "files_scanned": files_scanned,
+                "parse_errors": parse_errors,
                 "new_findings": [f.to_dict() for f in new],
                 "baselined_findings": [
                     f.to_dict() for f in grandfathered
@@ -436,17 +584,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             },
             indent=2,
         ))
+    elif args.format == "sarif":
+        from ray_tpu.devtools.sarif import render_sarif
+
+        rules_meta = list(all_rules())
+        if args.flow:
+            rules_meta.extend(flow_mod.all_flow_rules())
+        print(json.dumps(
+            render_sarif(new, grandfathered, rules_meta), indent=2,
+        ))
     else:
         for f in new:
             print(f.render())
         summary = (
-            f"rtlint: {report.files_scanned} files, "
+            f"rtlint: {files_scanned} files, "
             f"{len(new)} new finding(s), "
             f"{len(grandfathered)} baselined"
         )
-        if report.parse_errors:
-            summary += f", {len(report.parse_errors)} unparseable"
-            for e in report.parse_errors:
+        if parse_errors:
+            summary += f", {len(parse_errors)} unparseable"
+            for e in parse_errors:
                 print(f"rtlint: parse error: {e}", file=sys.stderr)
         print(summary)
 

@@ -11,6 +11,11 @@ Rule ids are stable and grouped by family:
 - RT107 swallowed-cancellation     (async_rules)
 - RT108 unlocked-lazy-init         (concurrency)
 - RT109 blocking-collective-in-async (async_rules)
+
+The RT2xx series (actor-deadlock, objectref-leak, unserializable-
+capture, rank-divergent-collective) is the whole-program rtflow tier —
+see ``ray_tpu.devtools.flow``; those rules need the cross-module index
+and are not registered here.
 """
 
 from ray_tpu.devtools.rules.async_rules import (

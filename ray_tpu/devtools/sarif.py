@@ -1,0 +1,98 @@
+"""SARIF 2.1.0 output for rtlint/rtflow findings.
+
+SARIF is the interchange format CI systems (GitHub code scanning,
+Azure, Gitlab) render as inline PR annotations.  One run object carries
+both tiers; baselined findings are included but marked with an
+``external`` suppression so dashboards show them as accepted debt
+instead of new violations.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _rule_entry(rule) -> dict:
+    return {
+        "id": rule.id,
+        "name": rule.name,
+        "shortDescription": {"text": rule.description},
+        "help": {"text": rule.hint},
+        "defaultConfiguration": {"level": "warning"},
+    }
+
+
+def _result(finding, suppressed: bool) -> dict:
+    out = {
+        "ruleId": finding.rule,
+        "level": "warning",
+        "message": {"text": f"{finding.message} (hint: {finding.hint})"},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": finding.line,
+                        "startColumn": finding.col,
+                    },
+                }
+            }
+        ],
+        "partialFingerprints": {
+            "rtlint/v1": finding.fingerprint(),
+        },
+    }
+    if suppressed:
+        out["suppressions"] = [
+            {"kind": "external", "justification": "rtlint baseline"}
+        ]
+    return out
+
+
+def render_sarif(
+    new: Sequence, baselined: Sequence, rules: Iterable
+) -> dict:
+    """Build the SARIF document for one lint invocation.  ``rules`` is
+    every rule object that COULD have fired (both tiers when --flow ran)
+    so rule metadata stays stable across runs."""
+    results: List[dict] = []
+    for f in new:
+        results.append(_result(f, suppressed=False))
+    for f in baselined:
+        results.append(_result(f, suppressed=True))
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "rtlint",
+                        "informationUri": (
+                            "https://github.com/ray_tpu/ray_tpu"
+                        ),
+                        "rules": sorted(
+                            (_rule_entry(r) for r in rules),
+                            key=lambda r: r["id"],
+                        ),
+                    }
+                },
+                "columnKind": "unicodeCodePoints",
+                "originalUriBaseIds": {
+                    "SRCROOT": {"description": {
+                        "text": "lint invocation working directory"
+                    }}
+                },
+                "results": results,
+            }
+        ],
+    }

@@ -208,7 +208,9 @@ class IMPALA(Algorithm):
             # dropped ref is safe: per-caller actor-call ordering runs
             # set_weights BEFORE the sample.remote below on the same
             # runner, and a set_weights failure surfaces through that
-            # tracked sample ref
+            # tracked sample ref (rtflow RT202 audit: the sample refs
+            # stored in self._inflight are all drained by the
+            # wait/pop/get loop above and cleared in stop())
             # rtlint: disable-next=RT105
             runner.set_weights.remote(self._ray.put(self.learner.params))
             self._inflight[

@@ -94,7 +94,9 @@ class TuneController:
         )
         # dropped ref is safe: the run loop tracks this trial through
         # next_report refs on the same actor — a failed start kills the
-        # actor and surfaces as an errored report there
+        # actor and surfaces as an errored report there (rtflow RT202
+        # audit: next_report refs live in the local `outstanding` dict
+        # and every path pops them before re-arming)
         # rtlint: disable-next=RT105
         trial.actor.start_training.remote(
             self.trainable, trial.config, ctx, from_checkpoint

@@ -39,6 +39,14 @@ except AttributeError:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running scale/stress tests excluded from the "
+        "tier-1 `-m 'not slow'` run",
+    )
+
+
 @pytest.fixture
 def rt_start_regular():
     """Fresh single-node cluster for a test (ray: conftest.py ray_start_regular:419)."""

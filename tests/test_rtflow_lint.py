@@ -1,0 +1,689 @@
+"""rtflow (RT2xx): per-rule fixture pairs + the whole-package gate.
+
+Same contract as tests/test_lint.py one tier up: every interprocedural
+rule must flag its positive fixture and stay silent on the compliant
+twin, cross-module resolution is pinned explicitly (the whole point of
+the flow tier), and the final gate runs the real analysis over the
+installed package so the tree stays clean going forward.
+"""
+
+import json
+import os
+
+import pytest
+
+from ray_tpu.devtools.flow import (
+    DEFAULT_FLOW_BASELINE,
+    analyze_paths,
+    analyze_sources,
+    flow_rule_ids,
+)
+from ray_tpu.devtools.lint import load_baseline, split_baselined
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+
+
+def flow_ids(files, rules=None):
+    return [f.rule for f in analyze_sources(files, rules=rules)]
+
+
+# ---------------------------------------------------------------------------
+# RT201 actor-deadlock
+# ---------------------------------------------------------------------------
+
+
+class TestActorDeadlock:
+    def test_flags_two_actor_cycle(self):
+        files = {"pkg/ab.py": '''
+import ray_tpu
+
+@ray_tpu.remote
+class Ping:
+    def set_peer(self, peer: "Pong"):
+        self._pong = peer
+
+    def ping(self):
+        return ray_tpu.get(self._pong.pong.remote())
+
+@ray_tpu.remote
+class Pong:
+    def set_peer(self, peer: Ping):
+        self._ping = peer
+
+    def pong(self):
+        return ray_tpu.get(self._ping.ping.remote())
+'''}
+        assert flow_ids(files, rules=["RT201"]) == ["RT201", "RT201"]
+
+    def test_flags_self_deadlock_via_local_ref_variable(self):
+        # the ref flows through a local before the blocking get
+        files = {"pkg/selfie.py": '''
+import ray_tpu
+
+@ray_tpu.remote
+class Selfie:
+    def set_self(self, me: "Selfie"):
+        self._me = me
+
+    def outer(self):
+        ref = self._me.inner.remote()
+        return ray_tpu.get(ref)
+
+    def inner(self):
+        return 1
+'''}
+        assert flow_ids(files, rules=["RT201"]) == ["RT201"]
+
+    def test_flags_cross_module_cycle(self):
+        # the cycle is only visible with both modules indexed — the
+        # per-file tier can never see this
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/a.py": '''
+import ray_tpu
+
+@ray_tpu.remote
+class Alpha:
+    def set_peer(self, peer: "pkg.b.Beta"):
+        self._b = peer
+
+    def go(self):
+        return ray_tpu.get(self._b.back.remote())
+''',
+            "pkg/b.py": '''
+import ray_tpu
+
+from pkg.a import Alpha
+
+@ray_tpu.remote
+class Beta:
+    def set_peer(self, peer: Alpha):
+        self._a = peer
+
+    def back(self):
+        return ray_tpu.get(self._a.go.remote())
+''',
+        }
+        found = analyze_sources(files, rules=["RT201"])
+        assert [f.rule for f in found] == ["RT201", "RT201"]
+        assert {f.path for f in found} == {"pkg/a.py", "pkg/b.py"}
+
+    def test_silent_on_acyclic_chain_and_driver_gets(self):
+        files = {"pkg/chain.py": '''
+import ray_tpu
+
+@ray_tpu.remote
+class Worker:
+    def work(self):
+        return 1
+
+@ray_tpu.remote
+class Boss:
+    def set_w(self, w: Worker):
+        self._w = w
+
+    def run(self):
+        return ray_tpu.get(self._w.work.remote())
+
+def driver(boss: Boss):
+    # drivers are not actors: blocking here cannot freeze a mailbox
+    return ray_tpu.get(boss.run.remote())
+'''}
+        assert flow_ids(files, rules=["RT201"]) == []
+
+    def test_silent_with_bounded_timeout(self):
+        # same contract as RT104: an explicit finite timeout degrades
+        # the deadlock to latency (the supervision pattern)
+        files = {"pkg/sup.py": '''
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def set_peer(self, peer: "B"):
+        self._b = peer
+
+    def probe(self):
+        return ray_tpu.get(self._b.probe.remote(), timeout=5.0)
+
+@ray_tpu.remote
+class B:
+    def set_peer(self, peer: A):
+        self._a = peer
+
+    def probe(self):
+        return ray_tpu.get(self._a.probe.remote(), timeout=5.0)
+'''}
+        assert flow_ids(files, rules=["RT201"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT202 objectref-leak
+# ---------------------------------------------------------------------------
+
+
+class TestObjectRefLeak:
+    LEAK = '''
+import ray_tpu
+
+@ray_tpu.remote
+class Worker:
+    def step(self):
+        return 1
+
+class Driver:
+    def __init__(self, w: Worker):
+        self._w = w
+        self._pending = []
+
+    def kick(self):
+        self._pending.append(self._w.step.remote())
+'''
+
+    def test_flags_append_only_attribute(self):
+        assert flow_ids(
+            {"pkg/leak.py": self.LEAK}, rules=["RT202"]
+        ) == ["RT202"]
+
+    def test_flags_ref_keyed_dict_store(self):
+        files = {"pkg/leakmap.py": '''
+import ray_tpu
+
+@ray_tpu.remote
+class Worker:
+    def step(self):
+        return 1
+
+class Tracker:
+    def __init__(self, w: Worker):
+        self._w = w
+        self._launched = {}
+
+    def kick(self, tag):
+        self._launched[self._w.step.remote()] = tag
+'''}
+        assert flow_ids(files, rules=["RT202"]) == ["RT202"]
+
+    def test_silent_when_any_method_drains(self):
+        drained = self.LEAK + '''
+    def drain(self):
+        out = ray_tpu.get(self._pending)
+        self._pending.clear()
+        return out
+'''
+        assert flow_ids({"pkg/ok.py": drained}, rules=["RT202"]) == []
+
+    def test_silent_when_drained_from_another_module(self):
+        # consumption is a whole-program property
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/store.py": self.LEAK,
+            "pkg/drain.py": '''
+import ray_tpu
+
+def flush(driver):
+    refs = driver._pending
+    driver._pending = []
+    return ray_tpu.get(refs)
+''',
+        }
+        assert flow_ids(files, rules=["RT202"]) == []
+
+    def test_silent_on_actor_handle_pools(self):
+        # handles are legitimately long-lived; only refs pin the arena
+        files = {"pkg/pool.py": '''
+import ray_tpu
+
+@ray_tpu.remote
+class Worker:
+    def step(self):
+        return 1
+
+class Pool:
+    def __init__(self):
+        self._actors = []
+
+    def grow(self):
+        self._actors.append(Worker.remote())
+'''}
+        assert flow_ids(files, rules=["RT202"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT203 unserializable-capture
+# ---------------------------------------------------------------------------
+
+
+class TestUnserializableCapture:
+    def test_flags_module_global_lock_capture(self):
+        files = {"pkg/cap.py": '''
+import threading
+
+import ray_tpu
+
+_LK = threading.Lock()
+
+@ray_tpu.remote
+def task(x):
+    with _LK:
+        return x + 1
+'''}
+        assert flow_ids(files, rules=["RT203"]) == ["RT203"]
+
+    def test_flags_nested_closure_and_remote_arg(self):
+        files = {"pkg/cap2.py": '''
+import threading
+
+import ray_tpu
+
+@ray_tpu.remote
+def helper(lk):
+    return lk
+
+def driver():
+    lock = threading.Lock()
+
+    @ray_tpu.remote
+    def inner(x):
+        with lock:
+            return x
+
+    ref = helper.remote(lock)
+    return ray_tpu.get([ref, inner.remote(1)])
+'''}
+        assert flow_ids(files, rules=["RT203"]) == ["RT203", "RT203"]
+
+    def test_flags_captured_jax_array(self):
+        files = {"pkg/cap3.py": '''
+import jax.numpy as jnp
+
+import ray_tpu
+
+_WEIGHTS = jnp.zeros((4, 4))
+
+@ray_tpu.remote
+def apply(x):
+    return x @ _WEIGHTS
+'''}
+        assert flow_ids(files, rules=["RT203"]) == ["RT203"]
+
+    def test_silent_on_scalars_and_locally_built_resources(self):
+        files = {"pkg/ok.py": '''
+import threading
+
+import ray_tpu
+
+_LIMIT = 8
+
+@ray_tpu.remote
+def task(x):
+    lk = threading.Lock()  # worker-local: constructed on the worker
+    with lk:
+        return x + _LIMIT
+'''}
+        assert flow_ids(files, rules=["RT203"]) == []
+
+    def test_silent_on_jax_array_as_remote_argument(self):
+        # passing an array as an ARG is the supported path (object
+        # store serialization); only closure capture pins the buffer
+        files = {"pkg/ok2.py": '''
+import jax.numpy as jnp
+
+import ray_tpu
+
+@ray_tpu.remote
+def consume(arr):
+    return arr.sum()
+
+def driver():
+    batch = jnp.ones((8,))
+    return ray_tpu.get(consume.remote(batch))
+'''}
+        assert flow_ids(files, rules=["RT203"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT204 rank-divergent-collective
+# ---------------------------------------------------------------------------
+
+
+class TestRankDivergentCollective:
+    def test_flags_rank_guarded_allreduce_without_else(self):
+        files = {"pkg/col.py": '''
+from ray_tpu.util import collective as col
+
+def step(x, rank):
+    if rank == 0:
+        col.allreduce(x, group_name="g")
+    return x
+'''}
+        assert flow_ids(files, rules=["RT204"]) == ["RT204"]
+
+    def test_flags_divergence_through_cross_module_helper(self):
+        files = {
+            "pkg/__init__.py": "",
+            "pkg/metrics.py": '''
+from ray_tpu.util import collective as col
+
+def report(stats):
+    return col.allreduce(stats, group_name="g")
+''',
+            "pkg/train.py": '''
+from pkg.metrics import report
+
+def tick(stats, rank):
+    if rank == 0:
+        report(stats)
+    return stats
+''',
+        }
+        found = analyze_sources(files, rules=["RT204"])
+        assert [f.rule for f in found] == ["RT204"]
+        assert found[0].path == "pkg/train.py"
+
+    def test_flags_async_twin_divergence(self):
+        # the *_async twins participate in the same ring schedule
+        files = {"pkg/col2.py": '''
+from ray_tpu.util import collective as col
+
+async def step(x, rank):
+    if rank == 0:
+        await col.allreduce_async(x, group_name="g")
+    return x
+'''}
+        assert flow_ids(files, rules=["RT204"]) == ["RT204"]
+
+    def test_silent_when_both_branches_match(self):
+        files = {"pkg/ok.py": '''
+from ray_tpu.util import collective as col
+
+def step(x, rank):
+    if rank == 0:
+        out = col.broadcast(x, src_rank=0, group_name="g")
+    else:
+        out = col.broadcast(None, src_rank=0, group_name="g")
+    return out
+'''}
+        assert flow_ids(files, rules=["RT204"]) == []
+
+    def test_silent_on_point_to_point_divergence(self):
+        # send/recv are rank-divergent BY DESIGN (the PS pattern)
+        files = {"pkg/ps.py": '''
+from ray_tpu.util import collective as col
+
+def exchange(x, rank):
+    if rank == 0:
+        col.recv(x, 1)
+    else:
+        col.send(x, 0)
+    return x
+'''}
+        assert flow_ids(files, rules=["RT204"]) == []
+
+    def test_flags_divergence_behind_nested_non_rank_conditional(self):
+        # rank 0 conditionally barriers, other ranks never do: still a
+        # hang whenever debug=True — the inner data-dependent `if` must
+        # not shield the rank comparison
+        files = {"pkg/nested.py": '''
+from ray_tpu.util import collective as col
+
+def step(x, rank, debug):
+    if rank == 0:
+        if debug:
+            col.barrier(group_name="g")
+    return x
+'''}
+        assert flow_ids(files, rules=["RT204"]) == ["RT204"]
+
+    def test_nested_rank_conditional_reports_once_at_its_own_level(self):
+        files = {"pkg/nested2.py": '''
+from ray_tpu.util import collective as col
+
+def step(x, rank, local_rank):
+    if rank < 4:
+        if local_rank == 0:
+            col.barrier(group_name="g")
+    return x
+'''}
+        found = analyze_sources(files, rules=["RT204"])
+        assert [f.rule for f in found] == ["RT204"]
+        assert found[0].line == 6  # the INNER rank conditional
+
+    def test_silent_on_symmetric_data_dependent_branches(self):
+        # both ranks run the same data-dependent structure: uniform
+        files = {"pkg/sym.py": '''
+from ray_tpu.util import collective as col
+
+def step(x, rank, debug):
+    if rank == 0:
+        if debug:
+            col.barrier(group_name="g")
+    else:
+        if debug:
+            col.barrier(group_name="g")
+    return x
+'''}
+        assert flow_ids(files, rules=["RT204"]) == []
+
+    def test_silent_on_uniform_helper_in_both_branches(self):
+        files = {"pkg/ok2.py": '''
+from ray_tpu.util import collective as col
+
+def _sync(x):
+    return col.allreduce(x, group_name="g")
+
+def step(x, rank):
+    if rank == 0:
+        out = _sync(x)
+    else:
+        out = _sync(x)
+    return out
+'''}
+        assert flow_ids(files, rules=["RT204"]) == []
+
+
+# ---------------------------------------------------------------------------
+# Framework: suppressions, determinism, CLI (flow/sarif/changed-only)
+# ---------------------------------------------------------------------------
+
+
+DEADLOCK_SRC = '''
+import ray_tpu
+
+@ray_tpu.remote
+class Selfie:
+    def set_self(self, me: "Selfie"):
+        self._me = me
+
+    def outer(self):
+        return ray_tpu.get(self._me.inner.remote())
+
+    def inner(self):
+        return 1
+'''
+
+
+class TestFlowFramework:
+    def test_suppressions_apply_to_flow_findings(self):
+        suppressed = DEADLOCK_SRC.replace(
+            "        return ray_tpu.get(self._me.inner.remote())",
+            "        # rtlint: disable-next=RT201\n"
+            "        return ray_tpu.get(self._me.inner.remote())",
+        )
+        assert flow_ids({"pkg/s.py": suppressed}) == []
+
+    def test_unknown_flow_rule_id_raises(self):
+        with pytest.raises(ValueError):
+            analyze_sources({"pkg/x.py": "x = 1"}, rules=["RT299"])
+
+    def test_fingerprints_deterministic_across_runs(self):
+        files = {"pkg/d.py": DEADLOCK_SRC}
+        first = [f.fingerprint() for f in analyze_sources(files)]
+        second = [f.fingerprint() for f in analyze_sources(files)]
+        assert first and first == second
+
+    def _write_pkg(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "dead.py").write_text(DEADLOCK_SRC)
+        return pkg
+
+    def test_cli_flow_flag_reports_rt2xx(self, tmp_path, capsys, monkeypatch):
+        from ray_tpu.devtools.lint import main
+
+        monkeypatch.chdir(tmp_path)
+        pkg = self._write_pkg(tmp_path)
+        rc = main(["--flow", str(pkg), "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "RT201" in out
+        # without --flow only the per-file tier runs (the same get site
+        # is also an RT104, but the deadlock CYCLE needs the flow tier)
+        rc = main([str(pkg), "--no-baseline"])
+        out = capsys.readouterr().out
+        assert "RT201" not in out
+
+    def test_cli_sarif_output_is_valid_and_carries_rules(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        from ray_tpu.devtools.lint import main
+
+        monkeypatch.chdir(tmp_path)
+        pkg = self._write_pkg(tmp_path)
+        rc = main([
+            "--flow", str(pkg), "--no-baseline", "--format", "sarif",
+        ])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert set(flow_rule_ids()) <= rule_ids
+        results = [
+            r for r in run["results"] if r["ruleId"] == "RT201"
+        ]
+        assert results
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("dead.py")
+        assert loc["region"]["startLine"] > 1
+        assert "rtlint/v1" in results[0]["partialFingerprints"]
+
+    def test_cli_changed_only_filters_to_dirty_files(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        import subprocess
+
+        from ray_tpu.devtools.lint import main
+
+        monkeypatch.chdir(tmp_path)
+        pkg = self._write_pkg(tmp_path)
+        clean = tmp_path / "pkg" / "clean.py"
+        clean.write_text("import time\n\nasync def h():\n    time.sleep(1)\n")
+        try:
+            subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True,
+                           timeout=30)
+            subprocess.run(["git", "add", "."], cwd=tmp_path, check=True,
+                           timeout=30)
+            subprocess.run(
+                ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                 "commit", "-qm", "seed"],
+                cwd=tmp_path, check=True, timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError):
+            pytest.skip("git unavailable")
+        # nothing dirty: both tiers report clean even though dead.py
+        # has a deadlock and clean.py an RT101
+        rc = main(["--flow", str(pkg), "--no-baseline", "--changed-only"])
+        assert rc == 0
+        capsys.readouterr()
+        # dirty only the RT101 file: its finding appears, the deadlock
+        # in the untouched file stays out of the report
+        clean.write_text(
+            "import time\n\nasync def h():\n    time.sleep(2)\n"
+        )
+        rc = main(["--flow", str(pkg), "--no-baseline", "--changed-only"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "RT101" in out and "RT201" not in out
+        # a brand-new UNTRACKED module is dirty too — the edit loop's
+        # most important file must not be silently skipped
+        clean.write_text("import time\n\nasync def h():\n    pass\n")
+        fresh = tmp_path / "pkg" / "fresh.py"
+        fresh.write_text(
+            "import time\n\nasync def g():\n    time.sleep(3)\n"
+        )
+        rc = main(["--flow", str(pkg), "--no-baseline", "--changed-only"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "fresh.py" in out and "RT101" in out
+
+    def test_cli_single_file_flow_keeps_package_module_names(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        # `lint --flow pkg/dead.py` must index the file under its real
+        # package-qualified name (walking up through __init__.py), or
+        # qualname resolution breaks and the tier silently under-reports
+        from ray_tpu.devtools.lint import main
+
+        monkeypatch.chdir(tmp_path)
+        pkg = self._write_pkg(tmp_path)
+        rc = main([
+            "--flow", str(pkg / "dead.py"), "--no-baseline",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "RT201" in out
+
+    def test_cli_changed_only_falls_back_without_git(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        from ray_tpu.devtools import lint as lint_mod
+
+        monkeypatch.chdir(tmp_path)
+        pkg = self._write_pkg(tmp_path)
+        monkeypatch.setattr(
+            lint_mod, "git_changed_files", lambda: None
+        )
+        rc = lint_mod.main([
+            "--flow", str(pkg), "--no-baseline", "--changed-only",
+        ])
+        captured = capsys.readouterr()
+        assert rc == 1  # fell back to the whole package
+        assert "RT201" in captured.out
+        assert "git unavailable" in captured.err
+
+    def test_cli_rules_partition_between_tiers(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        from ray_tpu.devtools.lint import main
+
+        monkeypatch.chdir(tmp_path)
+        pkg = self._write_pkg(tmp_path)
+        (tmp_path / "pkg" / "blocky.py").write_text(
+            "import time\n\nasync def h():\n    time.sleep(1)\n"
+        )
+        rc = main([
+            "--flow", str(pkg), "--no-baseline", "--rules", "RT201",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "RT201" in out and "RT101" not in out
+
+
+# ---------------------------------------------------------------------------
+# The gate: the installed package stays clean under the flow tier
+# ---------------------------------------------------------------------------
+
+
+def test_whole_package_has_no_non_baselined_flow_findings():
+    report = analyze_paths([PKG])
+    assert report.files_indexed > 100
+    baseline = load_baseline(DEFAULT_FLOW_BASELINE)
+    new, _old = split_baselined(report.findings, baseline)
+    assert new == [], (
+        "rtflow found new interprocedural issues (fix them, suppress "
+        "with a justified `# rtlint: disable=...`, or — for "
+        "grandfathered debt — regenerate with --flow --write-baseline):\n"
+        + "\n".join(f.render() for f in new)
+    )
