@@ -319,6 +319,36 @@ def bench_actor_calls_n_n(ray_tpu, duration_s=3.0, n_actors=8, window=200):
     return n / dt
 
 
+def bench_taskplane_alloc_churn(ray_tpu, window=1000, rounds=5):
+    """Deterministic task-plane churn row: gen0 container allocations per
+    windowed async actor call, the round-4 methodology ((gen0 collections
+    x threshold + count delta) / calls, process-wide).  Wall-clock on the
+    1-core harness is mood-dependent; this is the regression signal that
+    is not (r4 band: 12.2-13.3, ~2.4 since the r5 fixes + batched task
+    plane; <= 9 pinned by tests/test_taskplane_batching.py)."""
+    import gc
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return b"ok"
+
+    a = Echo.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    for _ in range(3):  # steady state: leases, promotion, allocator
+        ray_tpu.get([a.ping.remote() for _ in range(window)], timeout=120)
+    gc.collect()
+    th0 = gc.get_threshold()[0]
+    c0 = gc.get_stats()[0]["collections"]
+    n0 = gc.get_count()[0]
+    for _ in range(rounds):
+        ray_tpu.get([a.ping.remote() for _ in range(window)], timeout=120)
+    c1 = gc.get_stats()[0]["collections"]
+    n1 = gc.get_count()[0]
+    ray_tpu.kill(a)
+    return ((c1 - c0) * th0 + (n1 - n0)) / (rounds * window)
+
+
 def bench_tasks_sync(ray_tpu, duration_s=3.0):
     @ray_tpu.remote
     def noop():
@@ -676,6 +706,7 @@ def main():
         ("actor_calls_async_n_n", bench_actor_calls_n_n, "calls/s"),
         ("tasks_sync_single_client", bench_tasks_sync, "tasks/s"),
         ("tasks_async_single_client", bench_tasks_async, "tasks/s"),
+        ("taskplane_alloc_churn", bench_taskplane_alloc_churn, "allocs/call"),
         ("put_gigabytes_per_s", bench_put_gigabytes, "GB/s"),
         ("multi_client_put_gigabytes_per_s", bench_multi_client_put, "GB/s"),
         ("get_calls_per_s", bench_get_calls, "gets/s"),

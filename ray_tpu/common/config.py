@@ -59,6 +59,20 @@ D = _Config.define
 
 # --- wire protocol / rpc ---
 D("rpc_max_frame_bytes", int, 512 * 1024 * 1024)
+# per-tick frame coalescing: messages queued on one connection within a
+# single event-loop tick ride one BATCH frame; a burst past this count
+# flushes mid-tick so send_backlog policing sees the bytes
+D("rpc_batch_max_msgs", int, 128)
+# ...and a byte cap on the same accumulator: coalescing must never build
+# a frame the peer rejects (rpc_max_frame_bytes), so ticks carrying large
+# payloads (object chunks, big inline args) flush in small groups
+D("rpc_batch_max_bytes", int, 8 * 1024 * 1024)
+# flush window for buffered object-directory GCS notifications
+# (add_object_location & co.): non-urgent announces wait up to this long
+# (or gcs_notify_flush_max entries) for one batched rpc; any ref export
+# or local get-miss flushes immediately (visibility unchanged)
+D("gcs_notify_flush_window_s", float, 0.01)
+D("gcs_notify_flush_max", int, 64)
 D("rpc_connect_timeout_s", float, 30.0)
 D("rpc_call_timeout_s", float, 120.0)
 D("heartbeat_interval_s", float, 1.0)

@@ -101,16 +101,22 @@ class TaskID(BaseID):
         return cls(d.digest())
 
 
+def task_return_binary(task_id: bytes, index: int) -> bytes:
+    """Raw bytes of ObjectID.for_task_return without constructing either
+    ID instance — the submission hot path derives return oids straight
+    from the 16-byte task id it already holds."""
+    return hashlib.blake2b(
+        task_id + struct.pack("<I", index), digest_size=_ID_LEN
+    ).digest()
+
+
 class ObjectID(BaseID):
     __slots__ = ()  # no per-instance dict (ids are hot-path objects)
     KIND = 0x06
 
     @classmethod
     def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
-        d = hashlib.blake2b(
-            task_id.binary() + struct.pack("<I", index), digest_size=_ID_LEN
-        )
-        return cls(d.digest())
+        return cls(task_return_binary(task_id.binary(), index))
 
     @classmethod
     def for_put(cls, worker_id: WorkerID, put_index: int) -> "ObjectID":

@@ -194,14 +194,44 @@ class _LargeBytes:
         return (ctor, (pickle.PickleBuffer(self.data),))
 
 
+# value types safe to memoize by (type, value): immutable, hashable, and
+# equality implies identical wire bytes.  float is EXCLUDED on purpose:
+# -0.0 == 0.0 would alias two different payloads, and NaN keys never hit.
+_MEMO_TYPES = frozenset((int, str, bytes, bool, type(None)))
+_MEMO_MAX_VALUE_LEN = 512   # memoized str/bytes size cap
+_MEMO_MAX_ENTRIES = 4096
+
+
 class SerializationContext:
     """Pickles python objects with out-of-band buffer extraction."""
 
     def __init__(self):
         self._custom_reducers = {}
+        # (type, value) -> wire bytes for small immutable arguments that
+        # repeat across task submissions (spec-template arg memo)
+        self._small_memo: dict = {}
 
     def register_reducer(self, typ: type, reducer: Callable) -> None:
         self._custom_reducers[typ] = reducer
+
+    def serialize_small(self, obj: Any) -> Optional[bytes]:
+        """Memoized wire bytes for a small immutable value, or None when
+        the value is not memoizable (caller falls back to serialize()).
+        Repeated small args (status strings, small ints, flags) then cost
+        one dict hit per submission instead of a pickle pass."""
+        t = type(obj)
+        if t not in _MEMO_TYPES:
+            return None
+        if (t is str or t is bytes) and len(obj) > _MEMO_MAX_VALUE_LEN:
+            return None
+        key = (t, obj)
+        b = self._small_memo.get(key)
+        if b is None:
+            b = self.serialize(obj).to_bytes()
+            if len(self._small_memo) >= _MEMO_MAX_ENTRIES:
+                self._small_memo.clear()
+            self._small_memo[key] = b
+        return b
 
     def serialize(self, obj: Any) -> SerializedObject:
         import io

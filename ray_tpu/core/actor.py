@@ -6,6 +6,7 @@ restart options :75-97).
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Dict, Optional
 
 from ray_tpu.common.config import cfg
@@ -15,7 +16,10 @@ from ray_tpu.core.runtime import get_runtime
 
 
 class ActorMethod:
-    __slots__ = ("_handle", "_name", "_num_returns", "_concurrency_group")
+    __slots__ = (
+        "_handle", "_name", "_num_returns", "_concurrency_group",
+        "_skeleton", "_fill_job", "_rt",
+    )
 
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
                  concurrency_group: Optional[str] = None):
@@ -23,6 +27,21 @@ class ActorMethod:
         self._name = name
         self._num_returns = num_returns
         self._concurrency_group = concurrency_group
+        # cached spec skeleton (see Runtime.make_actor_skeleton), built at
+        # first submit and keyed on the runtime instance — `.remote()`
+        # then only fills task id + args
+        self._skeleton = None
+        self._fill_job = False
+        self._rt = None
+
+    def __reduce__(self):
+        # the cached skeleton holds runtime-bound state — rebuild bare on
+        # the receiving side (first submit there re-warms its own cache)
+        return (
+            ActorMethod,
+            (self._handle, self._name, self._num_returns,
+             self._concurrency_group),
+        )
 
     def options(self, num_returns: int = 1,
                 concurrency_group: Optional[str] = None) -> "ActorMethod":
@@ -38,18 +57,21 @@ class ActorMethod:
         return ClassMethodNode(self._handle, self._name, args)
 
     def remote(self, *args, **kwargs):
-        refs = get_runtime().submit_actor_task(
-            self._handle._actor_id,
-            self._name,
-            args,
-            kwargs,
-            num_returns=self._num_returns,
-            retries=self._handle._max_task_retries,
-            concurrency_group=self._concurrency_group,
+        rt = get_runtime()
+        if self._rt is None or self._rt() is not rt:
+            self._skeleton, self._fill_job = rt.make_actor_skeleton(
+                self._handle._actor_id, self._name, self._num_returns,
+                self._concurrency_group,
+            )
+            # weakref: the cached skeleton must not pin a shut-down
+            # runtime alive across init/shutdown cycles
+            self._rt = weakref.ref(rt)
+        # bare ObjectRef / list / ObjectRefGenerator — already the
+        # caller-facing shape
+        return rt.submit_actor_task_from_skeleton(
+            self._skeleton, self._fill_job, args, kwargs,
+            self._handle._max_task_retries,
         )
-        if self._num_returns == "streaming":
-            return refs  # an ObjectRefGenerator
-        return refs[0] if self._num_returns == 1 else refs
 
 
 class ActorHandle:
@@ -60,7 +82,13 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        # cache on the instance: `handle.method` resolves from __dict__
+        # with no allocation on every later lookup, and the cached
+        # ActorMethod keeps its spec skeleton warm across calls
+        # (pickling is unaffected — __reduce__ carries only the id)
+        m = ActorMethod(self, name)
+        self.__dict__[name] = m
+        return m
 
     def _apply(self, fn, *args, **kwargs):
         """Run `fn(actor_instance, *args, **kwargs)` inside the actor

@@ -466,6 +466,7 @@ class CheckpointStore:
 _OBJECT_RPCS = frozenset({
     "add_object_location", "remove_object_location", "free_objects",
     "ref_edge", "ref_update", "add_spilled_location",
+    "object_notify_batch",
 })
 
 #: rpc methods whose effects must survive an immediate crash: flushed
@@ -1258,6 +1259,27 @@ class GcsServer:
     async def rpc_free_objects(self, conn, p):
         for oid in p["object_ids"]:
             await self._free_object(oid)
+        return True
+
+    #: sub-methods a client may batch into one object_notify_batch rpc —
+    #: the flush-window transport for high-churn object bookkeeping
+    _BATCHABLE_OBJECT_RPCS = frozenset({
+        "add_object_location", "remove_object_location", "free_objects",
+        "ref_edge", "ref_update",
+    })
+
+    async def rpc_object_notify_batch(self, conn, p):
+        """Apply a client's buffered object-directory notifies in arrival
+        order (one rpc per flush window instead of one per task/object).
+        Order matters: e.g. an add_object_location buffered before a
+        free_objects must land first so the free's node fan-out sees the
+        location."""
+        for method, payload in p["items"]:
+            if method not in self._BATCHABLE_OBJECT_RPCS:
+                raise rpc.RpcError(
+                    f"non-batchable method {method!r} in object_notify_batch"
+                )
+            await getattr(self, f"rpc_{method}")(conn, payload)
         return True
 
     async def _free_object(self, oid: bytes):

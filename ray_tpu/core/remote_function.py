@@ -34,6 +34,11 @@ class RemoteFunction:
     def __init__(self, fn, **default_opts):
         self._fn = fn
         self._opts = default_opts
+        # spec template (runtime.TaskTemplate), built at first submit and
+        # reused for every later `.remote()` on this option-set: function
+        # shipping, resource validation, scheduling-class key and the
+        # spec skeleton are paid once, not per call
+        self._template = None
         functools.update_wrapper(self, fn)
 
     def options(self, **opts) -> "RemoteFunction":
@@ -49,9 +54,20 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
-        import inspect
-
         from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        tmpl = self._template
+        if tmpl is None or tmpl.rt() is not rt:
+            # first submit on this runtime (or the runtime was recycled
+            # by shutdown/init): build and cache the template
+            tmpl = self._build_template(rt)
+        # single ObjectRef, list of refs, or ObjectRefGenerator — the
+        # template path already returns the caller-facing shape
+        return rt.submit_task_from_template(tmpl, args, kwargs)
+
+    def _build_template(self, rt):
+        import inspect
 
         o = self._opts
         resources = _build_resources(
@@ -66,23 +82,27 @@ class RemoteFunction:
             or inspect.isasyncgenfunction(self._fn)
         ):
             num_returns = "streaming"
-        strategy = _strategy_dict(o.get("scheduling_strategy"))
-        refs = get_runtime().submit_task(
+        tmpl = rt.make_task_template(
             self._fn,
-            args,
-            kwargs,
             name=o.get("name") or self._fn.__qualname__,
             num_returns=num_returns,
             resources=resources,
             max_retries=o.get(
                 "max_retries", cfg.task_max_retries_default
             ),
-            strategy=strategy,
+            strategy=_strategy_dict(o.get("scheduling_strategy")),
             runtime_env=o.get("runtime_env"),
         )
-        if num_returns == "streaming":
-            return refs  # an ObjectRefGenerator
-        return refs[0] if num_returns == 1 else refs
+        self._template = tmpl
+        return tmpl
+
+    def __getstate__(self):
+        # the template caches runtime-bound state (the Runtime itself,
+        # with its loop futures) — it never ships; the receiver rebuilds
+        # its own at first submit
+        d = self.__dict__.copy()
+        d["_template"] = None
+        return d
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -10,7 +10,17 @@ buffers to avoid copies.
 Wire frame:  [u32 nbufs][u32 len_0]...[u32 len_{n-1}][buf_0]...[buf_{n-1}]
 where buf_0 is the message pickle and buf_1.. are out-of-band buffers.
 Message: (kind, msg_id, method, payload)  kind: 0=req, 1=resp-ok, 2=resp-err,
-3=notify.
+3=notify, 4=batch (payload is a list of non-batch messages; one frame, one
+pickle parse, applied in arrival order).
+
+Per-tick frame coalescing: `call_soon` requests and request responses do
+not write their own frame — they append to a per-connection accumulator
+that a `loop.call_soon` callback flushes, so every message issued within
+one event-loop tick rides ONE vectored write (and the peer admits the
+whole batch from one parse).  Latency-neutral at depth 1: the flush
+callback runs before the loop can go back to sleep, and a single pending
+message is written as a plain frame — bytes identical to the unbatched
+protocol.
 """
 
 from __future__ import annotations
@@ -32,6 +42,7 @@ REQUEST = 0
 RESPONSE_OK = 1
 RESPONSE_ERR = 2
 NOTIFY = 3
+BATCH = 4  # payload: list of (kind, msg_id, method, payload) messages
 
 
 class RpcError(Exception):
@@ -48,6 +59,28 @@ class RemoteCallError(RpcError):
     def __init__(self, exc):
         super().__init__(f"remote handler raised: {exc!r}")
         self.remote_exception = exc
+
+
+def _approx_payload_bytes(obj, depth: int = 3) -> int:
+    """Cheap size estimate for batch-accumulator accounting: sums
+    bytes-like payload bodies through shallow container nesting (spec
+    dict → args list → ("val", b) tuple is depth 3).  Small control
+    values estimate 0 — the count cap governs those."""
+    t = type(obj)
+    if t is bytes or t is bytearray or t is memoryview:
+        return len(obj)
+    if depth <= 0:
+        return 0
+    # explicit loops, not sum(genexpr): this runs per queued message on
+    # the hot path and a generator object is a tracked gen0 alloc
+    n = 0
+    if t is tuple or t is list:
+        for o in obj:
+            n += _approx_payload_bytes(o, depth - 1)
+    elif t is dict:
+        for v in obj.values():
+            n += _approx_payload_bytes(v, depth - 1)
+    return n
 
 
 def _dump(msg) -> list:
@@ -84,6 +117,11 @@ class Connection:
         self._send_lock = asyncio.Lock()
         self._closed = False
         self._recv_task: Optional[asyncio.Task] = None
+        # per-tick frame coalescing: messages queued by call_soon /
+        # response sends, flushed as one BATCH frame at tick end
+        self._out_batch: list = []
+        self._out_batch_bytes = 0  # _approx_payload_bytes running sum
+        self._flush_scheduled = False
         # peers can stash identity here after a hello exchange
         self.peer_info: dict = {}
 
@@ -96,6 +134,10 @@ class Connection:
         async with self._send_lock:
             if self._closed:
                 raise ConnectionLost(f"connection {self.name} is closed")
+            # preserve program order with the coalesced path: anything
+            # queued this tick goes on the wire before this message
+            if self._out_batch:
+                self._flush_out_batch()
             self._write_frames(bufs)
             await self.writer.drain()
 
@@ -144,17 +186,65 @@ class Connection:
         returns the reply future (completed by the recv loop, failed with
         ConnectionLost on shutdown).  The hot-path primitive for high-rate
         callers (actor pushes): no per-call coroutine/Task, no wait_for
-        timer — attach a done-callback instead.  Loop-only.  NB: skipping
-        drain() skips asyncio's write flow control — transport.write
-        buffers unboundedly — so callers MUST police `send_backlog` and
-        fall back to an awaiting path (conn.drain) past their budget."""
+        timer — attach a done-callback instead.  Loop-only.
+
+        Requests issued within one event-loop tick coalesce into a single
+        BATCH frame (flushed by a loop.call_soon callback, so a lone
+        request still hits the wire before the loop can sleep — depth-1
+        latency is unchanged).  NB: skipping drain() skips asyncio's
+        write flow control — transport.write buffers unboundedly — so
+        callers MUST police `send_backlog` and fall back to an awaiting
+        path (conn.drain) past their budget."""
         if self._closed:
             raise ConnectionLost(f"connection {self.name} is closed")
         msg_id = next(self._msg_ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        self._write_frames(_dump((REQUEST, msg_id, method, payload)))
+        self._send_soon((REQUEST, msg_id, method, payload))
         return fut
+
+    def _send_soon(self, msg) -> None:
+        """Queue one message for the per-tick batch flush (loop-only)."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        self._out_batch.append(msg)
+        self._out_batch_bytes += _approx_payload_bytes(msg[3])
+        if (
+            len(self._out_batch) >= cfg.rpc_batch_max_msgs
+            or self._out_batch_bytes >= cfg.rpc_batch_max_bytes
+        ):
+            # count cap: a burst bigger than one tick's worth of batching
+            # flushes mid-tick, so transport backlog becomes visible to
+            # the callers policing send_backlog before the tick ends.
+            # byte cap: large payloads (object chunks, big inline args)
+            # must never coalesce into a frame past rpc_max_frame_bytes —
+            # a single huge message flushes alone, as its own plain frame
+            self._flush_out_batch()
+        elif not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_out_batch)
+
+    def _flush_out_batch(self) -> None:
+        """Write everything queued this tick as one frame.  A single
+        queued message is written as a plain (non-BATCH) frame — the
+        depth-1 wire bytes are identical to the unbatched protocol."""
+        self._flush_scheduled = False
+        batch = self._out_batch
+        self._out_batch = []
+        self._out_batch_bytes = 0
+        if not batch or self._closed:
+            # closed: _shutdown already failed every pending future;
+            # dropping queued messages mirrors a loss mid-flight
+            return
+        try:
+            if len(batch) == 1:
+                self._write_frames(_dump(batch[0]))
+            else:
+                self._write_frames(_dump((BATCH, 0, "", batch)))
+        except Exception:
+            # transport died under us; the recv loop notices the loss and
+            # fails every pending future via _shutdown
+            logger.debug("batch flush failed on %s", self.name, exc_info=True)
 
     @property
     def send_backlog(self) -> int:
@@ -165,7 +255,11 @@ class Connection:
             return 0
 
     async def drain(self):
-        """Await transport flow control (pauses while the peer is slow)."""
+        """Await transport flow control (pauses while the peer is slow).
+        Flushes the per-tick batch first so the backlog being drained
+        includes everything queued this tick."""
+        if self._out_batch:
+            self._flush_out_batch()
         await self.writer.drain()
 
     async def notify(self, method: str, payload: Any = None) -> None:
@@ -192,24 +286,15 @@ class Connection:
             while True:
                 bufs = await self._read_frame()
                 kind, msg_id, method, payload = _load(bufs)
-                if kind == REQUEST:
-                    asyncio.get_running_loop().create_task(
-                        self._handle_request(msg_id, method, payload)
-                    )
-                elif kind == NOTIFY:
-                    asyncio.get_running_loop().create_task(
-                        self._handle_notify(method, payload)
-                    )
+                if kind == BATCH:
+                    # one parse for the whole tick's worth of peer
+                    # messages; sub-messages apply in arrival order, so
+                    # e.g. a run of push_task requests admits (and, with
+                    # eager tasks, seq-admits) back-to-back in one pass
+                    for kind, msg_id, method, sub in payload:
+                        self._dispatch_msg(kind, msg_id, method, sub)
                 else:
-                    # pop: call() also pops in its finally (harmless
-                    # no-op then); call_soon() futures are only removed
-                    # here or at shutdown
-                    fut = self._pending.pop(msg_id, None)
-                    if fut is not None and not fut.done():
-                        if kind == RESPONSE_OK:
-                            fut.set_result(payload)
-                        else:
-                            fut.set_exception(RemoteCallError(payload))
+                    self._dispatch_msg(kind, msg_id, method, payload)
         except (
             asyncio.IncompleteReadError,
             ConnectionResetError,
@@ -224,17 +309,55 @@ class Connection:
         finally:
             await self._shutdown()
 
+    def _dispatch_msg(self, kind, msg_id, method, payload):
+        """Route one inbound message (loop-only, called by the recv loop)."""
+        if kind == REQUEST:
+            asyncio.get_running_loop().create_task(
+                self._handle_request(msg_id, method, payload)
+            )
+        elif kind == NOTIFY:
+            asyncio.get_running_loop().create_task(
+                self._handle_notify(method, payload)
+            )
+        elif kind == BATCH:
+            logger.warning("nested BATCH frame on %s dropped", self.name)
+        else:
+            # pop: call() also pops in its finally (harmless
+            # no-op then); call_soon() futures are only removed
+            # here or at shutdown
+            fut = self._pending.pop(msg_id, None)
+            if fut is not None and not fut.done():
+                if kind == RESPONSE_OK:
+                    fut.set_result(payload)
+                else:
+                    fut.set_exception(RemoteCallError(payload))
+
     async def _handle_request(self, msg_id, method, payload):
         try:
             result = await self.handler(self, method, payload)
-            await self._send((RESPONSE_OK, msg_id, method, result))
         except ConnectionLost:
-            pass
+            return
         except Exception as e:
             logger.debug("handler %s raised: %r", method, e)
+            result = _safe_exc(e)
             try:
-                await self._send((RESPONSE_ERR, msg_id, method, _safe_exc(e)))
+                self._send_soon((RESPONSE_ERR, msg_id, method, result))
             except ConnectionLost:
+                pass
+            return
+        # buffered reply: replies completed within one tick coalesce into
+        # a single frame (a handler that ran synchronously under the eager
+        # task factory replies in the same tick its request arrived).
+        # call_soon's skipped flow control is restored here: past the
+        # backlog budget the handler awaits the transport drain.
+        try:
+            self._send_soon((RESPONSE_OK, msg_id, method, result))
+        except ConnectionLost:
+            return
+        if self.send_backlog > cfg.rpc_send_backlog_limit_bytes:
+            try:
+                await self.drain()
+            except (ConnectionLost, OSError):
                 pass
 
     async def _handle_notify(self, method, payload):
@@ -247,6 +370,8 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        self._out_batch.clear()
+        self._out_batch_bytes = 0
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost(f"connection {self.name} lost"))

@@ -36,7 +36,15 @@ from ray_tpu._native.store import (
     StoreFullError,
 )
 from ray_tpu.common.config import cfg
-from ray_tpu.common.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.common.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+    task_return_binary,
+)
 from ray_tpu.common import serialization as ser
 from ray_tpu.core import rpc
 from ray_tpu.core.errors import (
@@ -81,15 +89,35 @@ class Lease:
     conn: rpc.Connection
     inflight: int = 0
     broken: bool = False
+    draining: bool = False  # a drain-then-pump task is in flight
 
 
 @dataclass(slots=True)
 class PendingTask:
     spec: dict
-    return_ids: List[bytes]
+    return_ids: Any  # tuple/list of return oid bytes
     retries_left: int
     sub_idx: int = 0  # per-actor submission order (client-side)
-    dep_oids: List[bytes] = field(default_factory=list)  # held while in flight
+    dep_oids: Any = ()  # oids held while in flight (list, or shared ())
+    # scheduling-class routing for NORMAL tasks (None for actor tasks):
+    # carried on the task so the coalesced submit queue and lineage need
+    # no per-call argument tuples
+    class_key: Any = None
+    resources: Any = None
+    strategy: Any = None
+    # reply routing (assigned at dispatch; rt/st/conn live on the task's
+    # slots so the done-callback is ONE bound method instead of a
+    # closure + cells per call).  st is the ActorClientState for actor
+    # pushes and the Lease for normal-task pushes.
+    rt: Any = None
+    st: Any = None
+    conn: Any = None
+
+    def on_push_reply(self, fut):
+        self.rt._on_push_reply(self.st, self.conn, self, fut)
+
+    def on_task_reply(self, fut):
+        self.rt._on_task_push_reply(self, fut)
 
 
 @dataclass
@@ -113,12 +141,45 @@ class ActorClientState:
     draining: bool = False  # pump is parked mid-drain waiting for inflight
 
 
+class TaskTemplate:
+    """Pre-computed, immutable submission state for one RemoteFunction
+    option-set (reference analogue: the cached TaskSpec prelude ray
+    builds once per function descriptor).  Everything that is identical
+    across `.remote()` calls — function hash, validated resources,
+    scheduling class key, runtime-env descriptor, the spec skeleton
+    dict — is computed once at first submit; each call then only fills
+    task/object ids and args.  Treat every field as frozen.  ``rt`` is a
+    weakref: the template is cached on long-lived RemoteFunction objects
+    and must not keep a shut-down Runtime (loop, stores, futures) alive
+    across init/shutdown cycles — callers deref it purely as the
+    staleness check."""
+
+    __slots__ = (
+        "rt", "skeleton", "class_key", "resources", "strategy",
+        "num_returns", "streaming", "max_retries", "fill_job",
+    )
+
+    def __init__(self, rt, skeleton, class_key, resources, strategy,
+                 num_returns, streaming, max_retries, fill_job):
+        self.rt = weakref.ref(rt)
+        self.skeleton = skeleton
+        self.class_key = class_key
+        self.resources = resources
+        self.strategy = strategy
+        self.num_returns = num_returns
+        self.streaming = streaming
+        self.max_retries = max_retries
+        self.fill_job = fill_job
+
+
 _sched_class_tags = iter(range(1, 1 << 62))
 
 
 class SchedClassState:
     def __init__(self):
-        self.queue: List[PendingTask] = []
+        # deque: the pump pops from the front at pipeline depth — a list
+        # pop(0) is O(queue) and turns a deep windowed burst quadratic
+        self.queue: deque = deque()
         self.leases: List[Lease] = []
         self.requests_inflight = 0
         self.idle_timer: Optional[asyncio.TimerHandle] = None
@@ -205,7 +266,25 @@ class Runtime:
         self._classes: Dict[tuple, SchedClassState] = {}
         self._worker_conns: Dict[str, rpc.Connection] = {}
         self._put_index = 0
-        self._task_index = 0
+
+        # caller-thread submission coalescing: tasks submitted between
+        # two io-loop ticks ride ONE call_soon_threadsafe wakeup (the
+        # per-call Handle + args tuple + context copy was a measurable
+        # slice of submission churn).  deque ops are GIL-atomic; the
+        # flag protocol (drainer clears BEFORE draining, submitters
+        # schedule only on a False read) cannot miss a wakeup.
+        self._submit_q: deque = deque()
+        self._submit_q_scheduled = False
+
+        # flush-window GCS notifications: object-directory notifies
+        # (add_object_location / ref_edge / ref_update / free_objects)
+        # buffer here and go out as one object_notify_batch rpc — per
+        # tick for urgent events, per gcs_notify_flush_window_s for
+        # windowed ones.  Ref export and local get-miss flush eagerly,
+        # so cross-process visibility semantics are unchanged.
+        self._gcs_nbuf: list = []
+        self._gcs_nbuf_lock = threading.Lock()
+        self._gcs_nbuf_mode: Optional[str] = None  # None | "timer" | "soon"
 
         # actors (client side)
         self._actor_conns: Dict[bytes, rpc.Connection] = {}
@@ -430,6 +509,9 @@ class Runtime:
         self._closed = True
 
         async def _close():
+            # windowed object notifies (announces, frees) must not die in
+            # the buffer — other processes may hold refs to the objects
+            self._flush_gcs_notify()
             t = getattr(self, "_metrics_task", None)
             if t is not None:
                 t.cancel()
@@ -488,10 +570,8 @@ class Runtime:
 
     def _register_edges(self, parent_oid: bytes, children: List[bytes]):
         if children and self.gcs and not self.gcs.closed:
-            self._spawn(
-                self.gcs.notify(
-                    "ref_edge", {"parent": parent_oid, "children": children}
-                )
+            self._gcs_object_notify(
+                "ref_edge", {"parent": parent_oid, "children": children}
             )
 
     def serialize(self, value) -> ser.SerializedObject:
@@ -524,6 +604,10 @@ class Runtime:
         # deferral that raced us earlier is cured and none can follow.
         self._escaped.add(oid)
         self._reregister_if_deferred(oid)
+        # ref export: any windowed location announce (e.g. this object's
+        # own put()) must be GCS-visible before the ref can reach a
+        # process that would look it up
+        self.flush_object_notifies()
         if oid in self._shared or self.store.contains(oid):
             self._shared.add(oid)
             return
@@ -554,7 +638,8 @@ class Runtime:
             self._shared.add(oid)
             return
 
-    def _write_to_store(self, oid: bytes, s: ser.SerializedObject) -> int:
+    def _write_to_store(self, oid: bytes, s: ser.SerializedObject,
+                        urgent_announce: bool = True) -> int:
         size = s.total_bytes
         try:
             buf = self.store.create(oid, size)
@@ -606,15 +691,14 @@ class Runtime:
             raise StoreError(f"protect failed for {oid.hex()[:12]}")
         self.store.seal(oid)
         self._shared.add(oid)
-        self._spawn(
-            self.gcs.notify(
-                "add_object_location",
-                {
-                    "object_id": oid,
-                    "node_id": bytes.fromhex(self.node_id),
-                    "size": size,
-                },
-            )
+        self._gcs_object_notify(
+            "add_object_location",
+            {
+                "object_id": oid,
+                "node_id": bytes.fromhex(self.node_id),
+                "size": size,
+            },
+            urgent=urgent_announce,
         )
         return size
 
@@ -648,7 +732,9 @@ class Runtime:
         object_id = ObjectID.for_put(self.worker_id, self._put_index)
         oid = object_id.binary()
         s, nested = self._serialize_tracked(value)
-        self._write_to_store(oid, s)
+        # windowed announce: nothing cluster-side can look this oid up
+        # until the ref escapes, and every escape path flushes the window
+        self._write_to_store(oid, s, urgent_announce=False)
         self._register_edges(oid, nested)
         return ObjectRef(object_id, self.node_id)
 
@@ -968,9 +1054,7 @@ class Runtime:
                 for i in range(consumed_upto, buf.count)
             ]
             if self.gcs and not self.gcs.closed:
-                self._spawn(
-                    self.gcs.notify("free_objects", {"object_ids": oids})
-                )
+                self._gcs_object_notify("free_objects", {"object_ids": oids})
 
     async def await_ref(self, ref: ObjectRef):
         (value,) = await self._get_async([ref.object_id.binary()], None)
@@ -1062,6 +1146,10 @@ class Runtime:
             value, found = self._read_from_store(oid)
             if found:
                 return value
+            # local get-miss: flush any windowed announces before asking
+            # the cluster (the object may be one whose announce is still
+            # sitting in our own window)
+            self.flush_object_notifies()
             # ask raylet to pull it from another node
             remaining = 30.0 if deadline is None else deadline - time.monotonic()
             if remaining <= 0:
@@ -1234,21 +1322,28 @@ class Runtime:
         nested refs via the reducer)."""
         if not args and not kwargs:
             return ()  # shared empty: no per-call list on no-arg calls
+        ser_ctx = self._serialization
         packed = []
         for a in args:
             if isinstance(a, ObjectRef):
                 self.ensure_shared(a.object_id)
                 packed.append(("ref", a.object_id.binary(), a._owner_hint))
             else:
-                packed.append(("val", self._serialization.serialize(a).to_bytes()))
+                # small immutable values (flags, indexes, short strings)
+                # repeat across submissions: the memo skips the pickle
+                b = ser_ctx.serialize_small(a)
+                if b is None:
+                    b = ser_ctx.serialize(a).to_bytes()
+                packed.append(("val", b))
         for k, v in (kwargs or {}).items():
             if isinstance(v, ObjectRef):
                 self.ensure_shared(v.object_id)
                 packed.append(("kwref", k, v.object_id.binary(), v._owner_hint))
             else:
-                packed.append(
-                    ("kwval", k, self._serialization.serialize(v).to_bytes())
-                )
+                b = ser_ctx.serialize_small(v)
+                if b is None:
+                    b = ser_ctx.serialize(v).to_bytes()
+                packed.append(("kwval", k, b))
         return packed
 
     def unpack_args_sync(self, packed) -> Optional[Tuple[list, dict]]:
@@ -1282,21 +1377,22 @@ class Runtime:
                 kwargs[item[1]] = self._serialization.deserialize(item[2])
         return args, kwargs
 
-    def submit_task(
+    def make_task_template(
         self,
         fn,
-        args,
-        kwargs,
         *,
         name: str = "",
-        num_returns: int = 1,
+        num_returns=1,
         resources: Optional[Dict[str, float]] = None,
         max_retries: int = 0,
         strategy: Optional[dict] = None,
         runtime_env: Optional[dict] = None,
-    ) -> List[ObjectRef]:
-        self._task_index += 1
-        task_id = TaskID.random()
+    ) -> TaskTemplate:
+        """Build the immutable submission template for one function /
+        option-set: function shipping, resource validation, scheduling
+        class key, runtime-env normalization and the spec skeleton all
+        happen HERE, once — `.remote()` pays only id/arg fills.
+        RemoteFunction caches the result per runtime instance."""
         fn_hash = self.fn_hash_and_register(fn)
         # {} is a valid demand (zero-resource tasks, e.g. PG probes)
         resources = dict(resources) if resources is not None else {"CPU": 1}
@@ -1304,21 +1400,7 @@ class Runtime:
         if streaming:
             num_returns = 1
             max_retries = 0  # re-running a generator would double-send items
-        spec = {
-            "task_id": task_id.binary(),
-            "name": name,
-            "fn_hash": fn_hash,
-            "args": self._pack_args(args, kwargs),
-            "num_returns": num_returns,
-            "resources": resources,
-            "caller_id": self.worker_id.binary(),
-            "job": self._job_hex(),
-        }
-        if streaming:
-            spec["streaming"] = True
-        return_ids = [
-            ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
-        ]
+        strategy = dict(strategy) if strategy else {}
         # Scheduling class = (fn, resources, strategy) — like the reference's
         # SchedulingClass (ray: common/task/task_spec.h) — so leased workers
         # are only reused for the same function shape and a slow function
@@ -1329,22 +1411,69 @@ class Runtime:
         class_key = (
             fn_hash,
             tuple(sorted(resources.items())),
-            tuple(sorted((strategy or {}).items(), key=lambda kv: kv[0])),
+            tuple(sorted(strategy.items(), key=lambda kv: kv[0])),
             rtenv_mod.descriptor_key(rtenv_desc),
         )
         if rtenv_desc is not None:
             self._class_runtime_envs[class_key] = rtenv_desc
+        # NB: resources deliberately do NOT ride the wire spec — the
+        # worker never schedules (the lease already placed the task) and
+        # nothing else reads them off the spec; they live on the template
+        # and PendingTask for lease requests and lineage re-execution
+        skeleton = {
+            "task_id": b"",  # filled per call
+            "name": name,
+            "fn_hash": fn_hash,
+            "args": (),      # filled per call
+            "num_returns": num_returns,
+            "caller_id": self.worker_id.binary(),
+        }
+        if streaming:
+            skeleton["streaming"] = True
+        # drivers bake their (constant) job into the skeleton; workers
+        # attribute nested submissions to the job of the task that last
+        # ran here, which changes — those fill per call
+        fill_job = self.job_id is None
+        if not fill_job:
+            skeleton["job"] = self._job_hex()
+        return TaskTemplate(
+            self, skeleton, class_key, resources, strategy,
+            num_returns, streaming, max_retries, fill_job,
+        )
+
+    def submit_task_from_template(self, tmpl: TaskTemplate, args, kwargs):
+        """Hot-path submit: copy the skeleton, fill ids + args, hand the
+        PendingTask to the io loop through the coalesced submit queue.
+        Returns a bare ObjectRef for num_returns == 1, a list of refs
+        otherwise, an ObjectRefGenerator when streaming."""
+        task_id = os.urandom(16)
+        spec = dict(tmpl.skeleton)
+        spec["task_id"] = task_id
+        spec["args"] = self._pack_args(args, kwargs)
+        if tmpl.fill_job:
+            spec["job"] = self._job_hex()
+        n = tmpl.num_returns
+        if n == 1:
+            return_ids = (task_return_binary(task_id, 0),)
+        else:
+            return_ids = tuple(
+                task_return_binary(task_id, i) for i in range(n)
+            )
         # Dependencies this process itself is producing.  They must resolve
         # BEFORE the task may occupy a lease — a worker blocking on an
         # in-flight upstream result while holding the worker that upstream
         # task needs is a scheduling deadlock (reference:
         # LocalDependencyResolver, core_worker/transport/dependency_resolver.h).
-        dep_oids = [
+        dep_oids = () if not spec["args"] else [
             item[1] if item[0] == "ref" else item[2]
             for item in spec["args"]
             if item[0] in ("ref", "kwref")
         ]
-        pending = PendingTask(spec, return_ids, max_retries, dep_oids=dep_oids)
+        pending = PendingTask(
+            spec, return_ids, tmpl.max_retries, dep_oids=dep_oids,
+            class_key=tmpl.class_key, resources=tmpl.resources,
+            strategy=tmpl.strategy,
+        )
         self.record_event("submit", spec["name"], task_id.hex())
         if tracing.enabled():
             # W3C trace context rides the spec; the worker's execute
@@ -1357,46 +1486,166 @@ class Runtime:
         # ref args stay pinned while the task is in flight, even if the
         # caller drops its own refs (reference: task-argument references,
         # reference_count.h)
-        self._hold_for_task(dep_oids)
-        if streaming:
+        if dep_oids:
+            self._hold_for_task(dep_oids)
+        if tmpl.streaming:
             # stream buffer must exist before any item can arrive; no
             # result futures (items resolve via the memory store / shm),
             # no lineage (generators are not reconstructible)
-            self._streams[task_id.binary()] = _StreamBuf()
-            self._call_on_loop(
-                self._enqueue_after_deps, class_key, pending,
-                dict(resources), strategy or {}, dep_oids,
-            )
-            return ObjectRefGenerator(task_id.binary())
-        self._record_lineage(
-            pending, class_key, dict(resources), strategy or {}, dep_oids
-        )
-        # Register result futures before the task can possibly complete, then
-        # hand off to the io loop without blocking (safe to call from the io
-        # thread itself, e.g. async actor methods submitting sub-tasks).
+            self._streams[task_id] = _StreamBuf()
+            self._submit_to_loop(pending)
+            return ObjectRefGenerator(task_id)
+        self._record_lineage(pending)
+        # Register result futures before the task can possibly complete,
+        # lazily (_PENDING_RESULT upgrades to an asyncio.Future only on
+        # async need), and create refs BEFORE the enqueue can run: a fast
+        # failure path must see a nonzero refcount or it would drop the
+        # error sentinel.
         for oid in return_ids:
-            # lazy: most results are consumed by the sync fast path and
-            # never need an asyncio.Future (one tracked object per call)
             self.result_futures[oid] = _PENDING_RESULT
-        # refs exist BEFORE the enqueue can run: a fast failure path must
-        # see a nonzero refcount or it would drop the error sentinel
+        if n == 1:
+            ref = ObjectRef(ObjectID(return_ids[0]), self.node_id)
+            self._submit_to_loop(pending)
+            return ref
         refs = [ObjectRef(ObjectID(oid), self.node_id) for oid in return_ids]
-        self._call_on_loop(
-            self._enqueue_after_deps, class_key, pending, dict(resources),
-            strategy or {}, dep_oids,
-        )
+        self._submit_to_loop(pending)
         return refs
 
-    def _call_on_loop(self, fn, *args):
-        if threading.current_thread() is self._thread:
-            fn(*args)
-        else:
-            self._loop.call_soon_threadsafe(fn, *args)
-
-    def _enqueue_after_deps(
-        self, class_key, pending: PendingTask, resources, strategy, dep_oids
+    def submit_task(
+        self,
+        fn,
+        args,
+        kwargs,
+        *,
+        name: str = "",
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: int = 0,
+        strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
     ):
+        """Untemplated submit (compatibility surface): builds a one-shot
+        template.  RemoteFunction bypasses this with a cached template;
+        returns a list of refs (or a generator) like it always did."""
+        tmpl = self.make_task_template(
+            fn, name=name, num_returns=num_returns, resources=resources,
+            max_retries=max_retries, strategy=strategy,
+            runtime_env=runtime_env,
+        )
+        out = self.submit_task_from_template(tmpl, args, kwargs)
+        if isinstance(out, ObjectRef):
+            return [out]
+        return out
+
+    # ---- coalesced submission hop --------------------------------------
+    def _submit_to_loop(self, task: PendingTask):
+        """Hand a PendingTask to the io loop, coalescing the cross-thread
+        wakeup: every task appended between two loop ticks drains in one
+        scheduled callback."""
+        if threading.current_thread() is self._thread:
+            self._admit_submitted(task)
+            return
+        self._submit_q.append(task)
+        # deliberately lock-free (GIL-ordered): the drainer clears the
+        # flag BEFORE draining, so a submitter reading a stale True has
+        # its append covered by that very drain; a stale False only
+        # schedules a redundant no-op drain.  A lock here would sit on
+        # every submission.
+        # rtlint: disable-next=RT108
+        if not self._submit_q_scheduled:
+            self._submit_q_scheduled = True
+            self._loop.call_soon_threadsafe(self._drain_submit_q)
+
+    def _drain_submit_q(self):
+        # clear the flag BEFORE draining: a submitter appending after the
+        # clear schedules a fresh (possibly redundant, never missed) drain
+        self._submit_q_scheduled = False
+        q = self._submit_q
+        while q:
+            try:
+                task = q.popleft()
+            except IndexError:
+                break
+            self._admit_submitted(task)
+
+    def _admit_submitted(self, task: PendingTask):
+        if "actor_id" in task.spec:
+            self._enqueue_actor_task(task)
+        else:
+            self._enqueue_after_deps(task)
+
+    # ---- flush-window GCS notifications --------------------------------
+    def _gcs_object_notify(self, method: str, payload: dict,
+                           urgent: bool = True) -> None:
+        """Buffer an object-directory notify for the batched flush.
+        ``urgent`` events (locations another process may already be
+        waiting on) flush this tick; windowed events (e.g. put()
+        announces of refs that have not escaped) wait up to
+        cfg.gcs_notify_flush_window_s / gcs_notify_flush_max for
+        company.  Buffer order is preserved on the wire and applied in
+        order by the GCS, so announce-before-free style invariants hold
+        within the batch."""
+        if self._closed:
+            return
+        with self._gcs_nbuf_lock:
+            self._gcs_nbuf.append((method, payload))
+            if len(self._gcs_nbuf) >= cfg.gcs_notify_flush_max:
+                urgent = True
+            if urgent:
+                if self._gcs_nbuf_mode == "soon":
+                    return
+                self._gcs_nbuf_mode = "soon"
+                mode = "soon"
+            else:
+                if self._gcs_nbuf_mode is not None:
+                    return
+                self._gcs_nbuf_mode = "timer"
+                mode = "timer"
+        try:
+            if mode == "soon":
+                self._loop.call_soon_threadsafe(self._flush_gcs_notify)
+            else:
+                self._loop.call_soon_threadsafe(self._arm_gcs_notify_timer)
+        except RuntimeError:
+            pass  # loop closing
+
+    def flush_object_notifies(self) -> None:
+        """Flush the object-notify window now (callable from any
+        thread).  Every path that can make a windowed announce
+        observable to another process — ref export, a directory read,
+        an explicit free — calls this first; the flush-window batching
+        is then invisible to cross-process visibility semantics."""
+        if self._gcs_nbuf:
+            self._flush_gcs_notify()
+
+    def _arm_gcs_notify_timer(self):
+        # loop-only.  The window may have been upgraded to a tick flush
+        # meanwhile; the timer then fires on an empty buffer (no-op).
+        self._loop.call_later(
+            cfg.gcs_notify_flush_window_s, self._flush_gcs_notify
+        )
+
+    def _flush_gcs_notify(self):
+        """Send everything buffered as ONE rpc (callable from any
+        thread; the send itself always happens on the io loop)."""
+        with self._gcs_nbuf_lock:
+            items = self._gcs_nbuf
+            self._gcs_nbuf_mode = None
+            if not items:
+                return
+            self._gcs_nbuf = []
+        if self.gcs is None or self.gcs.closed:
+            return
+        if len(items) == 1:
+            self._spawn(self.gcs.notify(items[0][0], items[0][1]))
+        else:
+            self._spawn(
+                self.gcs.notify("object_notify_batch", {"items": items})
+            )
+
+    def _enqueue_after_deps(self, pending: PendingTask):
         """Queue the task once locally-produced ref args have resolved."""
+        dep_oids = pending.dep_oids
         waits = [
             fut
             for oid in dep_oids
@@ -1408,7 +1657,7 @@ class Runtime:
             if failed is not None:
                 self._fail_task(pending, failed)
                 return
-            self._enqueue_task(class_key, pending, resources, strategy)
+            self._enqueue_task(pending)
             return
 
         async def wait_then_enqueue():
@@ -1419,7 +1668,7 @@ class Runtime:
             if failed is not None:
                 self._fail_task(pending, failed)
             else:
-                self._enqueue_task(class_key, pending, resources, strategy)
+                self._enqueue_task(pending)
 
         self._loop.create_task(wait_then_enqueue())
 
@@ -1440,28 +1689,39 @@ class Runtime:
             return True
         return False
 
-    def _enqueue_task(self, class_key, pending: PendingTask, resources, strategy):
+    def _enqueue_task(self, pending: PendingTask):
         if self._consume_cancel_flag(pending):
             return
+        class_key = pending.class_key
         st = self._classes.get(class_key)
         if st is None:
             st = self._classes[class_key] = SchedClassState()
         st.queue.append(pending)
-        self._pump_class(class_key, resources, strategy)
+        self._pump_class(class_key, pending.resources, pending.strategy)
 
     def _pump_class(self, class_key, resources, strategy):
         """Dispatch queued tasks onto leased workers; request more leases if
         the queue outruns capacity; give idle leases back."""
         st = self._classes[class_key]
         cap = cfg.max_tasks_in_flight_per_worker
-        # dispatch
+        # dispatch — but never past the transport's backlog budget: a
+        # connection already over rpc_send_backlog_limit_bytes stops
+        # taking pushes until its drain completes (real flow control;
+        # the dispatch path itself never awaits)
+        limit = cfg.rpc_send_backlog_limit_bytes
         for lease in st.leases:
-            while st.queue and not lease.broken and lease.inflight < cap:
-                task = st.queue.pop(0)
+            while (
+                st.queue and not lease.broken and lease.inflight < cap
+                and lease.conn.send_backlog <= limit
+            ):
+                task = st.queue.popleft()
                 lease.inflight += 1
-                self._loop.create_task(
-                    self._dispatch(class_key, lease, task, resources, strategy)
-                )
+                self._dispatch(class_key, lease, task, resources, strategy)
+            if (
+                st.queue and not lease.broken
+                and lease.conn.send_backlog > limit
+            ):
+                self._drain_then_pump(class_key, lease, resources, strategy)
         if st.queue:
             # scale leases: one in-flight request per ~cap queued tasks
             # beyond current capacity
@@ -1562,9 +1822,12 @@ class Runtime:
             self._worker_conns.pop(addr, None)
         self._notify_peer_closed(conn)
 
-    async def _dispatch(self, class_key, lease: Lease, task: PendingTask,
-                        resources, strategy):
-        st = self._classes[class_key]
+    def _dispatch(self, class_key, lease: Lease, task: PendingTask,
+                  resources, strategy):
+        """Fire one task push and attach the reply callback — NO per-task
+        coroutine/Task (the awaiting-coroutine shape cost a Task object +
+        frame per call on the pipelined-task hot path; the actor path
+        made the same move a round earlier)."""
         if self._consume_cancel_flag(task):  # cancelled in the pop→push window
             lease.inflight -= 1
             self._pump_class(class_key, resources, strategy)
@@ -1572,80 +1835,136 @@ class Runtime:
         self._inflight_dispatch[task.return_ids[0]] = (
             task.spec["task_id"], lease.conn,
         )
+        task.rt = self
+        task.st = lease
         try:
             # call_soon: no wait_for timer / pending-pop bookkeeping per
             # task (same no-timeout semantics the old timeout=-1 had).
-            # Its skipped write flow control is restored here: past the
-            # backlog budget, await drain so large pipelined arg payloads
-            # pause at the high-water mark instead of buffering unbounded
+            # Its skipped write flow control is restored below: past the
+            # backlog budget, spawn a drain so large pipelined arg
+            # payloads hit the high-water mark instead of buffering
+            # unbounded (pipelining is already capped per lease).
             fut = lease.conn.call_soon("push_task", task.spec)
-            if lease.conn.send_backlog > cfg.rpc_send_backlog_limit_bytes:
-                await lease.conn.drain()
-            reply = await fut
-        except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
-            # OSError included: the backlog drain() raises raw socket
-            # errors (ConnectionResetError) on a mid-write worker death —
-            # they must break the lease and retry/fail like any loss, not
-            # kill the dispatch task silently.  The catch covers ONLY
-            # the wire I/O: once a reply is in hand the task has
-            # executed, and a local failure applying it must not
-            # re-queue a task whose side effects already happened.
-            lease.broken = True
-            if task.retries_left > 0:
-                task.retries_left -= 1
-                st.queue.append(task)
-            else:
-                detail = await self._worker_death_detail(lease.worker_id)
-                self._fail_task(
-                    task,
-                    WorkerCrashedError(
-                        f"worker died while running {task.spec['name']}: "
-                        f"{e}{detail}"
-                    ),
-                )
-        else:
+        except (rpc.ConnectionLost, OSError):
+            self._task_push_failed(task, lease,
+                                   rpc.ConnectionLost("push failed"))
+            self._dispatch_done(task, lease)
+            return
+        fut.add_done_callback(task.on_task_reply)
+        if lease.conn.send_backlog > cfg.rpc_send_backlog_limit_bytes:
+            # over budget after this push: pause dispatch onto this lease
+            # (the pump skips draining/over-budget leases) and resume
+            # pumping when the transport falls below the high-water mark
+            self._drain_then_pump(
+                task.class_key, lease, task.resources, task.strategy
+            )
+
+    def _drain_then_pump(self, class_key, lease: Lease, resources, strategy):
+        """Await the lease connection's transport drain, then pump the
+        class again.  One in-flight drain per lease; this is the awaiting
+        fallback the call_soon contract requires (RT110)."""
+        if lease.draining or lease.broken:
+            return
+        lease.draining = True
+
+        async def _d():
             try:
-                span = None
-                if type(reply) is tuple:
-                    if len(reply) > 2:  # ("i", payload, t0, t1)
-                        span = (reply[2], reply[3])
-                elif reply.get("exec_span"):
-                    span = reply["exec_span"]
-                if span:
-                    t0, t1 = span
-                    self.record_event(
-                        "exec", task.spec["name"],
-                        task.spec["task_id"].hex(),
-                        worker=lease.worker_id.hex()
-                        if hasattr(lease.worker_id, "hex")
-                        else str(lease.worker_id),
-                        start=t0, dur=t1 - t0,
-                    )
-                self._apply_task_reply(task, reply)
-            except Exception as e:  # noqa: BLE001
-                # the task RAN; a local failure applying its reply (e.g.
-                # result deserialization needs a worker-only module) must
-                # fail the ObjectRef, not re-queue the side effects and
-                # not leave the caller hanging on a never-resolved ref
-                self._fail_task(
-                    task, TaskError.from_exception(
-                        e, f"applying reply of {task.spec['name']}"
-                    )
-                )
-        finally:
-            self._inflight_dispatch.pop(task.return_ids[0], None)
-            lease.inflight -= 1
-            if lease.broken:
-                if lease in st.leases:
-                    st.leases.remove(lease)
-                self._spawn(
-                    self.gcs.notify(
-                        "return_lease", {"lease_id": lease.lease_id, "broken": True}
-                    )
-                )
+                await lease.conn.drain()
+            except (rpc.ConnectionLost, OSError):
+                pass  # loss surfaces through the push reply futures
+            finally:
+                lease.draining = False
             self._pump_class(class_key, resources, strategy)
-            if not st.queue and lease.inflight == 0 and not lease.broken:
-                self._schedule_lease_return(class_key, lease)
+
+        self._loop.create_task(_d())
+
+    def _on_task_push_reply(self, task: PendingTask, fut):
+        lease = task.st
+        try:
+            if fut.cancelled():
+                exc = rpc.ConnectionLost("push future cancelled")
+            else:
+                exc = fut.exception()
+            if exc is None:
+                reply = fut.result()
+                try:
+                    span = None
+                    if type(reply) is tuple:
+                        if len(reply) > 2:  # ("i", payload, t0, t1)
+                            span = (reply[2], reply[3])
+                    elif reply.get("exec_span"):
+                        span = reply["exec_span"]
+                    if span:
+                        t0, t1 = span
+                        self.record_event(
+                            "exec", task.spec["name"],
+                            task.spec["task_id"].hex(),
+                            worker=lease.worker_id.hex()
+                            if hasattr(lease.worker_id, "hex")
+                            else str(lease.worker_id),
+                            start=t0, dur=t1 - t0,
+                        )
+                    self._apply_task_reply(task, reply)
+                except Exception as e:  # noqa: BLE001
+                    # the task RAN; a local failure applying its reply
+                    # (e.g. result deserialization needs a worker-only
+                    # module) must fail the ObjectRef, not re-queue the
+                    # side effects and not leave the caller hanging on a
+                    # never-resolved ref
+                    self._fail_task(
+                        task, TaskError.from_exception(
+                            e, f"applying reply of {task.spec['name']}"
+                        )
+                    )
+            elif isinstance(exc, (rpc.ConnectionLost, rpc.RpcError, OSError)):
+                # wire I/O failure ONLY reaches here before a reply is in
+                # hand — break the lease and retry/fail (OSError covers
+                # raw socket errors surfacing through the transport)
+                self._task_push_failed(task, lease, exc)
+            else:
+                self._fail_task(task, TaskError(
+                    "TaskDispatchError", repr(exc), "",
+                    task.spec.get("name", ""),
+                ))
+        finally:
+            self._dispatch_done(task, lease)
+
+    def _task_push_failed(self, task: PendingTask, lease: Lease, exc):
+        st = self._classes[task.class_key]
+        lease.broken = True
+        if task.retries_left > 0:
+            task.retries_left -= 1
+            st.queue.append(task)
+        else:
+            self._spawn(self._fail_task_worker_death(task, lease, exc))
+
+    async def _fail_task_worker_death(self, task, lease, exc):
+        # cold path: asking the GCS why the worker died needs an rpc
+        detail = await self._worker_death_detail(lease.worker_id)
+        self._fail_task(
+            task,
+            WorkerCrashedError(
+                f"worker died while running {task.spec['name']}: "
+                f"{exc}{detail}"
+            ),
+        )
+
+    def _dispatch_done(self, task: PendingTask, lease: Lease):
+        class_key = task.class_key
+        st = self._classes[class_key]
+        self._inflight_dispatch.pop(task.return_ids[0], None)
+        lease.inflight -= 1
+        if lease.broken:
+            if lease in st.leases:
+                st.leases.remove(lease)
+            self._spawn(
+                self.gcs.notify(
+                    "return_lease", {"lease_id": lease.lease_id, "broken": True}
+                )
+            )
+        self._pump_class(class_key, task.resources, task.strategy)
+        if not st.queue and lease.inflight == 0 and not lease.broken:
+            self._schedule_lease_return(class_key, lease)
 
     def _schedule_lease_return(self, class_key, lease: Lease, grace: float = 0.25):
         def _return():
@@ -1673,15 +1992,13 @@ class Runtime:
                 try:
                     self.store.put(oid, reply[1], protect=True)
                     self._shared.add(oid)
-                    self._spawn(
-                        self.gcs.notify(
-                            "add_object_location",
-                            {
-                                "object_id": oid,
-                                "node_id": bytes.fromhex(self.node_id),
-                                "size": len(reply[1]),
-                            },
-                        )
+                    self._gcs_object_notify(
+                        "add_object_location",
+                        {
+                            "object_id": oid,
+                            "node_id": bytes.fromhex(self.node_id),
+                            "size": len(reply[1]),
+                        },
                     )
                 except ObjectExistsError:
                     self._shared.add(oid)
@@ -1711,9 +2028,7 @@ class Runtime:
                     ObjectID.for_task_return(TaskID(tid), i).binary()
                     for i in range(consumed_upto, n)
                 ]
-                self._spawn(
-                    self.gcs.notify("free_objects", {"object_ids": oids})
-                )
+                self._gcs_object_notify("free_objects", {"object_ids": oids})
             return
         self._unhold_for_task(task.dep_oids)
         for oid, ret in zip(task.return_ids, reply["returns"]):
@@ -1729,15 +2044,13 @@ class Runtime:
                     try:
                         self.store.put(oid, ret[1], protect=True)
                         self._shared.add(oid)
-                        self._spawn(
-                            self.gcs.notify(
-                                "add_object_location",
-                                {
-                                    "object_id": oid,
-                                    "node_id": bytes.fromhex(self.node_id),
-                                    "size": len(ret[1]),
-                                },
-                            )
+                        self._gcs_object_notify(
+                            "add_object_location",
+                            {
+                                "object_id": oid,
+                                "node_id": bytes.fromhex(self.node_id),
+                                "size": len(ret[1]),
+                            },
                         )
                     except ObjectExistsError:
                         self._shared.add(oid)
@@ -1931,6 +2244,87 @@ class Runtime:
                 )
             await asyncio.sleep(0.1)
 
+    def make_actor_skeleton(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        num_returns=1,
+        concurrency_group: Optional[str] = None,
+    ) -> tuple:
+        """(spec skeleton, fill_job) for one actor method / option-set —
+        the actor twin of make_task_template, cached by ActorMethod."""
+        skeleton = {
+            "task_id": b"",  # filled per call
+            "actor_id": actor_id.binary(),
+            "method": method_name,
+            "args": (),      # filled per call
+            "num_returns": 1 if num_returns == "streaming" else num_returns,
+            "caller_id": self.worker_id.binary(),
+            # seq/seq_epoch are assigned at push time by the actor pump
+        }
+        if num_returns == "streaming":
+            skeleton["streaming"] = True
+        if concurrency_group:
+            skeleton["concurrency_group"] = concurrency_group
+        fill_job = self.job_id is None
+        if not fill_job:
+            skeleton["job"] = self._job_hex()
+        return skeleton, fill_job
+
+    def submit_actor_task_from_skeleton(
+        self, skeleton: dict, fill_job: bool, args, kwargs, retries: int = 0
+    ):
+        """Hot-path actor submit.  Returns a bare ObjectRef for a single
+        return, a list otherwise, an ObjectRefGenerator when streaming."""
+        aid = skeleton["actor_id"]
+        task_id = os.urandom(16)
+        sub_idx = self._actor_seq.get(aid, 0)
+        self._actor_seq[aid] = sub_idx + 1
+        streaming = "streaming" in skeleton
+        if streaming:
+            retries = 0  # re-running a generator would double-send items
+        spec = dict(skeleton)
+        spec["task_id"] = task_id
+        spec["args"] = self._pack_args(args, kwargs)
+        if fill_job:
+            spec["job"] = self._job_hex()
+        if tracing.enabled():
+            with tracing.span(
+                f"submit {spec['method']}", task_id=task_id.hex(),
+                actor_id=aid.hex(),
+            ):
+                spec["trace_ctx"] = tracing.inject()
+        n = spec["num_returns"]
+        if n == 1:
+            return_ids = (task_return_binary(task_id, 0),)
+        else:
+            return_ids = tuple(
+                task_return_binary(task_id, i) for i in range(n)
+            )
+        dep_oids = () if not spec["args"] else [
+            item[1] if item[0] == "ref" else item[2]
+            for item in spec["args"]
+            if item[0] in ("ref", "kwref")
+        ]
+        task = PendingTask(
+            spec, return_ids, retries, sub_idx=sub_idx, dep_oids=dep_oids
+        )
+        if dep_oids:
+            self._hold_for_task(dep_oids)
+        if streaming:
+            self._streams[task_id] = _StreamBuf()
+            self._submit_to_loop(task)
+            return ObjectRefGenerator(task_id)
+        for oid in return_ids:
+            self.result_futures[oid] = _PENDING_RESULT
+        if n == 1:
+            ref = ObjectRef(ObjectID(return_ids[0]))
+            self._submit_to_loop(task)
+            return ref
+        refs = [ObjectRef(ObjectID(oid)) for oid in return_ids]
+        self._submit_to_loop(task)
+        return refs
+
     def submit_actor_task(
         self,
         actor_id: ActorID,
@@ -1940,56 +2334,18 @@ class Runtime:
         num_returns: int = 1,
         retries: int = 0,
         concurrency_group: Optional[str] = None,
-    ) -> List[ObjectRef]:
-        task_id = TaskID.random()
-        aid = actor_id.binary()
-        sub_idx = self._actor_seq.get(aid, 0)
-        self._actor_seq[aid] = sub_idx + 1
-        streaming = num_returns == "streaming"
-        if streaming:
-            num_returns = 1
-            retries = 0  # re-running a generator would double-send items
-        spec = {
-            "task_id": task_id.binary(),
-            "actor_id": aid,
-            "method": method_name,
-            "args": self._pack_args(args, kwargs),
-            "num_returns": num_returns,
-            "caller_id": self.worker_id.binary(),
-            "job": self._job_hex(),
-            # seq/seq_epoch are assigned at push time by the actor pump
-        }
-        if tracing.enabled():
-            with tracing.span(
-                f"submit {method_name}", task_id=task_id.hex(),
-                actor_id=actor_id.hex(),
-            ):
-                spec["trace_ctx"] = tracing.inject()
-        if streaming:
-            spec["streaming"] = True
-        if concurrency_group:
-            spec["concurrency_group"] = concurrency_group
-        return_ids = [
-            ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
-        ]
-        dep_oids = () if not spec["args"] else [
-            item[1] if item[0] == "ref" else item[2]
-            for item in spec["args"]
-            if item[0] in ("ref", "kwref")
-        ]
-        task = PendingTask(
-            spec, return_ids, retries, sub_idx=sub_idx, dep_oids=dep_oids
+    ):
+        """Untemplated actor submit (compatibility surface); returns a
+        list of refs (or a generator) like it always did."""
+        skeleton, fill_job = self.make_actor_skeleton(
+            actor_id, method_name, num_returns, concurrency_group
         )
-        self._hold_for_task(dep_oids)
-        if streaming:
-            self._streams[task_id.binary()] = _StreamBuf()
-            self._call_on_loop(self._enqueue_actor_task, task)
-            return ObjectRefGenerator(task_id.binary())
-        for oid in return_ids:
-            self.result_futures[oid] = _PENDING_RESULT
-        refs = [ObjectRef(ObjectID(oid)) for oid in return_ids]
-        self._call_on_loop(self._enqueue_actor_task, task)
-        return refs
+        out = self.submit_actor_task_from_skeleton(
+            skeleton, fill_job, args, kwargs, retries
+        )
+        if isinstance(out, ObjectRef):
+            return [out]
+        return out
 
     def _enqueue_actor_task(self, task: PendingTask):
         aid = task.spec["actor_id"]
@@ -2128,7 +2484,14 @@ class Runtime:
         self._inflight_dispatch[task.return_ids[0]] = (
             task.spec["task_id"], conn,
         )
+        task.rt = self
+        task.st = st
+        task.conn = conn
         try:
+            # RT110 audited + baselined: backlog policing lives in the
+            # CALLERS — the pump awaits drain() past the budget after
+            # each push, and the _enqueue_actor_task fast path only
+            # dispatches while send_backlog is under budget
             fut = conn.call_soon("push_actor_task", task.spec)
         except (rpc.ConnectionLost, OSError):
             # Leave the task in st.inflight; the pump reconnects and
@@ -2143,9 +2506,9 @@ class Runtime:
                 st.conn = None
                 st.wake.set()
             return
-        fut.add_done_callback(
-            lambda f: self._on_push_reply(st, conn, task, f)
-        )
+        # bound method, not a closure: rt/st/conn ride the task's slots,
+        # so the reply callback costs one object instead of fn + cells
+        fut.add_done_callback(task.on_push_reply)
 
     def _on_push_reply(
         self, st: ActorClientState, conn, task: PendingTask, fut
@@ -2242,6 +2605,10 @@ class Runtime:
         for oid in oids:
             self.memory_store.pop(oid, None)
             self._shared.discard(oid)
+        # windowed location announces must reach the GCS before the free
+        # (a free seen first plants a tombstone and the late announce is
+        # dropped — the stored primary would never be deleted)
+        self.flush_object_notifies()
         self._run(self.gcs.call("free_objects", {"object_ids": oids}))
 
     # ---- distributed refcounting ---------------------------------------
@@ -2388,15 +2755,15 @@ class Runtime:
             if revisit:
                 self._schedule_ref_flush()
         if (add or dels) and self.gcs and not self.gcs.closed:
-            self._spawn(
-                self.gcs.notify(
-                    "ref_update",
-                    {
-                        "holder": self.worker_id.binary(),
-                        "add": add,
-                        "del": dels,
-                    },
-                )
+            # rides the object-notify coalescer: a ref window that
+            # coincides with pending location announces shares their rpc
+            self._gcs_object_notify(
+                "ref_update",
+                {
+                    "holder": self.worker_id.binary(),
+                    "add": add,
+                    "del": dels,
+                },
             )
 
     def _maybe_release_after_reply(self, oid: bytes):
@@ -2411,18 +2778,19 @@ class Runtime:
             self._release_local(oid)
 
     # ---- lineage + reconstruction --------------------------------------
-    def _record_lineage(self, task: PendingTask, class_key, resources,
-                        strategy, dep_oids):
+    def _record_lineage(self, task: PendingTask):
         if cfg.lineage_reconstruction_max <= 0:
             return
         tid = task.spec["task_id"]
         self._lineage[tid] = {
             "spec": task.spec,
-            "class_key": class_key,
-            "resources": resources,
-            "strategy": strategy,
-            "dep_oids": list(dep_oids),
-            "return_ids": list(task.return_ids),
+            "class_key": task.class_key,
+            "resources": task.resources,
+            "strategy": task.strategy,
+            # dep_oids/return_ids are owned by (or immutable on) the
+            # task — no defensive copies on the submission hot path
+            "dep_oids": task.dep_oids,
+            "return_ids": task.return_ids,
             "budget": cfg.lineage_reconstruction_max,
             "live_returns": set(task.return_ids),
             "inflight": False,
@@ -2476,15 +2844,15 @@ class Runtime:
             task = PendingTask(
                 entry["spec"], entry["return_ids"],
                 retries_left=0,
+                class_key=entry["class_key"],
+                resources=entry["resources"],
+                strategy=entry["strategy"],
             )
             for roid in entry["return_ids"]:
                 if roid not in self.result_futures:
                     self.memory_store.pop(roid, None)
                     self.result_futures[roid] = _PENDING_RESULT
-            self._enqueue_task(
-                entry["class_key"], task, dict(entry["resources"]),
-                entry["strategy"],
-            )
+            self._enqueue_task(task)
             return True
         finally:
             entry["inflight"] = False

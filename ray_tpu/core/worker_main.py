@@ -390,7 +390,7 @@ class WorkerServer:
                 await state["credit"].wait()
                 if spec["task_id"] in self._cancelled:
                     raise TaskCancelledError("cancelled")
-        from ray_tpu.common.ids import ObjectID, TaskID
+        from ray_tpu.common.ids import task_return_binary
 
         if error is not None:
             terr = error if isinstance(error, TaskError) else (
@@ -405,9 +405,7 @@ class WorkerServer:
             if s.total_bytes <= cfg.inline_object_max_bytes:
                 payload = ("inline", s.to_bytes())
             else:
-                oid = ObjectID.for_task_return(
-                    TaskID(spec["task_id"]), idx
-                ).binary()
+                oid = task_return_binary(spec["task_id"], idx)
                 self.rt._write_to_store(oid, s)
                 self.rt._register_edges(oid, nested)
                 payload = ("stored", s.total_bytes)
@@ -427,11 +425,11 @@ class WorkerServer:
             s, nested = self.rt._serialize_tracked(result)
             if s.total_bytes <= cfg.inline_object_max_bytes:
                 return ("i", s.to_bytes())
-            from ray_tpu.common.ids import ObjectID, TaskID
+            from ray_tpu.common.ids import task_return_binary
 
-            oid = ObjectID.for_task_return(
-                TaskID(spec["task_id"]), 0
-            ).binary()
+            oid = task_return_binary(spec["task_id"], 0)
+            # urgent announce: the "stored" reply races the caller's get —
+            # the location must flush this tick, not a window later
             self.rt._write_to_store(oid, s)
             self.rt._register_edges(oid, nested)
             return {"status": "ok", "returns": [("stored", s.total_bytes)]}
@@ -440,9 +438,9 @@ class WorkerServer:
             raise ValueError(
                 f"task declared num_returns={n} but returned {len(values)}"
             )
-        from ray_tpu.common.ids import ObjectID, TaskID
+        from ray_tpu.common.ids import task_return_binary
 
-        task_id = TaskID(spec["task_id"])
+        tid = spec["task_id"]
         returns = []
         for i, v in enumerate(values):
             s, nested = self.rt._serialize_tracked(v)
@@ -451,7 +449,7 @@ class WorkerServer:
                 # refs become live ObjectRefs there — no edge needed
                 returns.append(("inline", s.to_bytes()))
             else:
-                oid = ObjectID.for_task_return(task_id, i).binary()
+                oid = task_return_binary(tid, i)
                 self.rt._write_to_store(oid, s)
                 self.rt._register_edges(oid, nested)
                 returns.append(("stored", s.total_bytes))

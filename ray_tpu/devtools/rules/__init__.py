@@ -11,6 +11,7 @@ Rule ids are stable and grouped by family:
 - RT107 swallowed-cancellation     (async_rules)
 - RT108 unlocked-lazy-init         (concurrency)
 - RT109 blocking-collective-in-async (async_rules)
+- RT110 unpoliced-call-soon-backlog (backlog)
 
 The RT2xx series (actor-deadlock, objectref-leak, unserializable-
 capture, rank-divergent-collective) is the whole-program rtflow tier —
@@ -24,6 +25,7 @@ from ray_tpu.devtools.rules.async_rules import (
     SwallowedCancellation,
     UnawaitedCoroutine,
 )
+from ray_tpu.devtools.rules.backlog import UnpolicedCallSoon
 from ray_tpu.devtools.rules.concurrency import UnlockedLazyInit
 from ray_tpu.devtools.rules.persistence import NonAtomicWrite
 from ray_tpu.devtools.rules.remote_api import (
@@ -42,4 +44,5 @@ ALL_RULES = [
     SwallowedCancellation,
     UnlockedLazyInit,
     BlockingCollectiveInAsync,
+    UnpolicedCallSoon,
 ]

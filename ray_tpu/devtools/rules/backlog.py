@@ -1,0 +1,98 @@
+"""RT110: unpoliced Connection.call_soon (unbounded transport buffering).
+
+``Connection.call_soon`` deliberately skips asyncio's write flow control
+(core/rpc.py documents the contract): the frame is queued/written without
+awaiting ``drain()``, so ``transport.write`` buffers unboundedly.  Every
+call site must therefore police ``send_backlog`` (falling back to an
+awaiting ``drain()`` past its budget) — or be explicitly audited and
+baselined, with the policing documented at the site (e.g. a pump loop
+that drains on behalf of its push helper).
+
+The check is per enclosing function: a ``<conn>.call_soon(...)`` call is
+compliant when the same function also references ``send_backlog`` or
+calls ``.drain(...)``.  Event-loop ``call_soon`` (``loop.call_soon``,
+``get_running_loop().call_soon``) is a different API and is ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+# receiver spellings that mean the asyncio event loop, not an rpc
+# Connection — resolved names and bare attribute chains alike
+_LOOP_NAMES = {"loop", "_loop", "io_loop", "event_loop"}
+_LOOP_FACTORIES = ("get_event_loop", "get_running_loop", "new_event_loop")
+
+
+def _is_event_loop_receiver(func: ast.Attribute) -> bool:
+    base = func.value
+    # loop.call_soon / self._loop.call_soon / rt._loop.call_soon
+    if isinstance(base, ast.Name) and base.id in _LOOP_NAMES:
+        return True
+    if isinstance(base, ast.Attribute) and base.attr in _LOOP_NAMES:
+        return True
+    # asyncio.get_running_loop().call_soon(...)
+    if isinstance(base, ast.Call):
+        f = base.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else ""
+        )
+        if name in _LOOP_FACTORIES:
+            return True
+    return False
+
+
+def _function_polices_backlog(fn_node: ast.AST) -> bool:
+    """True when the function body references ``send_backlog`` or calls
+    ``.drain(...)`` anywhere (including conditions and nested awaits)."""
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Attribute):
+            if node.attr == "send_backlog":
+                return True
+            if node.attr == "drain":
+                return True
+    return False
+
+
+class _CallSoonVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "call_soon"
+            and not _is_event_loop_receiver(func)
+        ):
+            fn = self.current_function
+            if fn is None or not _function_polices_backlog(fn):
+                self.ctx.add(
+                    self.rule, node,
+                    message="`.call_soon(...)` skips rpc write flow "
+                            "control and this function never polices "
+                            "`send_backlog`/`drain()` — the transport "
+                            "buffer can grow without bound under a slow "
+                            "peer",
+                    hint="check `conn.send_backlog` against the budget "
+                         "and `await conn.drain()` past it (or audit the "
+                         "site, document who polices, and baseline it)",
+                )
+        self.generic_visit(node)
+
+
+class UnpolicedCallSoon(Rule):
+    id = "RT110"
+    name = "unpoliced-call-soon-backlog"
+    description = (
+        "Connection.call_soon call site whose enclosing function never "
+        "references send_backlog or drain() — unbounded transport "
+        "buffering under a slow peer"
+    )
+    hint = "police conn.send_backlog and fall back to await conn.drain()"
+    visitor_cls = _CallSoonVisitor

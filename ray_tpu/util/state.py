@@ -20,6 +20,10 @@ def _call(method: str, payload: Optional[dict] = None):
     from ray_tpu.core.runtime import get_runtime
 
     rt = get_runtime()
+    # state reads hit the GCS directory with no server-side wait: this
+    # process's windowed object notifies (put announces, ref updates)
+    # must flush first or a just-put object is invisible to the read
+    rt.flush_object_notifies()
     return rt._run(rt.gcs.call(method, payload or {}))
 
 

@@ -552,6 +552,57 @@ class TestBlockingCollectiveInAsync:
 
 
 # ---------------------------------------------------------------------------
+# RT110 unpoliced-call-soon-backlog
+# ---------------------------------------------------------------------------
+
+
+class TestUnpolicedCallSoon:
+    def test_flags_call_soon_without_backlog_policing(self):
+        src = """
+        def push_all(conn, specs):
+            futs = []
+            for spec in specs:
+                futs.append(conn.call_soon("push_task", spec))
+            return futs
+        """
+        assert rule_ids(src, rules=["RT110"]) == ["RT110"]
+
+    def test_flags_call_soon_at_module_level(self):
+        src = """
+        fut = conn.call_soon("push_task", spec)
+        """
+        assert rule_ids(src, rules=["RT110"]) == ["RT110"]
+
+    def test_silent_when_function_polices_send_backlog(self):
+        # the compliant twin: same push loop, but the function checks
+        # send_backlog and falls back to an awaiting drain()
+        src = """
+        LIMIT = 1 << 20
+
+        async def push_all(conn, specs):
+            futs = []
+            for spec in specs:
+                futs.append(conn.call_soon("push_task", spec))
+                if conn.send_backlog > LIMIT:
+                    await conn.drain()
+            return futs
+        """
+        assert rule_ids(src, rules=["RT110"]) == []
+
+    def test_silent_on_event_loop_call_soon(self):
+        # asyncio's loop.call_soon is a different API with no transport
+        src = """
+        import asyncio
+
+        def schedule(loop, cb, rt):
+            loop.call_soon(cb)
+            rt._loop.call_soon(cb)
+            asyncio.get_running_loop().call_soon(cb)
+        """
+        assert rule_ids(src, rules=["RT110"]) == []
+
+
+# ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, parse errors
 # ---------------------------------------------------------------------------
 
