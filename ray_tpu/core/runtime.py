@@ -472,6 +472,14 @@ class Runtime:
         self._subscriptions[channel] = callback
         self._run(self.gcs.call("subscribe", {"channel": channel}))
 
+    async def subscribe_async(self, channel: str, callback) -> None:
+        """Loop-native twin of subscribe() for callers already ON the io
+        loop (an actor's async method — e.g. the serve proxies
+        subscribing to route-version bumps); `_run` from the loop would
+        deadlock."""
+        self._subscriptions[channel] = callback
+        await self.gcs.call("subscribe", {"channel": channel})
+
     def publish(self, channel: str, message: dict) -> None:
         """Fire-and-forget publish from any thread."""
         self._spawn(
@@ -1061,9 +1069,18 @@ class Runtime:
         return value
 
     def as_future(self, ref: ObjectRef):
-        return asyncio.run_coroutine_threadsafe(
-            self._get_async([ref.object_id.binary()], None), self._loop
-        )
+        """concurrent.futures.Future resolving to the object's VALUE
+        (not the one-element batch list `_get_async` returns) — the
+        thread-safe bridge for awaiting a ref from outside the runtime
+        loop (ObjectRef.future(), serve's loop-agnostic result_async)."""
+
+        async def _one():
+            (value,) = await self._get_async(
+                [ref.object_id.binary()], None
+            )
+            return value
+
+        return asyncio.run_coroutine_threadsafe(_one(), self._loop)
 
     async def _get_async(self, oids: List[bytes], deadline) -> List[Any]:
         results: Dict[bytes, Any] = {}

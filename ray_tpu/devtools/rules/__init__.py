@@ -12,6 +12,7 @@ Rule ids are stable and grouped by family:
 - RT108 unlocked-lazy-init         (concurrency)
 - RT109 blocking-collective-in-async (async_rules)
 - RT110 unpoliced-call-soon-backlog (backlog)
+- RT111 unbounded-serve-dispatch    (backlog)
 
 The RT2xx series (actor-deadlock, objectref-leak, unserializable-
 capture, rank-divergent-collective) is the whole-program rtflow tier —
@@ -25,7 +26,10 @@ from ray_tpu.devtools.rules.async_rules import (
     SwallowedCancellation,
     UnawaitedCoroutine,
 )
-from ray_tpu.devtools.rules.backlog import UnpolicedCallSoon
+from ray_tpu.devtools.rules.backlog import (
+    UnboundedServeDispatch,
+    UnpolicedCallSoon,
+)
 from ray_tpu.devtools.rules.concurrency import UnlockedLazyInit
 from ray_tpu.devtools.rules.persistence import NonAtomicWrite
 from ray_tpu.devtools.rules.remote_api import (
@@ -45,4 +49,5 @@ ALL_RULES = [
     UnlockedLazyInit,
     BlockingCollectiveInAsync,
     UnpolicedCallSoon,
+    UnboundedServeDispatch,
 ]

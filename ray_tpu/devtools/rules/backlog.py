@@ -96,3 +96,96 @@ class UnpolicedCallSoon(Rule):
     )
     hint = "police conn.send_backlog and fall back to await conn.drain()"
     visitor_cls = _CallSoonVisitor
+
+
+# -- RT111: serve dispatch without a bound ---------------------------------
+#
+# Serve's replica dispatch (`<replica>.handle_request.remote(...)` /
+# `.handle_request_stream`) rides the actor pump, which enqueues onto
+# ``Connection.call_soon`` on the caller's behalf — the pump's RT110
+# audit assumes every dispatch layer ABOVE it is bounded.  A dispatch
+# site that consults no bound (the traffic plane's admission controller,
+# the router's in-flight accounting via ``pick``/``max_ongoing``, or the
+# transport's ``send_backlog`` directly) re-creates the unbounded-
+# buffering footgun one layer up: overload accumulates in the replica
+# mailbox and the transport buffer instead of being shed at the door.
+
+#: referencing any of these in the enclosing function counts as
+#: consulting a bound before dispatch
+_DISPATCH_BOUND_ATTRS = {"admission", "send_backlog", "max_ongoing"}
+_DISPATCH_BOUND_CALLS = {"pick", "drain", "check"}
+_DISPATCH_METHODS = {"handle_request", "handle_request_stream"}
+
+
+def _dispatch_method_of(func: ast.Attribute):
+    """The serve dispatch method name when ``func`` is the ``.remote``
+    of ``<x>.handle_request[.options(...)].remote`` — else None."""
+    if func.attr != "remote":
+        return None
+    base = func.value
+    # <x>.handle_request.options(...).remote
+    if isinstance(base, ast.Call) and isinstance(base.func, ast.Attribute):
+        if base.func.attr != "options":
+            return None
+        base = base.func.value
+    if isinstance(base, ast.Attribute) and base.attr in _DISPATCH_METHODS:
+        return base.attr
+    return None
+
+
+def _function_consults_bound(fn_node: ast.AST) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Attribute):
+            if node.attr in _DISPATCH_BOUND_ATTRS:
+                return True
+            if (
+                node.attr in _DISPATCH_BOUND_CALLS
+                and isinstance(getattr(node, "ctx", None), ast.Load)
+            ):
+                return True
+    return False
+
+
+class _ServeDispatchVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            meth = _dispatch_method_of(func)
+            if meth is not None:
+                fn = self.current_function
+                if fn is None or not _function_consults_bound(fn):
+                    self.ctx.add(
+                        self.rule, node,
+                        message=f"`.{meth}.remote(...)` dispatches to a "
+                                "replica without consulting any bound — "
+                                "no admission check, in-flight cap, or "
+                                "send_backlog reference in this "
+                                "function; overload buffers unboundedly "
+                                "in the replica mailbox",
+                        hint="route through the traffic scheduler "
+                             "(admission.check() + bounded queue), or "
+                             "consult router.pick()/max_ongoing before "
+                             "dispatching (or audit + baseline the "
+                             "site)",
+                    )
+        self.generic_visit(node)
+
+
+class UnboundedServeDispatch(Rule):
+    id = "RT111"
+    name = "unbounded-serve-dispatch"
+    description = (
+        "serve replica dispatch site whose enclosing function consults "
+        "no bound (admission, pick/max_ongoing, send_backlog) before "
+        "enqueueing onto the transport"
+    )
+    hint = (
+        "check admission / the router's in-flight cap before dispatch, "
+        "or audit and baseline the site"
+    )
+    visitor_cls = _ServeDispatchVisitor

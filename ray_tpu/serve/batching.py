@@ -42,45 +42,89 @@ class _BatchQueue:
         if len(self._queue) >= self._max:
             self._full.set()
         if self._drainer is None or self._drainer.done():
+            # covers both cold start and restart after idle — and, since
+            # a dead drainer fails every future it stranded on the way
+            # out (below), restart after a drainer crash too
             self._drainer = asyncio.get_running_loop().create_task(
                 self._drain()
             )
         return await fut
 
+    @staticmethod
+    def _fan_out_exception(futs, exc: BaseException) -> None:
+        """EVERY waiter of a failed batch learns the failure — a raising
+        batch fn must never strand a future (the caller would await
+        forever; through serve this wedges a replica slot)."""
+        for f in futs:
+            if not f.done():
+                f.set_exception(exc)
+
     async def _drain(self):
-        while self._queue:
-            # exact wakeup: either the batch fills (submit sets the
-            # event) or the window from the FIRST item elapses
-            if len(self._queue) < self._max:
+        try:
+            while self._queue:
+                # exact wakeup: either the batch fills (submit sets the
+                # event) or the window from the FIRST item elapses
+                if len(self._queue) < self._max:
+                    self._full.clear()
+                    try:
+                        await asyncio.wait_for(
+                            self._full.wait(), timeout=self._wait_s
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+                batch = self._queue[: self._max]
+                del self._queue[: len(batch)]
+                # reset between batches: a set() that filled THIS batch
+                # must not wake the next (possibly partial) batch's wait
+                # before its window — submit re-sets it if the remainder
+                # already fills a batch
                 self._full.clear()
+                if len(self._queue) >= self._max:
+                    self._full.set()
+                items = [b[0] for b in batch]
+                futs = [b[1] for b in batch]
                 try:
-                    await asyncio.wait_for(
-                        self._full.wait(), timeout=self._wait_s
-                    )
-                except asyncio.TimeoutError:
-                    pass
-            batch = self._queue[: self._max]
-            del self._queue[: len(batch)]
-            items = [b[0] for b in batch]
-            futs = [b[1] for b in batch]
-            try:
-                if self._owner is not None:
-                    results = await self._fn(self._owner, items)
-                else:
-                    results = await self._fn(items)
-                if len(results) != len(items):
-                    raise ValueError(
-                        f"@serve.batch function returned {len(results)} "
-                        f"results for {len(items)} inputs"
-                    )
-            except Exception as e:
-                for f in futs:
+                    if self._owner is not None:
+                        results = await self._fn(self._owner, items)
+                    else:
+                        results = await self._fn(items)
+                    if len(results) != len(items):
+                        raise ValueError(
+                            f"@serve.batch function returned "
+                            f"{len(results)} results for {len(items)} "
+                            f"inputs"
+                        )
+                except (asyncio.CancelledError, GeneratorExit) as e:
+                    # the drainer task (or the batch fn from inside) was
+                    # cancelled / closed: fail this batch's waiters, then
+                    # honor the cancellation — the finally fans out to
+                    # the rest of the queue
+                    self._fan_out_exception(futs, e)
+                    raise
+                except Exception as e:  # noqa: BLE001 — fan-out
+                    self._fan_out_exception(futs, e)
+                    continue
+                except BaseException as e:
+                    # SystemExit/KeyboardInterrupt: tell this batch's
+                    # waiters, then let the process-level signal
+                    # propagate — the serve loop must not eat it
+                    self._fan_out_exception(futs, e)
+                    raise
+                for f, r in zip(futs, results):
                     if not f.done():
-                        f.set_exception(e)
-                continue
-            for f, r in zip(futs, results):
-                if not f.done():
-                    f.set_result(r)
+                        f.set_result(r)
+        finally:
+            # abnormal exit (cancellation, loop teardown): everything
+            # still queued must fail fast rather than hang — the next
+            # submit starts a fresh drainer either way
+            if self._queue:
+                pending = self._queue[:]
+                del self._queue[: len(pending)]
+                self._fan_out_exception(
+                    [f for _, f in pending],
+                    RuntimeError("@serve.batch drainer stopped with "
+                                 "requests queued"),
+                )
 
 
 def batch(

@@ -26,12 +26,25 @@ logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
+#: GCS pubsub channel for route-table version bumps: proxies subscribe
+#: and fetch the full table only when the version moves, instead of an
+#: unbatched get_routes read per 1 s poll (the bump notify rides the
+#: same batched rpc plane as every other GCS push)
+ROUTES_CHANNEL = "serve:routes"
+
 
 class _DeploymentState:
     def __init__(self, app_name: str, deployment):
         self.app_name = app_name
         self.deployment = deployment
         self.replicas: List[Any] = []  # ActorHandles
+        # scale-down victims finishing in-flight work: (handle, stop
+        # deadline).  Excluded from get_routes, so routers stop picking
+        # them; killed once idle or past the drain timeout.
+        self.draining: List[tuple] = []
+        # queue-depth reports from traffic-plane schedulers:
+        # reporter id -> (monotonic timestamp, snapshot dict)
+        self.traffic_reports: Dict[Any, tuple] = {}
         self.target = (
             deployment.autoscaling_config.min_replicas
             if deployment.autoscaling_config
@@ -43,6 +56,16 @@ class _DeploymentState:
     @property
     def name(self) -> str:
         return self.deployment.name
+
+    def traffic_wire(self):
+        tc = getattr(self.deployment, "traffic_config", None)
+        if tc is None:
+            return None
+        return tc.to_wire() if hasattr(tc, "to_wire") else dict(tc)
+
+    def drain_timeout_s(self) -> float:
+        tc = getattr(self.deployment, "traffic_config", None)
+        return getattr(tc, "drain_timeout_s", 30.0) if tc else 30.0
 
 
 @ray_tpu.remote
@@ -100,6 +123,7 @@ class ServeControllerActor:
                 states[d.name] = _DeploymentState(app_name, d)
             self._routes_version += 1
         self._reconcile_once()
+        self._publish_routes_version()
         return True
 
     def get_app_root(self, app_name: str):
@@ -117,6 +141,7 @@ class ServeControllerActor:
                     del self._http_routes[prefix]
                     self._asgi_prefixes.discard(prefix)
             self._routes_version += 1
+        self._publish_routes_version()
         return True
 
     def set_route_prefix(
@@ -132,6 +157,7 @@ class ServeControllerActor:
             else:
                 self._asgi_prefixes.discard(prefix)
             self._routes_version += 1
+        self._publish_routes_version()
         return True
 
     def remove_route_prefix(self, prefix: str) -> bool:
@@ -140,7 +166,23 @@ class ServeControllerActor:
             self._asgi_prefixes.discard(prefix)
             if removed:
                 self._routes_version += 1
+        if removed:
+            self._publish_routes_version()
         return removed
+
+    def _publish_routes_version(self):
+        """Push the current route version on the GCS pubsub plane so
+        proxies refresh on change instead of polling with a full
+        get_routes read every second (the push itself coalesces into
+        the per-tick BATCH frames like any other GCS notify)."""
+        from ray_tpu.core.runtime import get_runtime
+
+        with self._lock:
+            v = self._routes_version
+        try:
+            get_runtime().publish(ROUTES_CHANNEL, {"version": v})
+        except Exception:
+            logger.debug("routes version publish failed", exc_info=True)
 
     def _drain(self, st: _DeploymentState):
         for r in st.replicas:
@@ -149,6 +191,13 @@ class ServeControllerActor:
             except Exception:
                 pass
         st.replicas = []
+        # app deleted / redeployed: draining replicas lose their grace
+        for r, _deadline in st.draining:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        st.draining = []
 
     # -- reconcile -------------------------------------------------------
     def _reconcile_loop(self):
@@ -197,9 +246,11 @@ class ServeControllerActor:
 
     def _reconcile_once(self):
         with self._reconcile_mutex:
-            self._reconcile_locked()
+            changed = self._reconcile_locked()
+        if changed:
+            self._publish_routes_version()
 
-    def _reconcile_locked(self):
+    def _reconcile_locked(self) -> bool:
         changed = False
         for st in self._snapshot():
             alive = self._check_health(st.replicas)
@@ -235,21 +286,88 @@ class ServeControllerActor:
                     except Exception:
                         pass
             for _ in range(max(0, to_remove)):
+                # drain-then-stop: the victim leaves the route table NOW
+                # (routers stop picking it on their next refresh) but
+                # keeps running until its in-flight requests finish —
+                # scale-down must never turn admitted requests into
+                # replica-death errors
                 with self._lock:
                     victim = (
                         st.replicas.pop()
                         if self._is_current(st) and st.replicas
                         else None
                     )
+                    if victim is not None:
+                        st.draining.append((
+                            victim,
+                            time.monotonic() + st.drain_timeout_s(),
+                        ))
                 if victim is not None:
-                    try:
-                        ray_tpu.kill(victim)
-                    except Exception:
-                        pass
                     changed = True
+            if st.draining:
+                # NOT folded into `changed`: a drained victim already
+                # left the route table when draining began, so killing
+                # it must not bump the version and fan a fleet-wide
+                # get_routes re-read out to every proxy
+                self._sweep_draining(st)
         if changed:
             with self._lock:
                 self._routes_version += 1
+        return changed
+
+    def _sweep_draining(self, st: _DeploymentState) -> None:
+        """Stop draining replicas that are idle (queue_len 0), dead, or
+        past their drain deadline.  Probes are batched like
+        _check_health — one busy draining replica must not stall the
+        single reconcile thread for everyone; a replica that doesn't
+        answer within the window just stays draining until the next
+        sweep (or its deadline)."""
+        with self._lock:
+            draining = list(st.draining)
+        if not draining:
+            return
+        now = time.monotonic()
+        refs = [r.queue_len.remote() for r, _ in draining]
+        ready, _pending = ray_tpu.wait(
+            refs, num_returns=len(refs), timeout=5.0, fetch_local=True
+        )
+        ready_set = set(ready)
+        stopped = []
+        for (replica, deadline), ref in zip(draining, refs):
+            stop = now >= deadline
+            if not stop and ref in ready_set:
+                try:
+                    stop = ray_tpu.get(ref, timeout=1) == 0
+                except Exception:
+                    stop = True  # dead already
+            if stop:
+                try:
+                    ray_tpu.kill(replica)
+                except Exception:
+                    pass
+                stopped.append(replica)
+        if not stopped:
+            return
+        with self._lock:
+            st.draining = [
+                (r, d) for r, d in st.draining if r not in stopped
+            ]
+
+    def _queued_depth(self, st: _DeploymentState, now: float) -> float:
+        """Sum of queued (admitted, undispatched) requests across the
+        traffic-plane schedulers that reported recently.  Stale
+        reporters (a proxy that died or went idle) age out so a
+        vanished queue cannot pin the deployment scaled up."""
+        tc = getattr(st.deployment, "traffic_config", None)
+        horizon = 3.0 * getattr(tc, "stats_push_interval_s", 0.5) + 2.0
+        total = 0.0
+        with self._lock:
+            for reporter, (t, snap) in list(st.traffic_reports.items()):
+                if now - t > horizon:
+                    del st.traffic_reports[reporter]
+                    continue
+                total += float(snap.get("queued", 0))
+        return total
 
     def _autoscale(self):
         now = time.monotonic()
@@ -263,7 +381,11 @@ class ServeControllerActor:
                 )
             except Exception:
                 continue
-            total = float(sum(lens))
+            # autoscaling signal = replica-ongoing PLUS scheduler queue
+            # depth: under admission control replicas never see more
+            # than max_ongoing at once, so the queue — where overload
+            # actually accumulates — must drive the scale-up
+            total = float(sum(lens)) + self._queued_depth(st, now)
             desired = max(
                 asc.min_replicas,
                 min(
@@ -284,6 +406,20 @@ class ServeControllerActor:
                     st.last_scale_up = now
                     st.last_scale_down = now
 
+    # -- traffic-plane stats ingest --------------------------------------
+    def report_traffic_stats(
+        self, app_name: str, deployment_name: str, reporter, snapshot: dict
+    ) -> bool:
+        """Fire-and-forget depth/rate push from a RequestScheduler
+        (one per routing process).  Reports are keyed by reporter so
+        several proxies sum, not clobber."""
+        with self._lock:
+            st = self._apps.get(app_name, {}).get(deployment_name)
+            if st is None:
+                return False
+            st.traffic_reports[reporter] = (time.monotonic(), dict(snapshot))
+        return True
+
     # -- discovery (handles / proxies poll this) -------------------------
     def get_routes(self) -> dict:
         with self._lock:
@@ -293,6 +429,7 @@ class ServeControllerActor:
                     name: {
                         "replicas": list(st.replicas),
                         "max_ongoing": st.deployment.max_ongoing_requests,
+                        "traffic": st.traffic_wire(),
                     }
                     for name, st in states.items()
                 }
@@ -310,6 +447,7 @@ class ServeControllerActor:
                     name: {
                         "target_replicas": st.target,
                         "running_replicas": len(st.replicas),
+                        "draining_replicas": len(st.draining),
                     }
                     for name, st in states.items()
                 }

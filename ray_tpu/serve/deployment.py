@@ -34,6 +34,27 @@ class Deployment:
     max_ongoing_requests: int = 100
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     user_config: Any = None
+    # SLO/queueing policy (serve/traffic/config.py TrafficConfig); None
+    # keeps the direct pow-2 dispatch path with no admission control
+    traffic_config: Any = None
+
+    def __post_init__(self):
+        # normalize HERE, not only in the decorator: .options(
+        # autoscaling_config={...}) / .options(traffic_config={...})
+        # go through dataclasses.replace (the declarative schema's
+        # override path too), and a raw dict would crash the
+        # controller's `.min_replicas` access resp. make its
+        # attribute-based traffic accessors (drain_timeout_s,
+        # stats_push_interval_s) silently fall back to defaults.
+        # Strict kwargs so a typo'd key raises at definition time.
+        if isinstance(self.autoscaling_config, dict):
+            self.autoscaling_config = AutoscalingConfig(
+                **self.autoscaling_config
+            )
+        if isinstance(self.traffic_config, dict):
+            from ray_tpu.serve.traffic.config import TrafficConfig
+
+            self.traffic_config = TrafficConfig(**self.traffic_config)
 
     def options(self, **kwargs) -> "Deployment":
         return dataclasses.replace(self, **kwargs)
@@ -84,6 +105,7 @@ def deployment(
     autoscaling_config: Optional[dict] = None,
     max_ongoing_requests: int = 100,
     ray_actor_options: Optional[dict] = None,
+    traffic_config: Optional[dict] = None,
 ):
     """@serve.deployment decorator (ray: serve/api.py:248)."""
 
@@ -91,6 +113,8 @@ def deployment(
         asc = autoscaling_config
         if isinstance(asc, dict):
             asc = AutoscalingConfig(**asc)
+        # traffic_config dicts normalize in Deployment.__post_init__
+        tc = traffic_config
         return Deployment(
             func_or_class=target,
             name=name or target.__name__,
@@ -98,6 +122,7 @@ def deployment(
             autoscaling_config=asc,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options or {},
+            traffic_config=tc,
         )
 
     if _func_or_class is not None:

@@ -18,6 +18,7 @@ import logging
 from typing import Any, Dict
 
 import ray_tpu
+from ray_tpu.serve.traffic.config import RequestShedError
 
 logger = logging.getLogger(__name__)
 
@@ -32,6 +33,8 @@ class GrpcProxyActor:
         self._routes: Dict[str, Any] = {}
         self._routes_version = -1
         self._last_poll = 0.0
+        self._last_full_read = 0.0
+        self._published_version = None  # serve:routes pubsub bumps
         self._handles: Dict[str, Any] = {}
         self._controller = None
 
@@ -41,6 +44,21 @@ class GrpcProxyActor:
         if self._server is not None:
             return self._port
 
+        # version-bump subscription: same protocol as the HTTP proxy —
+        # the per-request poll skips its get_routes read while the
+        # published version matches what we already hold
+        try:
+            from ray_tpu.core.runtime import get_runtime
+            from ray_tpu.serve.controller import ROUTES_CHANNEL
+
+            def _on_bump(msg: dict) -> None:
+                self._published_version = msg.get("version")
+
+            await get_runtime().subscribe_async(ROUTES_CHANNEL, _on_bump)
+        except Exception:
+            logger.debug("routes subscription failed; falling back to "
+                         "polling", exc_info=True)
+
         outer = self
 
         class _Generic(grpc.GenericRpcHandler):
@@ -49,7 +67,19 @@ class GrpcProxyActor:
                 md = dict(handler_call_details.invocation_metadata or ())
 
                 async def unary(request_bytes, context):
-                    return await outer._dispatch(method, md, request_bytes)
+                    try:
+                        return await outer._dispatch(
+                            method, md, request_bytes
+                        )
+                    except RequestShedError as e:
+                        # overload answer: RESOURCE_EXHAUSTED + machine-
+                        # readable backoff hint in trailing metadata
+                        context.set_trailing_metadata((
+                            ("retry-after-s", f"{e.retry_after_s:.3f}"),
+                        ))
+                        await context.abort(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
+                        )
 
                 return grpc.unary_unary_rpc_method_handler(
                     unary,
@@ -75,10 +105,20 @@ class GrpcProxyActor:
     def _poll_routes(self, force: bool = False):
         import time
 
+        from ray_tpu.serve.proxy import ROUTE_POLL_S, ROUTE_RECHECK_S
+
         now = time.monotonic()
-        if not force and now - self._last_poll < 1.0:
+        if not force and now - self._last_poll < ROUTE_POLL_S:
             return
         self._last_poll = now
+        if (
+            not force
+            and self._published_version is not None
+            and self._published_version == self._routes_version
+            and now - self._last_full_read < ROUTE_RECHECK_S
+        ):
+            return  # subscription says nothing moved: skip the read
+        self._last_full_read = now
         if self._controller is None:
             from ray_tpu.serve.controller import get_or_create_controller
 
@@ -106,6 +146,9 @@ class GrpcProxyActor:
             h = self._handles[prefix] = DeploymentHandle(
                 self._controller, app, deployment
             )
+            # wire-decoded args can never hold a DeploymentResponse:
+            # skip the chained-arg scan in remote()
+            h._args_known_plain = True
         return h
 
     async def _dispatch(self, method: str, metadata: Dict[str, str],
@@ -138,13 +181,26 @@ class GrpcProxyActor:
                 prefix = route if route in self._routes else None
             if prefix is None:
                 return None
-            return self._handle_for(prefix).remote(*args, **kwargs)
+            handle = self._handle_for(prefix)
+            # traffic-plane deployments dispatch on the io loop (same
+            # policy as the HTTP proxy: the scheduler is loop-bound)
+            r = handle._router
+            if r._version < 0:
+                try:
+                    r._refresh(force=True)
+                except Exception:
+                    pass  # dispatch will surface routing errors
+            if handle.traffic_config is not None:
+                return ("traffic", handle)
+            return handle.remote(*args, **kwargs)
 
         resp = await asyncio.get_running_loop().run_in_executor(
             None, _route_and_dispatch
         )
         if resp is None:
             raise RuntimeError(f"no serve application at route {route!r}")
+        if isinstance(resp, tuple) and resp[0] == "traffic":
+            resp = resp[1].remote(*args, **kwargs)
         value = await resp.result_async()
         if isinstance(value, bytes):
             return value

@@ -29,6 +29,14 @@ class Router:
         self._inflight: Dict[Any, int] = {}
         self._last_refresh = 0.0
         self._lock = threading.Lock()
+        # deployment policy, learned on refresh: concurrency cap per
+        # replica and the traffic plane's wire config (None = traffic
+        # plane inactive, direct dispatch)
+        self.max_ongoing: int = 100
+        self.traffic: Optional[dict] = None
+        # one RequestScheduler per deployment per process, shared by
+        # every handle.options() copy (they share this Router)
+        self._traffic_scheduler = None
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -47,6 +55,8 @@ class Router:
         with self._lock:
             self._version = routes["version"]
             self._replicas = entry["replicas"]
+            self.max_ongoing = entry.get("max_ongoing", 100)
+            self.traffic = entry.get("traffic")
             self._inflight = {
                 r: self._inflight.get(r, 0) for r in self._replicas
             }
@@ -85,12 +95,25 @@ class Router:
                     0, self._inflight[replica] - 1
                 )
 
+    def note_dispatch(self, replica):
+        """An external dispatcher (the traffic scheduler) routed a
+        request to `replica`: count it in the pow-2 load signal, so
+        direct-path picks see scheduler-created load AND so the
+        response's _settle() done() call has a matching increment
+        (without this, every scheduled completion would erase one
+        DIRECT request's in-flight count)."""
+        with self._lock:
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+
     def drop(self, replica):
         """Replica died mid-call: drop it until the next refresh."""
         with self._lock:
             self._replicas = [r for r in self._replicas if r != replica]
             self._inflight.pop(replica, None)
         self._last_refresh = 0.0
+        sched = self._traffic_scheduler
+        if sched is not None:
+            sched.drop_replica_threadsafe(replica)
 
 
 class DeploymentResponse:
@@ -136,10 +159,15 @@ class DeploymentResponse:
                 # count so pow-2 doesn't pile more load onto it
                 raise
             except ActorDiedError:
-                self._settle()
+                # no _settle() here: drop() erases the dead replica's
+                # in-flight entry wholesale, and _done must stay False
+                # so the eventual settle releases the RETRY's pick —
+                # settling now would leak the new replica's count
+                # forever (Router._refresh preserves counts)
                 self._router.drop(self._replica)
                 self._attempts -= 1
                 if self._attempts <= 0:
+                    self._settle()
                     raise
                 self._replica, self._ref = self._redispatch()
                 continue
@@ -153,25 +181,39 @@ class DeploymentResponse:
         """Async twin of result() with the same replica-death failover —
         awaits on the io loop instead of blocking a thread (used by the
         HTTP proxy so slow replicas can't exhaust its executor threads).
-        Redispatch (which blocks on route refresh) runs in an executor."""
+        Redispatch (which blocks on route refresh) runs in an executor.
+        Loop-agnostic: on the runtime's own io loop (proxy/replica
+        actors) the await is direct; any other asyncio loop (driver
+        code under asyncio.run) bridges via the thread-safe future —
+        the runtime's futures are bound to ITS loop and cannot be
+        awaited across loops."""
         import asyncio
 
         from ray_tpu.core.errors import ActorDiedError
         from ray_tpu.core.runtime import get_runtime
 
         rt = get_runtime()
+        on_rt_loop = asyncio.get_running_loop() is rt._loop
         if self._ref is None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._ensure_dispatched
             )
         while True:
             try:
-                value = await rt.await_ref(self._ref)
+                if on_rt_loop:
+                    value = await rt.await_ref(self._ref)
+                else:
+                    value = await asyncio.wrap_future(
+                        rt.as_future(self._ref)
+                    )
             except ActorDiedError:
-                self._settle()
+                # mirror of result(): drop() cleans up the dead replica;
+                # settling before the redispatch would strand the
+                # retry's pick increment (see there)
                 self._router.drop(self._replica)
                 self._attempts -= 1
                 if self._attempts <= 0:
+                    self._settle()
                     raise
                 loop = asyncio.get_running_loop()
                 self._replica, self._ref = await loop.run_in_executor(
@@ -226,6 +268,57 @@ class DeploymentResponse:
     def ref(self):
         self._ensure_dispatched()
         return self._ref
+
+
+class _ScheduledResponse(DeploymentResponse):
+    """DeploymentResponse whose FIRST dispatch rides the traffic
+    scheduler: construction enqueued the request (EDF-ordered, bounded,
+    shed-on-overload); the submit future resolves to (replica, ref) at
+    dispatch time or raises RequestShedError.  Failover after a replica
+    death falls back to the direct dispatch closure — the retry is one
+    request, not a burst, so it skips the queue."""
+
+    def __init__(self, router: Router, submit_fut, redispatch):
+        import concurrent.futures
+
+        super().__init__(router, None, None, redispatch)
+        self._submit_fut = submit_fut  # asyncio.Future on the scheduler loop
+        # mirror for sync callers (result()/.ref from non-loop threads);
+        # the scheduler's expiry sweep guarantees resolution by deadline
+        self._mirror: "concurrent.futures.Future" = (
+            concurrent.futures.Future()
+        )
+
+        def _copy(f):
+            if f.cancelled():
+                self._mirror.cancel()
+                return
+            exc = f.exception()
+            if exc is not None:
+                self._mirror.set_exception(exc)
+            else:
+                self._mirror.set_result(f.result())
+
+        submit_fut.add_done_callback(_copy)
+
+    def _ensure_dispatched(self):
+        with self._dispatch_lock:
+            if self._ref is None:
+                self._replica, self._ref = self._mirror.result()
+
+    async def result_async(self):
+        if self._ref is None:
+            # loop-native wait for the scheduler's dispatch: no executor
+            # thread parks per queued request, so an overload backlog
+            # cannot exhaust the shared pool (the admission queue holds
+            # the requests; this coroutine holds ~nothing).  Caller
+            # cancellation propagates to the submit future, which the
+            # scheduler's flush skips and un-counts.
+            replica, ref = await self._submit_fut
+            with self._dispatch_lock:
+                if self._ref is None:
+                    self._replica, self._ref = replica, ref
+        return await super().result_async()
 
 
 class DeploymentResponseGenerator:
@@ -333,6 +426,7 @@ class DeploymentHandle:
         method_name: str = "__call__",
         stream: bool = False,
         multiplexed_model_id: str = "",
+        slo_ms: Optional[float] = None,
     ):
         self._controller = controller
         self._app = app_name
@@ -340,6 +434,11 @@ class DeploymentHandle:
         self._method = method_name
         self._stream = stream
         self._model_id = multiplexed_model_id
+        self._slo_ms = slo_ms  # per-handle SLO override (traffic plane)
+        # proxies set this on their cached handles: args parsed from an
+        # HTTP/gRPC body can never contain a DeploymentResponse, so the
+        # chained-arg deep scan in remote() (O(payload)) is skipped
+        self._args_known_plain = False
         self._router = Router(controller, app_name, deployment_name)
 
     def options(
@@ -347,6 +446,7 @@ class DeploymentHandle:
         method_name: Optional[str] = None,
         stream: Optional[bool] = None,
         multiplexed_model_id: Optional[str] = None,
+        slo_ms: Optional[float] = None,
     ) -> "DeploymentHandle":
         h = DeploymentHandle(
             self._controller,
@@ -356,9 +456,75 @@ class DeploymentHandle:
             stream if stream is not None else self._stream,
             multiplexed_model_id
             if multiplexed_model_id is not None else self._model_id,
+            slo_ms if slo_ms is not None else self._slo_ms,
         )
         h._router = self._router  # share routing state
+        h._args_known_plain = self._args_known_plain
         return h
+
+    @property
+    def traffic_config(self) -> Optional[dict]:
+        """The deployment's wire-form TrafficConfig, learned from the
+        route table (None until the router first refreshes, and for
+        deployments without a traffic plane)."""
+        return self._router.traffic
+
+    def _scheduler(self):
+        """The shared per-deployment RequestScheduler bound to the
+        RUNNING loop, or None when the traffic plane is inactive or the
+        scheduler belongs to a different loop (fall back to direct
+        dispatch rather than cross loops)."""
+        import asyncio
+
+        tc_wire = self._router.traffic
+        if tc_wire is None:
+            return None
+        from ray_tpu.serve.traffic import RequestScheduler, TrafficConfig
+
+        loop = asyncio.get_running_loop()
+        sched = self._router._traffic_scheduler
+        if sched is not None and sched._loop.is_closed():
+            # the loop the scheduler was born on is gone (driver code
+            # under a finished asyncio.run): rebuild on the current one
+            # instead of silently disabling admission control forever —
+            # anything still queued there was already dead with its loop
+            sched = None
+            self._router._traffic_scheduler = None
+        if sched is None:
+            sched = RequestScheduler(
+                self._router, self._controller, self._app,
+                self._deployment, TrafficConfig.from_wire(tc_wire),
+            )
+            sched._wire_config = tc_wire
+            self._router._traffic_scheduler = sched
+        elif sched._wire_config is not tc_wire:
+            # the router refreshed (new wire dict object): if a redeploy
+            # changed the policy, apply it to the live scheduler in
+            # place (rebuilding would lose the in-flight accounting for
+            # requests already dispatched).  The identity guard keeps
+            # the per-request cost at one `is`; the deep compare runs
+            # once per route refresh.
+            if sched._wire_config != tc_wire:
+                cfg = TrafficConfig.from_wire(tc_wire)
+                sched.config = cfg
+                sched.admission.config = cfg
+            sched._wire_config = tc_wire
+        return sched if sched._loop is loop else None
+
+    @staticmethod
+    def _contains_response(v) -> bool:
+        """Chained-arg probe: scheduler dispatch must not have to block
+        on a nested response's lazy dispatch (loop-deadlock hazard), so
+        chained calls keep the direct executor-dispatched path."""
+        if isinstance(v, DeploymentResponse):
+            return True
+        if isinstance(v, (list, tuple)):
+            return any(DeploymentHandle._contains_response(x) for x in v)
+        if isinstance(v, dict):
+            return any(
+                DeploymentHandle._contains_response(x) for x in v.values()
+            )
+        return False
 
     def remote(self, *args, **kwargs):
         import asyncio
@@ -433,6 +599,21 @@ class DeploymentHandle:
             return replica, ref
 
         if on_loop:
+            # traffic plane: deployments with a TrafficConfig route
+            # through the SLO-aware scheduler (admission + EDF + bounded
+            # queue) — loop-native, non-blocking, sheds synchronously
+            # with RequestShedError.  Chained-response args keep the
+            # direct path (their lazy inner dispatch may block).
+            if self._args_known_plain or not (
+                any(map(self._contains_response, args))
+                or any(map(self._contains_response, kwargs.values()))
+            ):
+                sched = self._scheduler()
+                if sched is not None:
+                    fut = sched.submit(
+                        self._method, args, kwargs, self._slo_ms
+                    )
+                    return _ScheduledResponse(self._router, fut, dispatch)
             # inside an event loop (a replica composing over this handle,
             # or any async caller): dispatch must not block the loop —
             # defer it; result_async/await runs it on an executor thread

@@ -28,6 +28,9 @@ Wire-up::
 from __future__ import annotations
 
 import asyncio
+import heapq
+import itertools
+import time
 from typing import Any, List, Optional
 
 from ray_tpu import serve
@@ -73,19 +76,40 @@ class LLMEngine:
             self.cache_len = max_len
         self.cache = llama.init_cache(config, max_slots, self.cache_len)
         self.slots: List[Optional[_Slot]] = [None] * max_slots
-        self._pending: "asyncio.Queue" = asyncio.Queue()
+        # slot admitter queue: EDF heap of
+        # (deadline, seq, prompt, max_new, out_queue) — requests with a
+        # traffic-plane SLO overtake deadline-less ones (deadline=inf)
+        # at the free slot, and expired waiters are shed before prefill
+        self._pending: List[tuple] = []
+        self._admit_seq = itertools.count()
         self._runner: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
+        # admitter counters (bench / tests)
+        self.admitted_total = 0
+        self.shed_total = 0
 
     # -- client side -----------------------------------------------------
     async def stream(self, prompt: List[int], max_new_tokens: int = 16):
-        """Async generator of generated token ids for one request."""
+        """Async generator of generated token ids for one request.
+
+        Captures the traffic plane's per-request deadline (when the
+        request came through a TrafficConfig'd deployment) at submit
+        time — the contextvar is only live in the submitting task — so
+        the slot admitter can order prefill admissions EDF and shed
+        requests whose SLO already lapsed in the replica's own queue.
+        """
+        from ray_tpu.serve.traffic.config import get_request_deadline
+
         if self._runner is None or self._runner.done():
             self._runner = asyncio.get_running_loop().create_task(
                 self._run()
             )
         q: asyncio.Queue = asyncio.Queue()
-        await self._pending.put((list(prompt), int(max_new_tokens), q))
+        deadline = get_request_deadline()
+        heapq.heappush(self._pending, (
+            deadline if deadline is not None else float("inf"),
+            next(self._admit_seq), list(prompt), int(max_new_tokens), q,
+        ))
         self._wake.set()
         while True:
             tok = await q.get()
@@ -114,8 +138,8 @@ class LLMEngine:
                         await s.queue.put(e)
                         await s.queue.put(_END)
                         self.slots[i] = None
-                while not self._pending.empty():
-                    _, _, q = self._pending.get_nowait()
+                while self._pending:
+                    _, _, _, _, q = heapq.heappop(self._pending)
                     await q.put(e)
                     await q.put(_END)
                 self.cache = self._llama.init_cache(
@@ -129,9 +153,28 @@ class LLMEngine:
         llama = self._llama
         cfg = self.config
         while True:
-            # admit pending requests into free slots (prefill)
-            while not self._pending.empty() and None in self.slots:
-                prompt, max_new, q = self._pending.get_nowait()
+            # admit pending requests into free slots (prefill), EDF:
+            # the earliest-deadline waiter takes the free cache row, and
+            # a waiter whose deadline lapsed in this queue is shed —
+            # prefill compute for a response the client already gave up
+            # on would only delay every live slot's next token
+            while self._pending and None in self.slots:
+                deadline, _, prompt, max_new, q = heapq.heappop(
+                    self._pending
+                )
+                if deadline <= time.monotonic():
+                    from ray_tpu.serve.traffic.config import (
+                        RequestShedError,
+                    )
+
+                    self.shed_total += 1
+                    await q.put(RequestShedError(
+                        "SLO budget exhausted before a decode slot "
+                        "freed up"
+                    ))
+                    await q.put(_END)
+                    continue
+                self.admitted_total += 1
                 if max_new <= 0:  # exact budget: zero tokens requested
                     await q.put(_END)
                     continue
@@ -171,7 +214,7 @@ class LLMEngine:
             if not active:
                 # idle: park until a request arrives
                 self._wake.clear()
-                if self._pending.empty():
+                if not self._pending:
                     await self._wake.wait()
                 continue
             # one fused decode step over ALL slots (inactive rows decode

@@ -14,11 +14,16 @@ import logging
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve.traffic.config import RequestShedError
 
 logger = logging.getLogger(__name__)
 
 
 ROUTE_POLL_S = 1.0
+#: with the version-bump subscription live, a full get_routes read only
+#: happens when the published version moves — plus this slow safety
+#: recheck for a lost publish (GCS restart races)
+ROUTE_RECHECK_S = 10.0
 
 
 @ray_tpu.remote
@@ -29,6 +34,11 @@ class ProxyActor:
         self._asgi_prefixes: set = set()  # prefixes served via @serve.ingress
         self._routes_version = -1
         self._last_poll = 0.0
+        self._last_full_read = 0.0
+        # route-table version from the controller's serve:routes pubsub
+        # bumps (None until the first publish arrives); lets _poll_routes
+        # skip the unbatched get_routes read while nothing changed
+        self._published_version: Optional[int] = None
         self._handles: Dict[str, Any] = {}
         self._controller = None
         self._runner = None
@@ -49,6 +59,23 @@ class ProxyActor:
 
     async def _do_start(self) -> int:
         from aiohttp import web
+
+        # route-table refresh rides the GCS pubsub plane: the controller
+        # publishes version bumps (coalesced into the per-tick BATCH
+        # frames like every GCS notify), so the per-request poll below
+        # degrades to a no-op while the table is unchanged instead of an
+        # unbatched get_routes read per second
+        try:
+            from ray_tpu.core.runtime import get_runtime
+            from ray_tpu.serve.controller import ROUTES_CHANNEL
+
+            def _on_bump(msg: dict) -> None:
+                self._published_version = msg.get("version")
+
+            await get_runtime().subscribe_async(ROUTES_CHANNEL, _on_bump)
+        except Exception:
+            logger.debug("routes subscription failed; falling back to "
+                         "polling", exc_info=True)
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self._handle)
@@ -73,6 +100,15 @@ class ProxyActor:
         if not force and now - self._last_poll < ROUTE_POLL_S:
             return
         self._last_poll = now
+        if (
+            not force
+            and self._published_version is not None
+            and self._published_version == self._routes_version
+            and now - self._last_full_read < ROUTE_RECHECK_S
+        ):
+            # subscription says nothing moved: skip the read entirely
+            return
+        self._last_full_read = now
         if self._controller is None:
             from ray_tpu.serve.controller import get_or_create_controller
 
@@ -95,6 +131,9 @@ class ProxyActor:
         if h is None:
             app_name, dep_name = self._routes[prefix]
             h = DeploymentHandle(self._controller, app_name, dep_name)
+            # args come from a parsed HTTP body — they can never hold a
+            # DeploymentResponse, so remote() skips the chained-arg scan
+            h._args_known_plain = True
             self._handles[prefix] = h
         return h
 
@@ -181,6 +220,20 @@ class ProxyActor:
                         method_name=method_name or "__call__",
                         stream=want_stream,
                     )
+                # traffic-plane deployments dispatch ON the io loop (the
+                # scheduler is loop-bound and admission sheds
+                # synchronously); learn the policy here, where blocking
+                # on a route refresh is allowed.  Streams and plain
+                # deployments keep the direct executor dispatch.
+                if not want_stream:
+                    r = handle._router
+                    if r._version < 0:
+                        try:
+                            r._refresh(force=True)
+                        except Exception:
+                            pass  # dispatch will surface routing errors
+                    if handle.traffic_config is not None:
+                        return ("traffic", handle), False
                 return handle.remote(*args, **kwargs), False
 
             resp, is_asgi = await asyncio.get_running_loop().run_in_executor(
@@ -188,6 +241,10 @@ class ProxyActor:
             )
             if resp is None:
                 return web.Response(status=404, text="no route")
+            if isinstance(resp, tuple) and resp[0] == "traffic":
+                # on-loop dispatch: admission check + EDF enqueue (pure
+                # arithmetic + a heap push — nothing here blocks)
+                resp = resp[1].remote(*args, **kwargs)
             if is_asgi:
                 r = await resp.result_async()
                 headers = {
@@ -238,6 +295,19 @@ class ProxyActor:
             # failover — HTTP clients get the same retry semantics as
             # handle-API callers instead of a bare 500.
             value = await resp.result_async()
+        except RequestShedError as e:
+            # load shed: fast-fail with the standard overload answer so
+            # clients back off instead of retry-storming (Retry-After is
+            # whole seconds per RFC 9110)
+            import math
+
+            return web.Response(
+                status=503,
+                headers={
+                    "Retry-After": str(max(1, math.ceil(e.retry_after_s)))
+                },
+                text=str(e),
+            )
         except Exception as e:  # noqa: BLE001 — surface as 500
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         if isinstance(value, (dict, list)):

@@ -88,6 +88,7 @@ class ReplicaActor:
         try:
             args, kwargs = await self._resolve_chained(args, kwargs)
             kwargs = self._apply_multiplex(kwargs)
+            kwargs = self._apply_deadline(kwargs)
             if self._is_function:
                 target = self._callable
             else:
@@ -112,6 +113,29 @@ class ReplicaActor:
             )
         return kwargs
 
+    @staticmethod
+    def _apply_deadline(kwargs):
+        """Pop the traffic scheduler's remaining-SLO-budget kwarg and
+        re-anchor it against THIS process's monotonic clock (budgets
+        cross the wire as durations — clocks don't transfer), exposing
+        the deadline via serve.traffic.get_request_deadline() for the
+        LLM slot admitter and any deadline-aware user code."""
+        from ray_tpu.serve.traffic import config as traffic_config
+
+        if traffic_config.DEADLINE_KWARG in kwargs:
+            import time
+
+            kwargs = dict(kwargs)
+            budget_s = kwargs.pop(traffic_config.DEADLINE_KWARG)
+            traffic_config.set_request_deadline(
+                time.monotonic() + float(budget_s)
+            )
+        else:
+            # actor reuse: a prior deadline must not leak into a request
+            # that arrived without one
+            traffic_config.set_request_deadline(None)
+        return kwargs
+
     async def handle_request_stream(self, method: str, args, kwargs):
         """Streaming call: the target must return a (async) generator or
         iterable; items ride the core streaming-generator transport
@@ -124,6 +148,7 @@ class ReplicaActor:
         try:
             args, kwargs = await self._resolve_chained(args, kwargs)
             kwargs = self._apply_multiplex(kwargs)
+            kwargs = self._apply_deadline(kwargs)
             if self._is_function:
                 target = self._callable
             else:

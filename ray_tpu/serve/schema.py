@@ -36,6 +36,9 @@ class DeploymentOverride:
     max_ongoing_requests: Optional[int] = None
     autoscaling_config: Optional[dict] = None
     ray_actor_options: Optional[dict] = None
+    # SLO/queueing policy override (serve/traffic TrafficConfig fields);
+    # normalized by Deployment.__post_init__ like the decorator path
+    traffic_config: Optional[dict] = None
 
     @staticmethod
     def from_dict(d: dict) -> "DeploymentOverride":
@@ -103,6 +106,8 @@ def _apply_overrides(app: Application, overrides: List[DeploymentOverride]):
         changes["autoscaling_config"] = o.autoscaling_config
     if o.ray_actor_options is not None:
         changes["ray_actor_options"] = o.ray_actor_options
+    if o.traffic_config is not None:
+        changes["traffic_config"] = o.traffic_config
     return Application(d.options(**changes))
 
 

@@ -603,6 +603,57 @@ class TestUnpolicedCallSoon:
 
 
 # ---------------------------------------------------------------------------
+# RT111 unbounded-serve-dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestUnboundedServeDispatch:
+    def test_flags_dispatch_without_any_bound(self):
+        src = """
+        def route(replica, method, args, kwargs):
+            return replica.handle_request.remote(method, args, kwargs)
+        """
+        assert rule_ids(src, rules=["RT111"]) == ["RT111"]
+
+    def test_flags_stream_dispatch_through_options(self):
+        src = """
+        def route(replica, method, args, kwargs):
+            return replica.handle_request_stream.options(
+                num_returns="streaming"
+            ).remote(method, args, kwargs)
+        """
+        assert rule_ids(src, rules=["RT111"]) == ["RT111"]
+
+    def test_silent_when_admission_checked(self):
+        # the compliant twin: same dispatch, behind the traffic plane's
+        # admission gate (bounded queue + shed)
+        src = """
+        def route(sched, replica, method, args, kwargs):
+            sched.admission.check()
+            return replica.handle_request.remote(method, args, kwargs)
+        """
+        assert rule_ids(src, rules=["RT111"]) == []
+
+    def test_silent_when_inflight_cap_consulted(self):
+        src = """
+        def route(router, replicas, method, args, kwargs):
+            replica = router.pick(replicas, router.max_ongoing)
+            if replica is None:
+                return None
+            return replica.handle_request.remote(method, args, kwargs)
+        """
+        assert rule_ids(src, rules=["RT111"]) == []
+
+    def test_silent_on_unrelated_remote_calls(self):
+        # only serve's replica-dispatch methods are in scope
+        src = """
+        def other(actor, x):
+            return actor.do_work.remote(x)
+        """
+        assert rule_ids(src, rules=["RT111"]) == []
+
+
+# ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, parse errors
 # ---------------------------------------------------------------------------
 
