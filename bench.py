@@ -525,6 +525,88 @@ def bench_pg_churn(ray_tpu, duration_s=3.0):
     return _timed_loop(one, duration_s, chunk=10)
 
 
+def bench_fault_recovery(ray_tpu):
+    """Time-to-first-successful-result after an injected fault — the
+    number the robustness plane is accountable for.
+
+    Task leg: with a warm lease, the next push_task frame to the worker
+    is chaos-reset (site rpc.send.frame, driver-side, deterministic);
+    the lease breaks, the task requeues onto a fresh lease, and the
+    clock stops at the result.  Collective leg: a 3-rank group loses one
+    member to ray_tpu.kill; the clock runs from the kill through
+    reform_collective_group (shrink to 2) to the first bit-exact
+    allreduce among the survivors.
+    """
+    import numpy as np
+
+    from ray_tpu.common import faults
+    from ray_tpu.util import collective as col
+
+    @ray_tpu.remote(max_retries=2)
+    def probe():
+        return 1
+
+    ray_tpu.get(probe.remote(), timeout=60)  # warm lease + worker
+    faults.install([faults.FaultPlan(
+        site="rpc.send.frame", match="->worker", action="reset", nth=1,
+    )])
+    try:
+        t0 = time.perf_counter()
+        assert ray_tpu.get(probe.remote(), timeout=120) == 1
+        task_ms = (time.perf_counter() - t0) * 1e3
+        fired = len(faults.trace())
+    finally:
+        faults.clear()
+    if not fired:
+        raise RuntimeError("worker-conn reset never fired; task leg invalid")
+
+    @ray_tpu.remote
+    class _Rank:
+        def init(self, world, rank, group):
+            col.init_collective_group(world, rank, group_name=group)
+            return True
+
+        def reform(self, world, group):
+            col.reform_collective_group(world, group_name=group)
+            return True
+
+        def allreduce(self, arr, group):
+            return col.allreduce(arr, group_name=group)
+
+    # collective leg failures must not discard the task-leg measurement
+    # (each leg gets its own bench row): report the error alongside
+    collective_ms = None
+    collective_err = None
+    try:
+        group = "bench-fault-recovery"
+        ranks = [_Rank.options(num_cpus=0).remote() for _ in range(3)]
+        ray_tpu.get(
+            [m.init.remote(3, i, group) for i, m in enumerate(ranks)],
+            timeout=120,
+        )
+        data = np.arange(65536, dtype=np.float32)
+        ray_tpu.get([m.allreduce.remote(data, group) for m in ranks],
+                    timeout=120)  # warm the ring
+        ray_tpu.kill(ranks[1])
+        survivors = [ranks[0], ranks[2]]
+        t0 = time.perf_counter()
+        ray_tpu.get([m.reform.remote(2, group) for m in survivors],
+                    timeout=120)
+        out = ray_tpu.get(
+            [m.allreduce.remote(data, group) for m in survivors],
+            timeout=120,
+        )
+        collective_ms = (time.perf_counter() - t0) * 1e3
+        for o in out:
+            assert np.array_equal(o, data + data)
+        for m in survivors:
+            ray_tpu.kill(m)
+    except Exception as e:  # noqa: BLE001
+        collective_err = repr(e)
+    return {"task_ms": task_ms, "collective_ms": collective_ms,
+            "collective_err": collective_err}
+
+
 def bench_serve_rps(ray_tpu, service_ms=100.0, max_ongoing=4,
                     slo_ms=750.0, max_queue_depth=12,
                     steady_s=4.0, overload_s=5.0):
@@ -911,6 +993,29 @@ def main():
                     )
                 except Exception as e:  # noqa: BLE001
                     emit("serve_rps_overload", 0.0, "req/s", error=repr(e))
+            # fault recovery: time-to-first-result after an injected
+            # worker-conn reset (task plane) and after a collective
+            # member kill + reform — the robustness plane's quotable row
+            if remaining() > 45:
+                try:
+                    fr = bench_fault_recovery(ray_tpu)
+                    emit(
+                        "fault_recovery_task_ms", fr["task_ms"], "ms",
+                        note="first result after injected worker-conn "
+                             "reset; max_retries=2, warm lease",
+                    )
+                    if fr["collective_ms"] is not None:
+                        emit(
+                            "fault_recovery_collective_ms",
+                            fr["collective_ms"], "ms",
+                            note="3-rank group: kill 1 member, reform "
+                                 "to 2, first bit-exact allreduce",
+                        )
+                    else:
+                        emit("fault_recovery_collective_ms", 0.0, "ms",
+                             error=fr["collective_err"])
+                except Exception as e:  # noqa: BLE001
+                    emit("fault_recovery_task_ms", 0.0, "ms", error=repr(e))
         finally:
             ray_tpu.shutdown()
     except Exception as e:  # noqa: BLE001

@@ -16,6 +16,8 @@ import subprocess
 import threading
 from typing import Optional
 
+from ray_tpu.common import faults
+
 _SRC = os.path.join(os.path.dirname(__file__), "shm_store.cc")
 _SO = os.path.join(os.path.dirname(__file__), "libshm_store.so")
 
@@ -268,6 +270,16 @@ class ShmStore:
     def create(self, object_id: bytes, size: int) -> memoryview:
         """Reserve space; returns a writable view. Must seal() or abort()."""
         object_id = _check_id(object_id)
+        fault_ctl = faults.ACTIVE  # bind once: clear() races the check
+        if fault_ctl is not None:
+            # chaos site store.put: an injected arena-pressure failure —
+            # callers must survive it exactly like a genuinely full
+            # arena (spill request + bounded retry in _write_to_store)
+            plan = fault_ctl.hit("store.put", object_id.hex())
+            if plan is not None and plan.action == "error":
+                raise StoreFullError(
+                    f"injected arena put failure for {object_id.hex()[:12]}"
+                )
         off = ctypes.c_uint64()
         rc = self._lib.rt_store_create_object(
             self._h, object_id, ctypes.c_uint64(size), ctypes.byref(off)

@@ -182,4 +182,26 @@ D("ref_flush_interval_s", float, 0.05)  # batch window for holder updates
 D("lineage_reconstruction_max", int, 3)  # re-executions per lost task
 D("gcs_free_delay_s", float, 0.5)  # grace before freeing unreferenced objects
 
+# --- retry/backoff (common/backoff.py: the one shared exponential-
+# backoff-with-jitter policy; every knob below parameterizes a call
+# site of it — no retry loop hand-rolls its own schedule, rtlint RT112
+# flags the unbounded-no-backoff shape) ---
+D("backoff_base_s", float, 0.05)
+D("backoff_mult", float, 2.0)
+D("backoff_max_s", float, 2.0)
+D("backoff_jitter_frac", float, 0.1)
+# client-side object pull retries in Runtime._resolve_one (previously
+# the literal `failed_pulls < 8` and `sleep(min(0.2*n, 2.0))` ladder)
+D("pull_retry_max", int, 8)
+D("pull_retry_base_s", float, 0.2)
+D("pull_retry_max_s", float, 2.0)
+# failed pulls tolerated before an infinite-deadline wait (ray_tpu.wait)
+# surfaces the object as lost (was a literal 4)
+D("pull_retry_infinite_max", int, 4)
+# deadline-bounded get() retry poll (was a literal asyncio.sleep(0.05))
+D("get_retry_poll_s", float, 0.05)
+# ReconnectingConnection dial loop (was 0.1 doubling to a literal 2.0)
+D("reconnect_backoff_base_s", float, 0.1)
+D("reconnect_backoff_max_s", float, 2.0)
+
 cfg = _Config()

@@ -1,0 +1,293 @@
+"""Deterministic fault injection: named sites + seeded plans + chaos driver.
+
+The runtime's recovery paths (lease breaks, pull retries, GCS restarts,
+collective poisoning/re-formation) are only trustworthy if a failure can
+be injected *at a named site, on a chosen hit, reproducibly*.  This
+module is that plane:
+
+- **Sites** are string-named hooks threaded through the hot paths
+  (``rpc.send.frame``, ``rpc.recv.msg``, ``raylet.lease.grant``,
+  ``store.put``, ``collective.peer_conn``; the full registry is in
+  docs/architecture.md).  Each site guards itself with
+  ``if faults.ACTIVE is not None:`` — with ``RT_FAULTS`` unset the hook
+  is a single module-attribute None check: no allocation, no branch
+  taken, pinned by an alloc assertion in test_taskplane_batching.py.
+
+- **FaultPlan** selects when a site fires: exact ``site`` name, an
+  optional ``match`` substring against the site's context string, an
+  ``nth``-matching-hit window (``nth``/``count``) or a seeded
+  probability ``p``.  Decisions consume a per-plan ``random.Random(seed)``
+  only on *matching* hits, so the same plan over the same hit sequence
+  fires identically — bit-for-bit — across runs.
+
+- **Actions** are interpreted by the site: ``drop`` (message/frame
+  vanishes), ``delay`` (re-delivered after ``delay_s``), ``dup``
+  (delivered twice), ``error`` (the call fails with an injected
+  RpcError / the store raises StoreFullError), ``reset`` (transport
+  aborted), ``kill`` (the granted worker is hard-killed).
+
+Activation: programmatic ``install(plans)`` in-process, or the
+``RT_FAULTS`` environment variable carrying a JSON list of plan dicts —
+the env form is inherited by raylet/worker/GCS subprocesses, so a test
+can arm a fault inside a process it never touches directly.  Every
+firing is recorded; ``trace()`` is the determinism contract tests
+assert on.
+
+``ChaosController`` is the driver-side half for process-level faults a
+site hook cannot express (GCS kill/restart, whole-node kill) — it wraps
+a ``cluster_utils.Cluster`` and logs every event it applies, so a chaos
+schedule is replayable from its log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = [
+    "ACTIVE",
+    "ChaosController",
+    "FaultController",
+    "FaultPlan",
+    "clear",
+    "install",
+    "plans_from_json",
+    "plans_to_json",
+    "trace",
+]
+
+ENV_VAR = "RT_FAULTS"
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """One deterministic fault: where, when, and what to inject.
+
+    ``site``    exact injection-site name (see the registry in docs).
+    ``action``  drop | delay | dup | error | reset | kill — interpreted
+                by the site.  Firing is traced at SELECTION time, so
+                keep the action matched to what the site implements
+                (the registry in docs/architecture.md lists each
+                site's supported actions); a selected-but-unsupported
+                action is a no-op at the site yet still appears in
+                ``trace()``.
+    ``match``   optional substring the site's context string must
+                contain for the hit to count (e.g. an rpc method name).
+    ``nth``     1-based matching-hit number the window opens at.
+    ``count``   how many consecutive matching hits fire from ``nth``.
+    ``p``       when > 0, replaces the window: each matching hit at or
+                past ``nth`` fires with probability ``p`` drawn from the
+                plan's own ``random.Random(seed)`` stream.
+    ``delay_s`` delay for ``action="delay"``.
+    """
+
+    site: str
+    action: str = "error"
+    match: Optional[str] = None
+    nth: int = 1
+    count: int = 1
+    p: float = 0.0
+    seed: int = 0
+    delay_s: float = 0.05
+
+    def to_dict(self) -> dict:
+        d = {"site": self.site, "action": self.action, "nth": self.nth,
+             "count": self.count, "seed": self.seed}
+        if self.match is not None:
+            d["match"] = self.match
+        if self.p:
+            d["p"] = self.p
+        if self.action == "delay":
+            d["delay_s"] = self.delay_s
+        return d
+
+    _FIELDS = ("site", "action", "match", "nth", "count", "p", "seed",
+               "delay_s")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        unknown = set(d) - set(cls._FIELDS)
+        if unknown:
+            # a typo'd field (e.g. "mach" for "match") silently widening
+            # or disarming a plan makes the chaos test lie — fail loudly,
+            # matching the RT_FAULTS malformed-plan contract
+            raise ValueError(
+                f"FaultPlan has no field(s) {sorted(unknown)}; "
+                f"valid fields: {list(cls._FIELDS)}"
+            )
+        return cls(**{k: d[k] for k in cls._FIELDS if k in d})
+
+
+class _Armed:
+    """Mutable per-plan firing state (hit counter + seeded rng)."""
+
+    __slots__ = ("plan", "hits", "rng")
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.hits = 0
+        self.rng = random.Random(plan.seed)
+
+
+class FaultController:
+    """Evaluates every armed plan at each site hit; records firings.
+
+    Thread-safe: hits arrive from io-loop threads and caller threads of
+    every runtime in the process.  The lock is only ever taken while a
+    controller is installed — the disabled path never reaches here.
+    """
+
+    def __init__(self, plans: Sequence[FaultPlan]):
+        self._armed: List[_Armed] = [_Armed(p) for p in plans]
+        self._trace: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def hit(self, site: str, ctx: str = "") -> Optional[FaultPlan]:
+        """Register one hit at ``site``; returns the plan to apply (the
+        first armed plan whose selector fires) or None.
+
+        EVERY matching plan counts the hit (and, in ``p`` mode, draws
+        from its rng) even when an earlier plan already fired — each
+        plan's firing schedule is a pure function of the matching-hit
+        sequence, independent of which other plans are armed."""
+        fired: Optional[FaultPlan] = None
+        with self._lock:
+            for a in self._armed:
+                plan = a.plan
+                if plan.site != site:
+                    continue
+                if plan.match is not None and plan.match not in ctx:
+                    continue
+                a.hits += 1
+                if plan.p > 0.0:
+                    fire = a.hits >= plan.nth and a.rng.random() < plan.p
+                else:
+                    fire = plan.nth <= a.hits < plan.nth + plan.count
+                if fire and fired is None:
+                    fired = plan
+                    self._trace.append({
+                        "site": site,
+                        "ctx": ctx,
+                        "hit": a.hits,
+                        "action": plan.action,
+                    })
+        return fired
+
+    def trace(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._trace]
+
+
+# The one module-level switch every site checks.  None = disabled; the
+# site guard is then a single LOAD + is-None test with zero allocations.
+ACTIVE: Optional[FaultController] = None
+
+
+def install(plans: Sequence[FaultPlan]) -> FaultController:
+    """Arm ``plans`` in this process (replaces any prior controller;
+    counters and trace start fresh)."""
+    global ACTIVE
+    ACTIVE = FaultController(plans)
+    return ACTIVE
+
+
+def clear() -> None:
+    """Disarm fault injection in this process."""
+    global ACTIVE
+    ACTIVE = None
+
+
+def trace() -> List[Dict[str, Any]]:
+    """Firings recorded by the installed controller ([] when disabled)."""
+    return ACTIVE.trace() if ACTIVE is not None else []
+
+
+def plans_to_json(plans: Sequence[FaultPlan]) -> str:
+    return json.dumps([p.to_dict() for p in plans])
+
+
+def plans_from_json(text: str) -> List[FaultPlan]:
+    return [FaultPlan.from_dict(d) for d in json.loads(text)]
+
+
+def _activate_from_env() -> None:
+    text = os.environ.get(ENV_VAR)
+    if not text:
+        return
+    # a malformed plan must fail LOUDLY: chaos silently disabled by a
+    # typo'd env var is a test that stops testing anything
+    install(plans_from_json(text))
+
+
+_activate_from_env()
+
+
+# ---------------------------------------------------------------------------
+# ChaosController: driver-side process-level faults
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _ChaosEvent:
+    event: str
+    detail: dict = field(default_factory=dict)
+    ts: float = 0.0
+
+
+class ChaosController:
+    """Scripted process-level chaos against a ``cluster_utils.Cluster``.
+
+    Site hooks cover in-process faults; killing whole processes (the
+    GCS, a raylet and its workers) is driven from here.  Every applied
+    event is appended to ``log`` in order, so a chaos schedule is
+    reproducible: same seed + same method sequence ⇒ same victims.
+    """
+
+    def __init__(self, cluster, seed: int = 0):
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+        self.log: List[dict] = []
+
+    def _record(self, event: str, **detail) -> None:
+        self.log.append({"event": event, "detail": detail,
+                         "ts": time.monotonic()})
+
+    # -- GCS (head) faults ----------------------------------------------
+    def kill_gcs(self) -> None:
+        """kill -9 the control plane (clients hold ReconnectingConnections
+        and must ride the outage)."""
+        self.cluster.kill_gcs()
+        self._record("gcs_kill")
+
+    def restart_gcs(self, timeout: float = 30.0) -> None:
+        """Restart the GCS on the same port/session dir; state restores
+        from the WAL + checkpoint and clients re-attach."""
+        self.cluster.restart_gcs(timeout=timeout)
+        self._record("gcs_restart")
+
+    def gcs_outage(self, down_s: float = 0.5, timeout: float = 30.0) -> None:
+        """kill -9, hold the control plane down for ``down_s``, restart."""
+        self.kill_gcs()
+        time.sleep(down_s)
+        self.restart_gcs(timeout=timeout)
+
+    # -- node faults -----------------------------------------------------
+    def kill_node(self, node=None, graceful: bool = False):
+        """Kill a raylet (and its workers).  ``node=None`` picks a
+        seeded-random victim among the non-head nodes (falling back to
+        the head when it is the only node)."""
+        if node is None:
+            pool = [n for n in self.cluster._nodes
+                    if n is not self.cluster.head_node]
+            pool = pool or list(self.cluster._nodes)
+            if not pool:
+                raise RuntimeError("no nodes to kill")
+            node = self.rng.choice(pool)
+        self.cluster.remove_node(node, allow_graceful=graceful)
+        self._record("node_kill", node_id=node.node_id, graceful=graceful)
+        return node

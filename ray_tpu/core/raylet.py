@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
 from ray_tpu._native.store import ShmStore, default_capacity
+from ray_tpu.common import faults
 from ray_tpu.common.config import cfg
 from ray_tpu.common.ids import NodeID, WorkerID
 from ray_tpu.core import rpc
@@ -494,6 +495,22 @@ class Raylet:
         self.workers[worker_id] = entry
         return entry
 
+    def _chaos_on_lease_grant(self, w: "WorkerEntry") -> None:
+        """Chaos site ``raylet.lease.grant``: fires as a lease is handed
+        out.  ``kill`` hard-kills the granted worker — the client's push
+        then fails, the lease breaks, and the task-plane retry path
+        (requeue → fresh lease → resubmit) runs for real.  This is the
+        deterministic nth-hit lease-break the chaos suite drives."""
+        fault_ctl = faults.ACTIVE  # re-read: clear() races the caller's check
+        if fault_ctl is None:
+            return
+        plan = fault_ctl.hit("raylet.lease.grant", w.worker_id.hex())
+        if plan is not None and plan.action == "kill":
+            logger.warning(
+                "chaos: killing worker %s on lease grant", w.worker_id
+            )
+            self._hard_kill_worker(w)
+
     @staticmethod
     def _hard_kill_worker(w: "WorkerEntry"):
         """SIGKILL that actually reaches containerized workers: the run
@@ -802,6 +819,8 @@ class Raylet:
             if w is not None:
                 w.lease_id = p["lease_id"]
                 w.leased_at = time.monotonic()
+                if faults.ACTIVE is not None:
+                    self._chaos_on_lease_grant(w)
                 return {
                     "worker_id": w.worker_id.binary(),
                     "worker_addr": w.addr,
@@ -875,6 +894,8 @@ class Raylet:
             self._release_accel_env(accel_env)
         w.lease_id = p["lease_id"]
         w.leased_at = time.monotonic()
+        if faults.ACTIVE is not None:
+            self._chaos_on_lease_grant(w)
         return {
             "worker_id": w.worker_id.binary(),
             "worker_addr": w.addr,

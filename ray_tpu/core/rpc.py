@@ -30,8 +30,11 @@ import itertools
 import logging
 import pickle
 import struct
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
+from ray_tpu.common import faults
+from ray_tpu.common.backoff import Backoff, BackoffPolicy
 from ray_tpu.common.config import cfg
 
 logger = logging.getLogger(__name__)
@@ -151,6 +154,22 @@ class Connection:
         as separate writes costs 2-3 syscalls per message — the dominant
         per-RPC term for control-plane traffic.  Large buffers still pass
         through uncopied (a memcpy of a big payload beats nothing)."""
+        fault_ctl = faults.ACTIVE  # bind once: clear() races the check
+        if fault_ctl is not None:
+            # chaos site rpc.send.frame: drop (frame vanishes — the peer
+            # simply never sees these messages) or reset (transport
+            # aborted; both sides observe ConnectionLost and run their
+            # real loss paths)
+            plan = fault_ctl.hit("rpc.send.frame", self.name)
+            if plan is not None:
+                if plan.action == "drop":
+                    return
+                if plan.action == "reset":
+                    try:
+                        self.writer.transport.abort()
+                    except Exception:
+                        pass
+                    return
         header = bytearray(_U32.pack(len(bufs)))
         total = 0
         for b in bufs:
@@ -310,7 +329,56 @@ class Connection:
             await self._shutdown()
 
     def _dispatch_msg(self, kind, msg_id, method, payload):
-        """Route one inbound message (loop-only, called by the recv loop)."""
+        """Route one inbound message (loop-only, called by the recv
+        loop) — chaos site ``rpc.recv.msg`` guards the real dispatch,
+        so drop/delay/dup/error faults apply per MESSAGE (batched and
+        plain frames alike)."""
+        fault_ctl = faults.ACTIVE  # bind once: clear() races the check
+        if fault_ctl is not None:
+            plan = fault_ctl.hit("rpc.recv.msg", f"{self.name}:{method}")
+            if plan is not None and self._inject_recv_fault(
+                plan, kind, msg_id, method, payload
+            ):
+                return
+        self._dispatch_msg_now(kind, msg_id, method, payload)
+
+    def _inject_recv_fault(self, plan, kind, msg_id, method, payload) -> bool:
+        """Apply one recv-side fault; True = normal dispatch replaced."""
+        act = plan.action
+        if act == "drop":
+            return True
+        if act == "dup":
+            # deliver one extra copy; the wrapper delivers the original
+            self._dispatch_msg_now(kind, msg_id, method, payload)
+            return False
+        if act == "delay":
+            asyncio.get_running_loop().call_later(
+                plan.delay_s, self._dispatch_msg_now,
+                kind, msg_id, method, payload,
+            )
+            return True
+        if act == "error":
+            injected = RpcError(f"injected fault at rpc.recv.msg:{method}")
+            if kind == REQUEST:
+                # the handler never runs; the caller sees a remote error
+                try:
+                    self._send_soon((RESPONSE_ERR, msg_id, method, injected))
+                except ConnectionLost:
+                    pass
+            elif kind == RESPONSE_OK or kind == RESPONSE_ERR:
+                # the reply arrives as a failure
+                self._dispatch_msg_now(RESPONSE_ERR, msg_id, method, injected)
+            # NOTIFY: no reply channel — an errored notify is a drop
+            return True
+        if act == "reset":
+            try:
+                self.writer.transport.abort()
+            except Exception:
+                pass
+            return True
+        return False
+
+    def _dispatch_msg_now(self, kind, msg_id, method, payload):
         if kind == REQUEST:
             asyncio.get_running_loop().create_task(
                 self._handle_request(msg_id, method, payload)
@@ -514,10 +582,18 @@ class ReconnectingConnection:
                 raise ConnectionLost(f"{self.name}: channel closed")
             if self._conn is not None and not self._conn.closed:
                 return self._conn
-            deadline = (
-                asyncio.get_running_loop().time() + self.max_downtime_s
+            # shared deadline-aware backoff (common/backoff.py): dials
+            # de-correlate across the fleet via jitter, and the last
+            # sleep clamps to the remaining downtime budget
+            redial_backoff = Backoff(
+                BackoffPolicy(
+                    base_s=cfg.reconnect_backoff_base_s,
+                    mult=cfg.backoff_mult,
+                    max_s=cfg.reconnect_backoff_max_s,
+                    jitter_frac=cfg.backoff_jitter_frac,
+                ),
+                deadline=time.monotonic() + self.max_downtime_s,
             )
-            delay = 0.1
             first_attempt = self._conn is None
             while True:
                 conn = None
@@ -542,15 +618,13 @@ class ReconnectingConnection:
                         e, (OSError, RpcError, asyncio.TimeoutError)
                     ):
                         raise
-                    if asyncio.get_running_loop().time() >= deadline:
+                    if not await redial_backoff.wait():
                         if self.on_give_up:
                             self.on_give_up()
                         raise ConnectionLost(
                             f"{self.name}: peer at {self.address} unreachable "
                             f"for {self.max_downtime_s:.0f}s ({e!r})"
                         ) from e
-                    await asyncio.sleep(delay)
-                    delay = min(delay * 2, 2.0)
 
     async def call(self, method: str, payload: Any = None, timeout: float = None):
         while True:

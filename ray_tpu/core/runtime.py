@@ -35,6 +35,7 @@ from ray_tpu._native.store import (
     StoreError,
     StoreFullError,
 )
+from ray_tpu.common.backoff import Backoff, BackoffPolicy
 from ray_tpu.common.config import cfg
 from ray_tpu.common.ids import (
     ActorID,
@@ -195,6 +196,18 @@ class SchedClassState:
 
 
 _PENDING_RESULT = object()  # lazy marker: locally-pending result, no async waiter yet
+
+
+def lease_pending_backoff() -> Backoff:
+    """Backoff between LEASE_PENDING re-requests.  The request_lease
+    call itself parks at the GCS until woken or expired, so this sleep
+    exists only to DE-CORRELATE re-requests across classes/callers —
+    capped well under the grant cadence (a 2 s tail here would idle
+    freed capacity).  Shared by both lease loops and sched_bench."""
+    return Backoff(BackoffPolicy(
+        base_s=cfg.backoff_base_s, mult=cfg.backoff_mult,
+        max_s=0.25, jitter_frac=cfg.backoff_jitter_frac,
+    ))
 
 
 class Runtime:
@@ -1133,6 +1146,8 @@ class Runtime:
 
     async def _resolve_one(self, oid: bytes, deadline) -> Any:
         failed_pulls = 0
+        pull_backoff = None  # built lazily: only failed pulls pay for it
+        last_pull_exc = None  # chained into ObjectLostError for diagnosis
         while True:
             if oid in self.memory_store:
                 value = self.memory_store[oid]
@@ -1171,22 +1186,48 @@ class Runtime:
             remaining = 30.0 if deadline is None else deadline - time.monotonic()
             if remaining <= 0:
                 raise GetTimeoutError(f"timed out resolving {oid.hex()[:16]}")
-            ok = await self.raylet.call(
-                "pull_object",
-                {"object_id": oid, "timeout": min(remaining, 30.0)},
-                timeout=min(remaining, 30.0) + 10,
-            )
+            try:
+                ok = await self.raylet.call(
+                    "pull_object",
+                    {"object_id": oid, "timeout": min(remaining, 30.0)},
+                    timeout=min(remaining, 30.0) + 10,
+                )
+            except rpc.ConnectionLost as e:
+                # our raylet is gone: there is no pull plane left to
+                # retry against — fall through to reconstruction/loss
+                ok = False
+                last_pull_exc = e
+            except (rpc.RemoteCallError, rpc.RpcError,
+                    asyncio.TimeoutError) as e:
+                # a transient pull-plane failure (raylet handler error,
+                # rpc budget exceeded under load, an injected recv
+                # fault) is a FAILED PULL, not object loss — it rides
+                # the same bounded retry budget as a "retry" verdict
+                ok = "retry"
+                last_pull_exc = e
             if not ok or ok == "retry":
                 # last chance: it may have landed locally while we pulled
                 value, found = self._read_from_store(oid)
                 if found:
                     return value
                 failed_pulls += 1
-                if ok == "retry" and failed_pulls < 8:
+                if ok == "retry" and failed_pulls < cfg.pull_retry_max:
                     # a copy exists (spill file / live peer) but this
                     # round's restore or transfer failed — transient
                     # arena pressure, NOT object loss; back off and retry
-                    await asyncio.sleep(min(0.2 * failed_pulls, 2.0))
+                    # (shared policy; a lapsed deadline surfaces at the
+                    # loop head as GetTimeoutError)
+                    if pull_backoff is None:
+                        pull_backoff = Backoff(
+                            BackoffPolicy(
+                                base_s=cfg.pull_retry_base_s,
+                                mult=cfg.backoff_mult,
+                                max_s=cfg.pull_retry_max_s,
+                                jitter_frac=cfg.backoff_jitter_frac,
+                            ),
+                            deadline=deadline,
+                        )
+                    await pull_backoff.wait()
                     continue
                 # A failed pull already waited a location round: if we own
                 # lineage for the object, re-execute its producing task now
@@ -1195,16 +1236,23 @@ class Runtime:
                 if await self._try_reconstruct(oid):
                     continue
                 if deadline is None or (
-                    deadline == float("inf") and failed_pulls >= 4
+                    deadline == float("inf")
+                    and failed_pulls >= cfg.pull_retry_infinite_max
                 ):
                     # no-timeout get fails fast; an infinite-deadline wait
                     # (ray_tpu.wait) retries a few ~30s location rounds so
                     # an in-flight cross-owner ref isn't misreported, then
                     # surfaces genuinely lost objects as errored (= ready)
+                    # chain the last pull-plane error (when there was
+                    # one): a persistent raylet handler failure must not
+                    # masquerade as plain object loss
                     raise ObjectLostError(
-                        f"object {oid.hex()[:16]} not found anywhere in the cluster"
-                    )
-                await asyncio.sleep(0.05)  # retry until deadline
+                        f"object {oid.hex()[:16]} not found anywhere in "
+                        f"the cluster"
+                        + (f" (last pull error: {last_pull_exc!r})"
+                           if last_pull_exc is not None else "")
+                    ) from last_pull_exc
+                await asyncio.sleep(cfg.get_retry_poll_s)  # retry until deadline
 
     def _read_from_store(self, oid: bytes) -> Tuple[Any, bool]:
         pin = self.store.get(oid)
@@ -1772,6 +1820,7 @@ class Runtime:
 
     async def _acquire_lease(self, class_key, resources, strategy):
         st = self._classes[class_key]
+        pending_backoff = None  # built on first LEASE_PENDING only
         try:
             while True:
                 try:
@@ -1793,6 +1842,11 @@ class Runtime:
                     # capacity-pending timeout at the GCS: keep waiting as
                     # long as we still have queued demand; infeasible → fail
                     if "LEASE_PENDING" in str(e.remote_exception) and st.queue:
+                        # brief shared-policy backoff so a fleet of
+                        # starved classes doesn't re-request in lockstep
+                        if pending_backoff is None:
+                            pending_backoff = lease_pending_backoff()
+                        await pending_backoff.wait()
                         continue
                     raise
             if grant.get("cancelled"):
@@ -1800,15 +1854,33 @@ class Runtime:
                 # re-requests if demand reappeared since the cancel
                 pass
             else:
-                conn = await self._connect_worker(grant["worker_addr"])
-                lease = Lease(
-                    lease_id=grant["lease_id"],
-                    worker_addr=grant["worker_addr"],
-                    worker_id=grant["worker_id"],
-                    node_id=grant["node_id"],
-                    conn=conn,
-                )
-                st.leases.append(lease)
+                try:
+                    conn = await self._connect_worker(grant["worker_addr"])
+                except (OSError, rpc.RpcError, asyncio.TimeoutError) as e:
+                    # the granted worker died in the grant→dial window
+                    # (crash, OOM kill, injected chaos).  Return the
+                    # lease as broken and fall through to the pump —
+                    # the still-queued demand re-requests.  (A bare
+                    # return here stranded the queue forever: nothing
+                    # re-pumped the class; found by the chaos plane's
+                    # nth-hit lease-kill.)
+                    logger.warning(
+                        "granted worker at %s unreachable: %r",
+                        grant["worker_addr"], e,
+                    )
+                    self._spawn(self.gcs.notify(
+                        "return_lease",
+                        {"lease_id": grant["lease_id"], "broken": True},
+                    ))
+                else:
+                    lease = Lease(
+                        lease_id=grant["lease_id"],
+                        worker_addr=grant["worker_addr"],
+                        worker_id=grant["worker_id"],
+                        node_id=grant["node_id"],
+                        conn=conn,
+                    )
+                    st.leases.append(lease)
         except Exception as e:
             # fail queued tasks if the demand is infeasible
             if st.queue and isinstance(e, rpc.RemoteCallError):
@@ -2169,6 +2241,7 @@ class Runtime:
 
     async def _create_actor_async(self, actor_id, creation_spec, resources,
                                   strategy, runtime_env=None):
+        pending_backoff = None  # built on first LEASE_PENDING only
         try:
             while True:
                 try:
@@ -2189,6 +2262,9 @@ class Runtime:
                     # is feasible must eventually place (infeasible demands
                     # error immediately at the GCS instead)
                     if "LEASE_PENDING" in str(e.remote_exception):
+                        if pending_backoff is None:
+                            pending_backoff = lease_pending_backoff()
+                        await pending_backoff.wait()
                         continue
                     raise
             conn = await self._connect_worker(grant["worker_addr"])
@@ -2238,6 +2314,13 @@ class Runtime:
         conn = self._actor_conns.get(actor_id)
         if conn is not None and not conn.closed:
             return conn
+        # stale-address redials + state polls ride the shared backoff
+        # policy (liveness-based wait: no deadline, the GCS's DEAD
+        # transition is the exit)
+        retry_backoff = Backoff(BackoffPolicy(
+            base_s=cfg.backoff_base_s, mult=cfg.backoff_mult,
+            max_s=1.0, jitter_frac=cfg.backoff_jitter_frac,
+        ))
         while True:
             info = await self.gcs.call(
                 "get_actor", {"actor_id": actor_id, "wait": 5.0}, timeout=-1
@@ -2259,7 +2342,7 @@ class Runtime:
                 raise ActorDiedError(
                     f"actor {actor_id.hex()[:12]} is dead: {info.get('death_cause')}"
                 )
-            await asyncio.sleep(0.1)
+            await retry_backoff.wait()
 
     def make_actor_skeleton(
         self,

@@ -13,6 +13,7 @@ Rule ids are stable and grouped by family:
 - RT109 blocking-collective-in-async (async_rules)
 - RT110 unpoliced-call-soon-backlog (backlog)
 - RT111 unbounded-serve-dispatch    (backlog)
+- RT112 unbounded-retry-loop        (retry)
 
 The RT2xx series (actor-deadlock, objectref-leak, unserializable-
 capture, rank-divergent-collective) is the whole-program rtflow tier —
@@ -36,6 +37,7 @@ from ray_tpu.devtools.rules.remote_api import (
     MutableDefaultArg,
     NestedBlockingGet,
 )
+from ray_tpu.devtools.rules.retry import UnboundedRetryLoop
 from ray_tpu.devtools.rules.traced import ImpureTracedFn
 
 ALL_RULES = [
@@ -50,4 +52,5 @@ ALL_RULES = [
     BlockingCollectiveInAsync,
     UnpolicedCallSoon,
     UnboundedServeDispatch,
+    UnboundedRetryLoop,
 ]

@@ -1,0 +1,136 @@
+"""RT112: unbounded retry loop without backoff.
+
+A ``while True:`` loop that wraps a reconnect/retry-shaped call and
+whose body shows neither a sleep/backoff reference nor any visible
+attempt/deadline bound retries at full speed forever while the peer is
+down — the hot-spin shape ``common/backoff.py`` exists to replace
+(one dead GCS turns every such site into a busy loop, and a fleet of
+them into a reconnect stampede).
+
+Scope, tuned for precision over recall:
+
+- Only constant-true ``while`` loops are candidates; a ``for`` loop or
+  a ``while`` with a real condition is already bounded by construction.
+- The body must contain a retry-shaped call: a callee whose NAME
+  contains a reconnect/retry marker (``connect``, ``retry``,
+  ``redial``, ``resubscribe``), or an rpc verb —
+  ``.call("<method>", ...)`` / ``.notify("<method>", ...)`` whose
+  method string names a retried control-plane operation (``lease``,
+  ``pull``, ``connect``, ``subscribe``, ``register``, ``fetch``,
+  ``kv_get``).
+- Compliance: the body references a sleep (``time.sleep`` /
+  ``asyncio.sleep`` / any ``.sleep``), anything whose identifier
+  contains ``backoff``, or a visible bound — an identifier containing
+  ``deadline``, ``attempt``, ``retries``, ``tries``, or ``budget``.
+
+Sites that police their bound elsewhere (a helper owning the backoff)
+should name it locally or carry a justified ``rtlint: disable=RT112``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+# callee-name substrings that mean "this call dials/retries something"
+_RETRY_CALL_MARKERS = ("connect", "redial", "retry", "resubscribe")
+
+# method-string markers for the `.call("<method>", ...)` rpc shape
+_RETRY_RPC_MARKERS = (
+    "connect", "lease", "pull", "subscribe", "register", "fetch", "kv_get",
+)
+
+# identifier substrings that count as a bound or a pacing mechanism
+_BOUND_MARKERS = ("backoff", "deadline", "attempt", "retries", "tries",
+                  "budget")
+
+
+def _callee_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_retry_call(node: ast.Call) -> bool:
+    name = _callee_name(node.func).lower()
+    if any(m in name for m in _RETRY_CALL_MARKERS):
+        return True
+    if name in ("call", "notify") and node.args:
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            method = first.value.lower()
+            return any(m in method for m in _RETRY_RPC_MARKERS)
+    return False
+
+
+def _loop_has_retry_call(node: ast.While) -> bool:
+    for stmt in node.body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and _is_retry_call(sub):
+                return True
+    return False
+
+
+def _loop_shows_bound_or_backoff(node: ast.While) -> bool:
+    for stmt in node.body:
+        for sub in ast.walk(stmt):
+            ident = ""
+            if isinstance(sub, ast.Name):
+                ident = sub.id
+            elif isinstance(sub, ast.Attribute):
+                ident = sub.attr
+            if not ident:
+                continue
+            low = ident.lower()
+            if low == "sleep":
+                return True
+            if any(m in low for m in _BOUND_MARKERS):
+                return True
+    return False
+
+
+class _RetryLoopVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def visit_While(self, node: ast.While):
+        test = node.test
+        if (
+            isinstance(test, ast.Constant)
+            and test.value
+            and _loop_has_retry_call(node)
+            and not _loop_shows_bound_or_backoff(node)
+        ):
+            self.ctx.add(
+                self.rule, node,
+                message="`while True:` retry loop with neither backoff "
+                        "nor a visible attempt/deadline bound — a dead "
+                        "peer turns this into a hot spin (and a fleet of "
+                        "them into a reconnect stampede)",
+                hint="pace it with common/backoff.py (Backoff.wait() "
+                     "against a deadline or max_attempts), or make the "
+                     "bound visible in the loop (attempt counter, "
+                     "deadline check)",
+            )
+        self.generic_visit(node)
+
+
+class UnboundedRetryLoop(Rule):
+    id = "RT112"
+    name = "unbounded-retry-loop"
+    description = (
+        "constant-true retry loop wrapping a reconnect/retry-shaped "
+        "call with no sleep/backoff reference and no visible attempt "
+        "or deadline bound in its body"
+    )
+    hint = (
+        "use common/backoff.py's Backoff (deadline- or attempt-bounded, "
+        "jittered) instead of hand-rolled hot retries"
+    )
+    visitor_cls = _RetryLoopVisitor

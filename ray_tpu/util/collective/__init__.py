@@ -25,6 +25,12 @@ in-program ``"xla"`` adapter registered by ``parallel.collectives``
 The module-level ops BLOCK and are for sync actor methods; from
 ``async def`` bodies use the ``*_async`` twins or hand the call to a
 thread — rtlint rule RT109 enforces this.
+
+Fault tolerance: a member death poisons the group; instead of a full
+teardown, survivors can call ``reform_collective_group(new_world)`` to
+re-run rendezvous with the survivors (shrink) or with a replacement
+member joining under the dead rank — see docs/architecture.md "Fault
+injection & recovery".
 """
 
 from ray_tpu.util.collective.backend import (  # noqa: F401
@@ -53,6 +59,8 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     recv_async,
     reducescatter,
     reducescatter_async,
+    reform_collective_group,
+    reform_collective_group_async,
     send,
     send_async,
 )

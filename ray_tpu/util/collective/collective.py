@@ -280,6 +280,27 @@ class CollectiveManager:
         )
 
     # ---- lifecycle -----------------------------------------------------
+    async def _install_group(self, spec: GroupSpec) -> GroupHandle:
+        """Instantiate the backend for ``spec`` and publish the handle
+        (shared tail of init_group and reform_group)."""
+        backend_cls = resolve_backend(spec.backend)
+        impl = backend_cls(spec, self)
+        setup = getattr(impl, "setup", None)
+        if setup is not None:
+            await setup()
+        gh = GroupHandle(spec, impl)
+        self.groups[spec.name] = gh
+        # blocking sync methods bridge through the io loop; a
+        # proven-fast collective call must never be promoted onto the
+        # loop itself (it would park the loop it needs) — disable the
+        # inline-execution fast path for this worker outright
+        server = getattr(self.rt, "_worker_server", None)
+        if server is not None:
+            server.disable_inline_execution(
+                f"collective group {spec.name!r} member"
+            )
+        return gh
+
     async def init_group(self, group_name: str, world_size: int, rank: int,
                          backend_name: str) -> GroupHandle:
         if not (0 <= rank < world_size):
@@ -312,29 +333,122 @@ class CollectiveManager:
                 backend=backend_name, members=members,
                 incarnation=incarnation,
             )
-            backend_cls = resolve_backend(backend_name)
-            impl = backend_cls(spec, self)
-            setup = getattr(impl, "setup", None)
-            if setup is not None:
-                await setup()
+            return await self._install_group(spec)
         except BaseException:
             # a failed init never reaches self.groups, so destroy_group
             # would not retract for it — take the declared key back here
             # or a later same-name group reads this rank's stale record
             await rendezvous.retract(self.rt, group_name, rank)
             raise
-        gh = GroupHandle(spec, impl)
-        self.groups[group_name] = gh
-        # blocking sync methods bridge through the io loop; a
-        # proven-fast collective call must never be promoted onto the
-        # loop itself (it would park the loop it needs) — disable the
-        # inline-execution fast path for this worker outright
-        server = getattr(self.rt, "_worker_server", None)
-        if server is not None:
-            server.disable_inline_execution(
-                f"collective group {group_name!r} member"
+
+    async def reform_group(self, group_name: str, world_size: int,
+                           rank: Optional[int] = None,
+                           backend_name: Optional[str] = None,
+                           timeout: Optional[float] = None) -> GroupHandle:
+        """Re-form a (typically poisoned) group without a full teardown:
+        re-run GCS rendezvous at a bumped generation with the surviving
+        ranks (shrink) or with a replacement member joining under the
+        dead member's rank.
+
+        Survivors call with just the new ``world_size``; shrinking
+        reassigns new ranks by sorted old-rank order (phase-A roster),
+        while an unchanged ``world_size`` keeps every survivor's rank
+        and expects a replacement to join with an explicit ``rank=``.
+        A replacement member (no local history for the group) must pass
+        ``rank=`` and learns the generation from the stale KV record.
+
+        Fallback: if reform itself fails (another member died mid-way,
+        rendezvous times out), the group is left uninitialized locally —
+        ``destroy_collective_group`` + ``init_collective_group`` with
+        the live set is always available, and an un-reformed group stays
+        poisoned rather than half-alive."""
+        # validate BEFORE the destructive scrub below: a pure usage
+        # error on a healthy group must not un-initialize it
+        gh = self.groups.get(group_name)
+        old_spec = gh.spec if gh is not None else None
+        if old_spec is not None and world_size > old_spec.world_size:
+            raise CollectiveError(
+                f"reform cannot GROW group {group_name!r} "
+                f"({old_spec.world_size} -> {world_size}); use "
+                f"destroy_collective_group + init_collective_group"
             )
-        return gh
+        if old_spec is None and rank is None:
+            raise CollectiveError(
+                f"reform of group {group_name!r} from a fresh member "
+                f"needs rank= (the dead member's rank)"
+            )
+        if rank is not None and not (0 <= rank < world_size):
+            raise CollectiveError(
+                f"rank {rank} out of range for world_size {world_size}"
+            )
+        if (
+            old_spec is not None
+            and rank is not None
+            and world_size < old_spec.world_size
+        ):
+            # a survivor with an explicit rank would skip the phase-A
+            # roster declaration and strand every derive-mode survivor
+            # until the rendezvous timeout — shrink ranks are DERIVED
+            raise CollectiveError(
+                f"reform of group {group_name!r}: shrink derives new "
+                f"ranks from the surviving-rank order — do not pass "
+                f"rank= from a survivor (rank= is for a replacement "
+                f"member at unchanged world_size)"
+            )
+        self.groups.pop(group_name, None)
+        # scrub every trace of the old incarnation: mailboxes (buffered
+        # chunks are reclaimed), connection→group tracking (a late close
+        # of a conn that carried OLD traffic must not poison the NEW
+        # group), and the backend's own state
+        for key in [k for k in self._inbox if k[0] == group_name]:
+            self._drop_box(
+                self._inbox.pop(key),
+                CollectiveGroupError(f"group {group_name!r} is re-forming"),
+            )
+        for pairs in self._conn_groups.values():
+            pairs.difference_update({p for p in pairs if p[0] == group_name})
+        if gh is not None:
+            try:
+                await gh.backend.shutdown()
+            except Exception:
+                pass
+        if backend_name is None:
+            backend_name = old_spec.backend if old_spec is not None else "rpc"
+        if old_spec is not None:
+            gen = old_spec.reform_gen + 1
+            if rank is None:
+                if world_size == old_spec.world_size:
+                    # replacement scenario: survivors keep their ranks,
+                    # the fresh member joins under the dead one's rank
+                    rank = old_spec.rank
+                else:  # shrink (grow rejected above)
+                    rank = await rendezvous.reform_roster(
+                        self.rt, group_name, old_spec, world_size, timeout
+                    )
+        else:
+            # replacement member: no local history (rank= validated
+            # above) — learns the generation from the stale record it
+            # is about to overwrite
+            gen = await rendezvous.peek_gen(self.rt, group_name, rank) + 1
+        actor_id = self.rt.actor_id.hex() if self.rt.actor_id else None
+        me = await rendezvous.declare(
+            self.rt, group_name, world_size, rank, actor_id, gen=gen
+        )
+        members, incarnation = await rendezvous.await_members(
+            self.rt, group_name, world_size, rank, me,
+            timeout=timeout, gen=gen,
+        )
+        spec = GroupSpec(
+            name=group_name, world_size=world_size, rank=rank,
+            backend=backend_name, members=members,
+            incarnation=incarnation, reform_gen=gen,
+        )
+        new_gh = await self._install_group(spec)
+        if rank == 0 and old_spec is not None:
+            await rendezvous.reform_cleanup(
+                self.rt, group_name, old_spec, world_size
+            )
+        return new_gh
 
     async def destroy_group(self, group_name: str):
         gh = self.groups.pop(group_name, None)
@@ -469,6 +583,81 @@ def create_collective_group(actors, *, world_size: Optional[int] = None,
         timeout=timeout
         if timeout is not None
         else cfg.collective_rendezvous_timeout_s + 30.0,
+    )
+
+
+def _reform_in_actor(inst, group_name, world_size, rank, backend):
+    reform_collective_group(world_size, rank=rank, group_name=group_name,
+                            backend=backend)
+    return True
+
+
+def reform_collective_group(world_size: int, *,
+                            rank: Optional[int] = None,
+                            group_name: str = DEFAULT_GROUP_NAME,
+                            backend: Optional[str] = None,
+                            timeout: Optional[float] = None,
+                            actors=None,
+                            ranks: Optional[List[int]] = None) -> None:
+    """Re-form a group after a member death — the alternative to a full
+    teardown when the group is poisoned.
+
+    In-actor (each surviving member calls it, concurrently)::
+
+        col.reform_collective_group(3, group_name=g)        # shrink 4→3
+        col.reform_collective_group(4, group_name=g)        # survivor,
+                                                            # keeps rank
+        col.reform_collective_group(4, rank=2, group_name=g)  # the
+                                                            # REPLACEMENT
+
+    Shrinking DERIVES new ranks (sorted old-rank order) — survivors
+    must not pass ``rank=`` on a shrink; an unchanged world_size keeps
+    survivor ranks and expects a replacement member to join with the
+    dead member's ``rank``.  Driver-side declarative form: pass
+    ``actors`` (the surviving/replacement handles) and optionally
+    ``ranks`` (None entries mean "derive like the in-actor form";
+    explicit entries only for replacement members).
+
+    On failure the group is left uninitialized locally (poisoning
+    fallback): ``destroy_collective_group`` + ``init_collective_group``
+    always recovers."""
+    if actors is not None:
+        import ray_tpu
+
+        if ranks is None:
+            ranks = [None] * len(actors)
+        if len(ranks) != len(actors):
+            raise CollectiveError(
+                f"{len(ranks)} ranks for {len(actors)} actors"
+            )
+        refs = [
+            a._apply(_reform_in_actor, group_name, world_size, rk, backend)
+            for a, rk in zip(actors, ranks)
+        ]
+        ray_tpu.get(
+            refs,
+            timeout=timeout
+            if timeout is not None
+            else cfg.collective_rendezvous_timeout_s + 30.0,
+        )
+        return
+    mgr = _manager()
+    _run_blocking(mgr.reform_group(
+        group_name, world_size, rank=rank, backend_name=backend,
+        timeout=timeout,
+    ))
+
+
+async def reform_collective_group_async(world_size: int, *,
+                                        rank: Optional[int] = None,
+                                        group_name: str = DEFAULT_GROUP_NAME,
+                                        backend: Optional[str] = None,
+                                        timeout: Optional[float] = None) -> None:
+    """Loop-native twin of :func:`reform_collective_group` for async
+    actor methods (RT109: the blocking form would park the io loop)."""
+    await _manager().reform_group(
+        group_name, world_size, rank=rank, backend_name=backend,
+        timeout=timeout,
     )
 
 

@@ -8,6 +8,15 @@ table this runtime already has: each rank publishes its identity under
 is visible.  Teardown deletes the keys so a group name can be reused
 after ``destroy_collective_group``.
 
+Re-formation (``reform_collective_group``) reuses the same keyspace at
+a bumped **generation**: every record carries ``gen`` and
+``await_members`` only accepts records of its own generation, so a
+dead member's stale record (same key, older gen) can never complete a
+reformed membership table.  A shrink reform runs a phase-A roster
+first — survivors declare their OLD ranks under
+``collective-reform:<group>:<old incarnation>:<old_rank>`` and the new
+rank is each survivor's position in the sorted old-rank order.
+
 All coroutines here run on the runtime's io loop.
 """
 
@@ -19,27 +28,39 @@ import pickle
 import time
 from typing import Optional
 
+from ray_tpu.common.backoff import Backoff, BackoffPolicy
 from ray_tpu.common.config import cfg
 from ray_tpu.util.collective.types import (
     CollectiveError,
+    GroupSpec,
     MemberInfo,
     RendezvousTimeoutError,
 )
+
+# poll schedule for the KV tables (historic values, now expressed as
+# the shared backoff policy shape; jitter off keeps polls predictable)
+_POLL_POLICY = BackoffPolicy(base_s=0.02, mult=2.0, max_s=0.25,
+                             jitter_frac=0.0)
 
 
 def _key(group_name: str, rank: int) -> str:
     return f"collective:{group_name}:{rank}"
 
 
+def _reform_key(group_name: str, incarnation: str, rank: int) -> str:
+    return f"collective-reform:{group_name}:{incarnation or '0'}:{rank}"
+
+
 async def declare(rt, group_name: str, world_size: int, rank: int,
-                  actor_id_hex: Optional[str]) -> MemberInfo:
+                  actor_id_hex: Optional[str], gen: int = 0) -> MemberInfo:
     """Publish this rank's identity.  Overwrites any stale key from a
     previous same-named group (names are reusable only after destroy —
     concurrent same-named groups are user error and detected below by
     world_size/identity mismatches).  Rank 0's record also carries the
     group's incarnation nonce; every rank adopts it at await_members,
     and wire chunks are keyed by it so stale traffic from a previous
-    incarnation is dropped, never consumed."""
+    incarnation is dropped, never consumed.  ``gen`` is the reform
+    generation (0 for a fresh group)."""
     server = getattr(rt, "_worker_server", None)
     if server is None:
         raise CollectiveError(
@@ -54,7 +75,7 @@ async def declare(rt, group_name: str, world_size: int, rank: int,
         worker_id=rt.worker_id.hex(),
         actor_id=actor_id_hex,
     )
-    record = {"world_size": world_size, "member": me.to_dict()}
+    record = {"world_size": world_size, "member": me.to_dict(), "gen": gen}
     if rank == 0:
         record["incarnation"] = os.urandom(8).hex()
     await rt.gcs.call(
@@ -70,11 +91,17 @@ async def declare(rt, group_name: str, world_size: int, rank: int,
 
 async def await_members(rt, group_name: str, world_size: int, rank: int,
                         me: MemberInfo,
-                        timeout: Optional[float] = None):
+                        timeout: Optional[float] = None,
+                        gen: int = 0):
     """Poll the KV table until every rank has declared; returns
     ``(members in rank order, incarnation nonce)``.  Raises
     RendezvousTimeoutError naming the missing ranks — the actionable
     shape ("rank 2 never arrived") rather than a bare hang.
+
+    Records whose ``gen`` differs from ours are SKIPPED (treated as
+    not-yet-declared): on the reform path those are a dead member's
+    leftovers, and adopting one would hand the new group a corpse's
+    address.
 
     The incarnation is taken from a FINAL re-read of rank 0's record
     once the table is complete: destroy deletes the keys, so stale
@@ -85,7 +112,7 @@ async def await_members(rt, group_name: str, world_size: int, rank: int,
         timeout = cfg.collective_rendezvous_timeout_s
     deadline = time.monotonic() + timeout
     members: dict = {rank: me}
-    delay = 0.02
+    poll_backoff = Backoff(_POLL_POLICY, deadline=deadline)
     while True:
         for i in range(world_size):
             if i in members:
@@ -94,6 +121,8 @@ async def await_members(rt, group_name: str, world_size: int, rank: int,
             if blob is None:
                 continue
             rec = pickle.loads(blob)
+            if rec.get("gen", 0) != gen:
+                continue  # stale generation: not a declaration for US
             if rec["world_size"] != world_size:
                 raise CollectiveError(
                     f"collective group {group_name!r}: rank {i} declared "
@@ -104,6 +133,18 @@ async def await_members(rt, group_name: str, world_size: int, rank: int,
         if len(members) == world_size:
             blob = await rt.gcs.call("kv_get", {"key": _key(group_name, 0)})
             rec = pickle.loads(blob) if blob is not None else {}
+            if rank != 0 and rec.get("gen", 0) != gen:
+                # rank 0's record moved under us (a racing round):
+                # treat the table as incomplete and keep polling
+                members.pop(0, None)
+                if time.monotonic() >= deadline:
+                    raise RendezvousTimeoutError(
+                        f"collective group {group_name!r} rendezvous "
+                        f"could not settle rank 0's record at "
+                        f"generation {gen}"
+                    )
+                await poll_backoff.wait()
+                continue
             incarnation = rec.get("incarnation", "")
             members[0] = (
                 MemberInfo.from_dict(rec["member"])
@@ -120,8 +161,7 @@ async def await_members(rt, group_name: str, world_size: int, rank: int,
                 f"member actor is alive and called init_collective_group "
                 f"with the same group_name and world_size."
             )
-        await asyncio.sleep(delay)
-        delay = min(delay * 2, 0.25)
+        await poll_backoff.wait()
 
 
 async def retract(rt, group_name: str, rank: int) -> None:
@@ -130,3 +170,91 @@ async def retract(rt, group_name: str, rank: int) -> None:
         await rt.gcs.call("kv_del", {"key": _key(group_name, rank)})
     except Exception:
         pass  # best-effort: the GCS may already be gone at shutdown
+
+
+# ---------------------------------------------------------------------------
+# Re-formation (group shrink / member replacement)
+# ---------------------------------------------------------------------------
+
+
+async def reform_roster(rt, group_name: str, old_spec: GroupSpec,
+                        world_size: int,
+                        timeout: Optional[float] = None) -> int:
+    """Phase A of a SHRINK reform: survivors declare their old ranks
+    under a keyspace scoped by the old incarnation, wait until exactly
+    ``world_size`` survivors have declared, and take new rank = own
+    position in the sorted old-rank order.  Returns this rank's new
+    rank.  More declarations than ``world_size`` means the caller's
+    survivor count was wrong — raised, not guessed around."""
+    if timeout is None:
+        timeout = cfg.collective_rendezvous_timeout_s
+    deadline = time.monotonic() + timeout
+    inc = old_spec.incarnation
+    await rt.gcs.call("kv_put", {
+        "key": _reform_key(group_name, inc, old_spec.rank),
+        "value": b"1",
+        "overwrite": True,
+    })
+    declared = {old_spec.rank}
+    poll_backoff = Backoff(_POLL_POLICY, deadline=deadline)
+    while True:
+        for i in range(old_spec.world_size):
+            if i in declared:
+                continue
+            blob = await rt.gcs.call(
+                "kv_get", {"key": _reform_key(group_name, inc, i)}
+            )
+            if blob is not None:
+                declared.add(i)
+        if len(declared) >= world_size:
+            if len(declared) > world_size:
+                raise CollectiveError(
+                    f"reform of group {group_name!r}: {len(declared)} "
+                    f"survivors declared ({sorted(declared)}) but "
+                    f"world_size={world_size} was requested — every "
+                    f"surviving member must call reform_collective_group "
+                    f"with the same world_size"
+                )
+            return sorted(declared).index(old_spec.rank)
+        if time.monotonic() >= deadline:
+            raise RendezvousTimeoutError(
+                f"reform of group {group_name!r} timed out after "
+                f"{timeout:.0f}s: {len(declared)}/{world_size} survivors "
+                f"declared ({sorted(declared)}).  Another member may "
+                f"have died too — fall back to destroy_collective_group "
+                f"+ init_collective_group with the live set."
+            )
+        await poll_backoff.wait()
+
+
+async def peek_gen(rt, group_name: str, rank: int) -> int:
+    """The reform generation recorded under ``rank``'s key (0 when the
+    key is absent or predates generations) — how a REPLACEMENT member,
+    which has no local group history, joins at the right generation."""
+    blob = await rt.gcs.call("kv_get", {"key": _key(group_name, rank)})
+    if blob is None:
+        return 0
+    try:
+        return pickle.loads(blob).get("gen", 0)
+    except Exception:
+        return 0
+
+
+async def reform_cleanup(rt, group_name: str, old_spec: GroupSpec,
+                         world_size: int) -> None:
+    """Post-reform housekeeping (new rank 0 only): drop the phase-A
+    roster keys and the stale member keys beyond the new world size —
+    a later destroy/re-init must not trip over them."""
+    inc = old_spec.incarnation
+    for i in range(old_spec.world_size):
+        try:
+            await rt.gcs.call(
+                "kv_del", {"key": _reform_key(group_name, inc, i)}
+            )
+        except Exception:
+            pass
+        if i >= world_size:
+            try:
+                await rt.gcs.call("kv_del", {"key": _key(group_name, i)})
+            except Exception:
+                pass

@@ -36,6 +36,7 @@ import os
 import pickle
 from typing import List
 
+from ray_tpu.common import faults
 from ray_tpu.common.config import cfg
 from ray_tpu._native.store import StoreError, StoreFullError
 from ray_tpu.util.collective.backend import RuntimeBackend
@@ -107,6 +108,20 @@ class RpcRingBackend(RuntimeBackend):
                 f"(a previous group reused the name "
                 f"{self.spec.name!r} without destroy_collective_group)."
             ) from e
+        fault_ctl = faults.ACTIVE  # bind once: clear() races the check
+        if fault_ctl is not None:
+            # chaos site collective.peer_conn: a reset here severs the
+            # ring exactly like a member dying mid-op — the group must
+            # poison (and then be reformable), never wedge
+            plan = fault_ctl.hit(
+                "collective.peer_conn", f"{self.spec.name}:{peer_rank}"
+            )
+            if plan is not None and plan.action == "reset":
+                await conn.close()
+                raise CollectiveGroupError(
+                    f"injected peer-conn reset to "
+                    f"{self.spec.describe_member(peer_rank)}"
+                )
         self.manager._track_conn(conn, self.spec.name, peer_rank)
         return conn
 

@@ -92,6 +92,11 @@ class GroupSpec:
     # it so traffic from a destroyed same-named group can never be
     # consumed by — or corrupt — a re-initialized one
     incarnation: str = ""
+    # reform generation: bumped by each reform_collective_group round.
+    # Rendezvous records carry it, and await_members only accepts
+    # records of its own generation — a survivor re-declaring can never
+    # adopt the DEAD member's stale record (same key, older gen)
+    reform_gen: int = 0
 
     def member(self, rank: int) -> MemberInfo:
         return self.members[rank]

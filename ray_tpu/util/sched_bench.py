@@ -129,8 +129,12 @@ async def stop_fleet(stubs, hb_tasks):
 async def _lease_with_retry(client, resources, timeout=600.0):
     """request_lease with the runtime's LEASE_PENDING contract: a queued
     request is woken-or-expired within sched_max_pending_lease_s and the
-    client re-requests (core/runtime.py does exactly this), so a deep
-    backlog never strands a caller."""
+    client re-requests (core/runtime.py does exactly this, including the
+    shared backoff between re-requests), so a deep backlog never strands
+    a caller."""
+    from ray_tpu.core.runtime import lease_pending_backoff
+
+    pending_backoff = None
     while True:
         try:
             return await client.call("request_lease", {
@@ -140,6 +144,9 @@ async def _lease_with_retry(client, resources, timeout=600.0):
         except rpc.RpcError as e:
             if "LEASE_PENDING" not in str(e):
                 raise
+            if pending_backoff is None:
+                pending_backoff = lease_pending_backoff()
+            await pending_backoff.wait()
 
 
 async def lease_churn(clients: List, n_leases: int, concurrency: int,

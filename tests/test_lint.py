@@ -654,6 +654,91 @@ class TestUnboundedServeDispatch:
 
 
 # ---------------------------------------------------------------------------
+# RT112 unbounded-retry-loop
+# ---------------------------------------------------------------------------
+
+
+class TestUnboundedRetryLoop:
+    def test_flags_hot_reconnect_loop(self):
+        src = """
+        async def keep_alive(self):
+            while True:
+                try:
+                    self.conn = await connect(self.address)
+                    return self.conn
+                except OSError:
+                    continue
+        """
+        assert rule_ids(src, rules=["RT112"]) == ["RT112"]
+
+    def test_flags_rpc_verb_retry_without_pacing(self):
+        src = """
+        async def fetch(self, oid):
+            while True:
+                ok = await self.raylet.call("pull_object", {"oid": oid})
+                if ok:
+                    return ok
+        """
+        assert rule_ids(src, rules=["RT112"]) == ["RT112"]
+
+    def test_silent_with_backoff_reference(self):
+        # the compliant twin: same loop, paced by the shared policy
+        src = """
+        from ray_tpu.common.backoff import Backoff, BackoffPolicy
+
+        async def keep_alive(self):
+            pull_backoff = Backoff(BackoffPolicy(base_s=0.1))
+            while True:
+                try:
+                    self.conn = await connect(self.address)
+                    return self.conn
+                except OSError:
+                    if not await pull_backoff.wait():
+                        raise
+        """
+        assert rule_ids(src, rules=["RT112"]) == []
+
+    def test_silent_with_sleep_and_attempt_cap(self):
+        src = """
+        import asyncio
+
+        async def fetch(self, oid):
+            attempts = 0
+            while True:
+                ok = await self.raylet.call("pull_object", {"oid": oid})
+                if ok:
+                    return ok
+                attempts += 1
+                if attempts > 8:
+                    raise RuntimeError("lost")
+                await asyncio.sleep(0.1)
+        """
+        assert rule_ids(src, rules=["RT112"]) == []
+
+    def test_silent_on_bounded_while_and_for(self):
+        # a real loop condition (or a for-range) is already a bound
+        src = """
+        async def drain(self):
+            while not self.closed:
+                await self.gcs.call("register_node", {})
+            for _ in range(3):
+                await connect(self.address)
+        """
+        assert rule_ids(src, rules=["RT112"]) == []
+
+    def test_silent_on_non_retry_while_true(self):
+        # infinite loops that don't dial anything (pumps, servers) are
+        # out of scope
+        src = """
+        async def pump(self):
+            while True:
+                item = await self.queue.get()
+                self.apply(item)
+        """
+        assert rule_ids(src, rules=["RT112"]) == []
+
+
+# ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, parse errors
 # ---------------------------------------------------------------------------
 

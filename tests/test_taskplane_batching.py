@@ -258,6 +258,55 @@ def test_warm_template_cache_stays_picklable(cluster):
     ray_tpu.kill(a)
 
 
+def test_fault_hooks_are_noops_when_disabled():
+    """With RT_FAULTS unset the chaos sites on the depth-1 hot path
+    (rpc send/recv, store create, lease grant) are a single module-
+    attribute None check: zero allocations, nothing measurable.  The
+    alloc-churn ceiling above pins the hooks' cost on the REAL
+    submission/dispatch/reply path (the sites live inside
+    _write_frames/_dispatch_msg/create, all on that path); this test
+    pins the guard shape itself so the hooks can never regress the
+    depth-1 path."""
+    import sys
+
+    from ray_tpu.common import faults
+
+    assert faults.ACTIVE is None, (
+        "tier-1 must run with RT_FAULTS unset — the zero-cost contract "
+        "only holds for the disabled plane"
+    )
+    name = "conn-name"
+
+    def guard():
+        # the exact site shape threaded through rpc.py/store.py
+        fault_ctl = faults.ACTIVE
+        if fault_ctl is not None:
+            fault_ctl.hit("rpc.send.frame", name)
+
+    guard()  # warm
+    deltas = []
+    for _ in range(5):
+        before = sys.getallocatedblocks()
+        for _ in range(10_000):
+            guard()
+        deltas.append(sys.getallocatedblocks() - before)
+    # min-of-5: background runtime threads may allocate concurrently,
+    # but at least one clean window must show the guard allocating
+    # nothing
+    assert min(deltas) <= 2, (
+        f"disabled fault guard allocated (deltas={deltas}) — the "
+        "RT_FAULTS-unset path must stay a bare None check"
+    )
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        guard()
+    dt = time.perf_counter() - t0
+    assert dt < 0.5, (
+        f"100k disabled fault guards took {dt:.3f}s — the no-op path "
+        "grew real work"
+    )
+
+
 def test_windowed_put_announces_land(cluster):
     """put() location announces ride the flush window; they must still
     become GCS-visible (window/count caps) without any export flush."""
