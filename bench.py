@@ -607,6 +607,152 @@ def bench_fault_recovery(ray_tpu):
             "collective_err": collective_err}
 
 
+def bench_preemption_recovery():
+    """Graceful drain vs the reactive fault_recovery baseline.
+
+    A 2-node cluster's worker node holds the sole copy of an object, a
+    stateful checkpointable actor, and rank 1 of a 2-rank collective
+    group.  ``ChaosController.preempt_node`` delivers the termination
+    notice, the GCS drain migrates everything inside the deadline, and
+    the node is then hard-killed.  Three legs, each reporting the
+    BLACKOUT — time from the kill to the first successful post-kill
+    result — which is what preemption costs goodput: the reactive
+    ``fault_recovery`` task row pays detection + lease re-grant + worker
+    spawn (~450 ms) plus recomputation *after* the kill, while graceful
+    drain pays its migration *before* the kill, so the blackout is just
+    the first call's routing latency.  ``drain_ms`` (notice → fully
+    migrated) is reported alongside for the full picture.
+
+    Own cluster + driver (multi-node); call after the single-node bench
+    family has shut down.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.common.faults import ChaosController
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.util import collective as col  # noqa: F401 (workers use it)
+
+    @ray_tpu.remote
+    class _Ck:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def value(self):
+            return self.n
+
+        def init(self, world, rank, group):
+            from ray_tpu.util import collective as _c
+
+            _c.init_collective_group(world, rank, group_name=group)
+            return rank
+
+        def allreduce(self, arr, group):
+            from ray_tpu.util import collective as _c
+
+            return _c.allreduce(arr, group_name=group)
+
+        def __rt_checkpoint__(self):
+            return {"n": self.n}
+
+        def __rt_restore__(self, state):
+            self.n = state["n"]
+
+    cluster = Cluster(initialize_head=True, connect=True,
+                      head_node_args={"num_cpus": 4,
+                                      "resources": {"h": 4.0}})
+    try:
+        victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+        cluster.wait_for_nodes(timeout=60)
+
+        @ray_tpu.remote(resources={"pre": 0.3})
+        def big():
+            return np.arange(400_000, dtype=np.int64)
+
+        @ray_tpu.remote(resources={"pre": 0.3})
+        def marker():
+            return True
+
+        group = "bench-preempt"
+        home = _Ck.options(num_cpus=0, resources={"h": 0.5}).remote()
+        moving = _Ck.options(
+            num_cpus=0, resources={"pre": 0.3}, max_restarts=0
+        ).remote()
+        ray_tpu.get(
+            [home.init.remote(2, 0, group), moving.init.remote(2, 1, group)],
+            timeout=120,
+        )
+        data = np.arange(65536, dtype=np.float32)
+        ray_tpu.get(
+            [home.allreduce.remote(data, group),
+             moving.allreduce.remote(data, group)],
+            timeout=120,
+        )  # warm the ring
+        assert ray_tpu.get(moving.bump.remote(), timeout=60) == 1
+        ref = big.remote()
+        assert ray_tpu.get(marker.remote(), timeout=120) is True
+
+        # the survivor the migration lands on
+        cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+        cluster.wait_for_nodes(timeout=60)
+
+        chaos = ChaosController(cluster, seed=7)
+        t_notice = time.perf_counter()
+        _, state = chaos.preempt_node(node=victim, deadline_s=30.0)
+        t_killed = time.perf_counter()
+        if state != "drained":
+            raise RuntimeError(f"graceful drain did not complete: {state}")
+        rt = get_runtime()
+        st = rt._run(rt.gcs.call(
+            "get_drain_status", {"node_id": victim.node_id}
+        ))
+        drain_ms = (st["finished_at"] - st["started_at"]) * 1e3
+
+        # --- blackout legs (the node is dead NOW) ---
+        t0 = time.perf_counter()
+        arr = ray_tpu.get(ref, timeout=60)
+        object_ms = (time.perf_counter() - t0) * 1e3
+        assert arr[-1] == 399_999
+        assert rt.reconstructions == 0, "evacuation leg reconstructed"
+
+        t0 = time.perf_counter()
+        assert ray_tpu.get(moving.value.remote(), timeout=120) == 1
+        actor_ms = (time.perf_counter() - t0) * 1e3
+
+        t0 = time.perf_counter()
+        end = time.monotonic() + 60
+        while True:  # survivors' reform rides pubsub; tolerate a beat
+            try:
+                outs = ray_tpu.get(
+                    [home.allreduce.remote(data, group),
+                     moving.allreduce.remote(data, group)],
+                    timeout=60,
+                )
+                break
+            except Exception:  # noqa: BLE001
+                if time.monotonic() > end:
+                    raise
+                time.sleep(0.1)
+        collective_ms = (time.perf_counter() - t0) * 1e3
+        for o in outs:
+            assert np.array_equal(o, data + data)
+        return {
+            "drain_ms": drain_ms,
+            "notice_to_kill_ms": (t_killed - t_notice) * 1e3,
+            "object_blackout_ms": object_ms,
+            "actor_blackout_ms": actor_ms,
+            "collective_blackout_ms": collective_ms,
+        }
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def bench_serve_rps(ray_tpu, service_ms=100.0, max_ongoing=4,
                     slo_ms=750.0, max_queue_depth=12,
                     steady_s=4.0, overload_s=5.0):
@@ -1020,6 +1166,37 @@ def main():
             ray_tpu.shutdown()
     except Exception as e:  # noqa: BLE001
         emit("control_plane_family", 0.0, "rows", error=repr(e))
+
+    # preemption recovery: graceful drain (notice → migrated → kill)
+    # vs the reactive fault_recovery rows — blackout = kill → first
+    # successful result.  Own 3-node cluster; runs after the family's
+    # single-node runtime shut down.
+    if remaining() > 90:
+        try:
+            pr = bench_preemption_recovery()
+            emit(
+                "preemption_recovery_object_blackout_ms",
+                pr["object_blackout_ms"], "ms",
+                drain_ms=round(pr["drain_ms"], 1),
+                note="sole-copy object evacuated pre-kill; 0 "
+                     "reconstructions (reactive path: lineage re-exec)",
+            )
+            emit(
+                "preemption_recovery_actor_blackout_ms",
+                pr["actor_blackout_ms"], "ms",
+                note="checkpointable actor migrated with state pre-kill "
+                     "(reactive fault_recovery_task: ~lease+spawn "
+                     "after the kill)",
+            )
+            emit(
+                "preemption_recovery_collective_blackout_ms",
+                pr["collective_blackout_ms"], "ms",
+                note="2-rank group proactively re-formed pre-kill; "
+                     "first bit-exact allreduce after the kill",
+            )
+        except Exception as e:  # noqa: BLE001
+            emit("preemption_recovery_object_blackout_ms", 0.0, "ms",
+                 error=repr(e))
 
     # scheduler scale excerpt: 1k virtual nodes, lease-churn latency
     # (full tier: tests/test_scheduler_scale.py).  After the cluster

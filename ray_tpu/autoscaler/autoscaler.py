@@ -46,6 +46,10 @@ class AutoscalerConfig:
     idle_timeout_s: float = 60.0
     interval_s: float = 1.0
     max_launch_batch: int = 8
+    # graceful idle-drain budget: the GCS evacuates sole-copy objects
+    # (an "idle" node holds no leases/actors but may still hold the only
+    # copy of live objects) before the provider terminates the node
+    idle_drain_deadline_s: float = 15.0
 
 
 class Autoscaler:
@@ -59,6 +63,10 @@ class Autoscaler:
         self.config = config
         self.gcs: Optional[rpc.ReconnectingConnection] = None
         self._idle_since: Dict[str, float] = {}  # node_id_hex -> ts
+        # drain-then-terminate in flight: provider_id -> (pn, nids,
+        # settle deadline).  Checked once per reconcile pass instead of
+        # blocking the single reconcile coroutine for the whole drain.
+        self._pending_terminations: Dict[str, tuple] = {}
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
 
@@ -101,6 +109,7 @@ class Autoscaler:
                 tc.labels,
             )
         await self._drain_idle(state)
+        await self._reap_drained()
 
     def _type(self, name: str) -> NodeTypeConfig:
         for tc in self.config.node_types:
@@ -115,10 +124,13 @@ class Autoscaler:
         must fit whole on some node (matches the GCS's per-bundle atomic
         placement).  Pending leases are singles.
         """
+        # draining nodes (idle teardown or a preemption notice) are not
+        # supply: counting them would suppress the replacement launch
+        # that proactive evacuation needs capacity for
         free = [
             ResourceSet(n["resources_available"])
             for n in state["nodes"]
-            if n["alive"]
+            if n["alive"] and not n.get("draining")
         ]
         # launches still registering count as supply, or every reconcile
         # pass while a node boots would launch another copy
@@ -251,20 +263,65 @@ class Autoscaler:
             ):
                 continue
             tc = self._type(pn.node_type)
-            if counts.get(pn.node_type, 0) <= tc.min_workers:
+            # nodes queued for termination still show in provider counts
+            # until their drain settles — subtract them, or successive
+            # passes drain one node per tick straight through min_workers
+            pending_same_type = sum(
+                1 for (ppn, _n, _d) in self._pending_terminations.values()
+                if ppn.node_type == pn.node_type
+            )
+            if counts.get(pn.node_type, 0) - pending_same_type <= tc.min_workers:
                 continue
+            if pn.provider_id in self._pending_terminations:
+                continue  # drain already in flight
             logger.info(
                 "draining idle node %s (%s)", pn.provider_id, pn.node_type
             )
+            # deadline-based graceful drain: the GCS evacuates sole-copy
+            # objects off the node inside the budget; termination happens
+            # on a LATER reconcile pass once every host's drain settles
+            # (drained/failed) or the budget lapses — blocking here would
+            # stall scale-up for the whole drain (the hard node-death
+            # fallback covers whatever the drain did not finish)
+            budget = self.config.idle_drain_deadline_s
             for nid in nids:
                 try:
-                    await self.gcs.call("drain_node", {"node_id": nid})
+                    await self.gcs.call(
+                        "drain_node",
+                        {"node_id": nid, "reason": "idle",
+                         "deadline_s": budget},
+                    )
                 except Exception:
                     logger.exception("drain_node rpc failed")
-            await asyncio.to_thread(self.provider.terminate_node, pn)
+            self._pending_terminations[pn.provider_id] = (
+                pn, nids, time.monotonic() + budget + 1.0
+            )
             counts[pn.node_type] -= 1
             for nid in nids:
                 self._idle_since.pop(nid, None)
+
+    async def _reap_drained(self):
+        """Terminate drain-then-stop victims whose drain settled (or
+        whose settle deadline lapsed).  One non-blocking status check per
+        reconcile pass."""
+        settled_states = ("drained", "failed", "dead", "none", "unknown")
+        for pid, (pn, nids, deadline) in list(
+            self._pending_terminations.items()
+        ):
+            if time.monotonic() < deadline:
+                try:
+                    states = [
+                        (await self.gcs.call(
+                            "get_drain_status", {"node_id": nid}
+                        ) or {}).get("state")
+                        for nid in nids
+                    ]
+                except Exception:
+                    continue  # GCS hiccup: re-check next pass
+                if not all(s in settled_states for s in states):
+                    continue  # still draining inside the budget
+            del self._pending_terminations[pid]
+            await asyncio.to_thread(self.provider.terminate_node, pn)
 
 
 def main():

@@ -118,6 +118,51 @@ class FakeGceTpuApi(GceTpuApi):
             return list(self._slices.values())
 
 
+class GceMetadataPreemption:
+    """GCE metadata-server preemption poll (the raylet's watcher source).
+
+    A preemptible/spot TPU VM learns of its termination via the metadata
+    server's ``instance/preempted`` flag (and an ACPI G2 signal) roughly
+    30 s before the kill.  ``poll()`` returns the announced drain budget
+    in seconds when the flag is TRUE, else 0.  The HTTP fetch is
+    injectable so tests (and this egress-less environment) drive it with
+    a fake; the raylet enables the real poll with ``RT_PREEMPT_METADATA``.
+    """
+
+    URL = (
+        "http://metadata.google.internal/computeMetadata/v1/"
+        "instance/preempted"
+    )
+    #: what GCE actually grants between notice and kill
+    DEFAULT_DEADLINE_S = 30.0
+
+    def __init__(self, fetch=None, deadline_s: Optional[float] = None):
+        self._fetch = fetch or self._http_fetch
+        self.deadline_s = (
+            deadline_s if deadline_s is not None else self.DEFAULT_DEADLINE_S
+        )
+
+    def _http_fetch(self) -> str:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.URL, headers={"Metadata-Flavor": "Google"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=1.0) as resp:
+                return resp.read().decode("utf-8", "replace").strip()
+        except Exception:
+            return "FALSE"  # no metadata server / transient: not preempted
+
+    def poll(self) -> float:
+        """Seconds of drain budget if preempted, else 0."""
+        try:
+            flag = self._fetch()
+        except Exception:
+            return 0.0
+        return self.deadline_s if str(flag).upper() == "TRUE" else 0.0
+
+
 class TpuPodProvider(NodeProvider):
     """Slice-granular provider: create_node provisions a whole TPU slice
     and boots a raylet per host with the slice env injected."""

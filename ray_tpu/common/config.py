@@ -204,4 +204,18 @@ D("get_retry_poll_s", float, 0.05)
 D("reconnect_backoff_base_s", float, 0.1)
 D("reconnect_backoff_max_s", float, 2.0)
 
+# --- graceful drain / preemption (gcs.py drain protocol v2) ---
+# default drain budget when the caller names none (idle autoscaler
+# drains and preemption notices without an announced deadline)
+D("drain_deadline_default_s", float, 30.0)
+# concurrent evacuation pulls per draining node (each is a target-node
+# pull_object of a sole-copy object)
+D("drain_evac_concurrency", int, 8)
+# share of the drain budget spent waiting for in-flight task leases to
+# return before proceeding to the kill-adjacent phases
+D("drain_lease_wait_frac", float, 0.5)
+# raylet preemption-watcher poll cadence (node.preempt chaos site +
+# the GCE metadata stub); 0 disables the watcher
+D("preempt_poll_interval_s", float, 0.25)
+
 cfg = _Config()

@@ -7,8 +7,11 @@ module is that plane:
 
 - **Sites** are string-named hooks threaded through the hot paths
   (``rpc.send.frame``, ``rpc.recv.msg``, ``raylet.lease.grant``,
-  ``store.put``, ``collective.peer_conn``; the full registry is in
-  docs/architecture.md).  Each site guards itself with
+  ``store.put``, ``collective.peer_conn``, ``node.preempt`` — the
+  raylet's preemption watcher polls the last one, so a seeded plan
+  delivers a spot-termination notice deterministically, with
+  ``delay_s`` carrying the announced drain deadline; the full registry
+  is in docs/architecture.md).  Each site guards itself with
   ``if faults.ACTIVE is not None:`` — with ``RT_FAULTS`` unset the hook
   is a single module-attribute None check: no allocation, no branch
   taken, pinned by an alloc assertion in test_taskplane_batching.py.
@@ -102,7 +105,11 @@ class FaultPlan:
             d["match"] = self.match
         if self.p:
             d["p"] = self.p
-        if self.action == "delay":
+        # any non-default delay_s round-trips: "delay" uses it as the
+        # re-delivery lag, "preempt" as the announced drain deadline —
+        # dropping it for non-delay actions silently rewrote a chaos
+        # plan's deadline through plans_to_json/RT_FAULTS
+        if self.action == "delay" or self.delay_s != type(self).delay_s:
             d["delay_s"] = self.delay_s
         return d
 
@@ -277,17 +284,82 @@ class ChaosController:
         self.restart_gcs(timeout=timeout)
 
     # -- node faults -----------------------------------------------------
+    def _pick_node(self, node=None):
+        if node is not None:
+            return node
+        pool = [n for n in self.cluster._nodes
+                if n is not self.cluster.head_node]
+        pool = pool or list(self.cluster._nodes)
+        if not pool:
+            raise RuntimeError("no nodes to kill")
+        return self.rng.choice(pool)
+
+    def preempt_node(self, node=None, deadline_s: float = 5.0,
+                     kill: bool = True, poll_s: float = 0.1):
+        """Deliver a spot-preemption notice to a node, then (``kill``)
+        hard-kill it once its graceful drain settles or the deadline
+        lapses — the full GCE preemption sequence, seeded and replayable
+        (``node=None`` picks a seeded-random non-head victim).
+
+        Returns ``(node, drain_state)`` where ``drain_state`` is the
+        GCS's final drain verdict ("drained", "failed", "dead", ...)."""
+        import asyncio
+
+        from ray_tpu.core import rpc
+
+        node = self._pick_node(node)
+
+        async def drive():
+            # one connection for the notice AND the whole status poll —
+            # a fresh dial per 0.1 s poll would hammer the GCS's accept
+            # path exactly while it is busy driving the drain
+            conn = await rpc.connect(self.cluster.address,
+                                     name="chaos->gcs")
+            try:
+                reply = await conn.call("drain_node", {
+                    "node_id": node.node_id,
+                    "reason": "preemption",
+                    "deadline_s": deadline_s,
+                })
+                accepted = bool(
+                    isinstance(reply, dict) and reply.get("accepted")
+                )
+                state = (
+                    reply.get("state") if isinstance(reply, dict) else None
+                )
+                if not kill:
+                    return accepted, state
+                # the provider kills at the announced deadline
+                # regardless; polling just shortens the wait when the
+                # drain finishes early (and records what it achieved)
+                end = time.monotonic() + deadline_s + 2.0
+                while time.monotonic() < end:
+                    st = await conn.call(
+                        "get_drain_status", {"node_id": node.node_id}
+                    ) or {}
+                    state = st.get("state")
+                    if state in ("drained", "failed", "dead", "unknown"):
+                        break
+                    await asyncio.sleep(poll_s)
+                return accepted, state
+            finally:
+                await conn.close()
+
+        accepted, state = asyncio.run(drive())
+        self._record("node_preempt", node_id=node.node_id,
+                     deadline_s=deadline_s, accepted=accepted)
+        if not kill:
+            return node, state
+        self.cluster.remove_node(node, allow_graceful=False)
+        self._record("node_kill", node_id=node.node_id, graceful=False,
+                     drain_state=state)
+        return node, state
+
     def kill_node(self, node=None, graceful: bool = False):
         """Kill a raylet (and its workers).  ``node=None`` picks a
         seeded-random victim among the non-head nodes (falling back to
         the head when it is the only node)."""
-        if node is None:
-            pool = [n for n in self.cluster._nodes
-                    if n is not self.cluster.head_node]
-            pool = pool or list(self.cluster._nodes)
-            if not pool:
-                raise RuntimeError("no nodes to kill")
-            node = self.rng.choice(pool)
+        node = self._pick_node(node)
         self.cluster.remove_node(node, allow_graceful=graceful)
         self._record("node_kill", node_id=node.node_id, graceful=graceful)
         return node

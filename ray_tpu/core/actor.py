@@ -149,6 +149,7 @@ class ActorClass:
             max_concurrency=o.get("max_concurrency"),
             concurrency_groups=o.get("concurrency_groups"),
             method_groups=self._method_groups(),
+            on_drain=o.get("on_drain", "migrate"),
         )
         return ActorHandle(actor_id, max_task_retries)
 

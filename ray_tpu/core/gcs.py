@@ -62,6 +62,15 @@ class NodeEntry:
     conn: rpc.Connection
     alive: bool = True
     draining: bool = False  # drain requested: stop scheduling onto it
+    # drain protocol v2 (rpc_drain_node): why and until when
+    drain_reason: Optional[str] = None  # "idle" | "preemption"
+    drain_status: Optional[dict] = None  # progress; see _drain_node
+    # lease_worker calls currently awaiting this node's raylet: a grant
+    # issued just before a drain began is not in self.leases yet, and
+    # the drain's settle phase must not conclude "no work here" while
+    # one is in flight (its task would dispatch onto the node after the
+    # final evacuation sweep and be lost to the kill)
+    inflight_grants: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
 
     # Write-through scheduler index: every assignment to a field the
@@ -151,6 +160,11 @@ class ActorEntry:
     lease_id: Optional[int] = None
     detached: bool = False
     runtime_env: Optional[dict] = None  # descriptor for restart replay
+    # graceful-drain policy: "migrate" (default — the GCS checkpoint/
+    # restart-migrates it off a draining node) or "ignore" (an app-level
+    # manager owns relocation, e.g. serve replicas ride the controller's
+    # drain-then-stop flow instead)
+    on_drain: str = "migrate"
     death_cause: Optional[str] = None
     num_pending_restart_waiters: int = 0
     # conn of the creating client while PENDING_CREATION; a PENDING actor
@@ -486,6 +500,7 @@ _READONLY_RPCS = frozenset({
     "get_object_locations", "get_actor", "list_actors", "heartbeat",
     "get_placement_group", "list_placement_groups",
     "wait_placement_group_ready", "ping", "subscribe", "unsubscribe",
+    "get_drain_status",
     "get_autoscaler_state", "list_tasks", "list_objects",
     "metrics_push", "get_metrics", "get_job_info", "get_job_logs",
     "list_jobs", "list_events", "report_event", "get_worker_death_info",
@@ -560,6 +575,13 @@ class GcsServer:
         self._conn_job: Dict[rpc.Connection, JobID] = {}
         self._worker_conns: Dict[WorkerID, rpc.Connection] = {}
         self._worker_death_reasons: Dict[bytes, str] = {}
+        # in-flight graceful drains: node_id -> asyncio.Task (strong refs;
+        # the loop holds tasks weakly and a GC'd drain would silently stop)
+        self._drain_tasks: Dict[NodeID, asyncio.Task] = {}
+        # shielded drain-migration actor restarts (strong refs only: a
+        # drain-deadline cancel orphans the shield inner, which must
+        # keep running onto its surviving node)
+        self._restart_tasks: Set[asyncio.Task] = set()
         self._events: List[dict] = []  # bounded structured event log
         self._health_task: Optional[asyncio.Task] = None
         self._start_time = time.time()
@@ -590,6 +612,12 @@ class GcsServer:
                 "address": n.address,
                 "resources": n.resources_total.to_dict(),
                 "labels": n.labels,
+                # a restart must not silently re-admit a node the
+                # provider is mid-way through terminating
+                "draining": n.draining,
+                "drain_reason": n.drain_reason,
+                "drain_status": dict(n.drain_status)
+                if n.drain_status else None,
             }
             for nid, n in self.nodes.items()
             if n.alive
@@ -653,6 +681,19 @@ class GcsServer:
                 alive=True,
                 last_heartbeat=now,
             )
+            if n.get("draining"):
+                entry.drain_reason = n.get("drain_reason")
+                entry.drain_status = n.get("drain_status")
+                if entry.drain_status and entry.drain_status.get(
+                    "state"
+                ) == "draining":
+                    # the drain task died with the old GCS: report it
+                    # settled-as-failed (pollers must not wait forever)
+                    # but keep the node excluded — the provider's kill
+                    # is still coming and the hard-death path cleans up
+                    entry.drain_status["state"] = "failed"
+                    entry.drain_status["error"] = "GCS restarted mid-drain"
+                entry.draining = True
             self.scheduler.index_node(entry)
         self.actors.update(st["actors"])
         self.named_actors.update(st["named_actors"])
@@ -918,6 +959,15 @@ class GcsServer:
         if not node or not node.alive:
             return
         node.alive = False
+        # a drain in flight for this node is moot now (the failure path
+        # pops itself before calling here, so this never self-cancels)
+        drain_task = self._drain_tasks.pop(node_id, None)
+        if drain_task is not None:
+            drain_task.cancel()
+        if node.drain_status is not None and node.drain_status.get(
+            "state"
+        ) == "draining":
+            node.drain_status["state"] = "dead"
         logger.warning("node %s died: %s", node_id, reason)
         self.record_cluster_event(
             "ERROR", "gcs", f"node died: {reason}",
@@ -1087,12 +1137,28 @@ class GcsServer:
         for old_conn, nid in list(self._conn_node.items()):
             if nid == node_id and old_conn is not conn:
                 del self._conn_node[old_conn]
+        # a raylet reconnecting mid-drain must come back DRAINING: the
+        # fresh entry would otherwise silently re-admit a node the
+        # provider is about to terminate
+        prev = self.nodes.get(node_id)
+        if prev is not None and prev.draining:
+            entry.drain_reason = prev.drain_reason
+            entry.drain_status = prev.drain_status
+            entry.draining = True
         self.nodes[node_id] = entry
         self.scheduler.index_node(entry)
         self._conn_node[conn] = node_id
         await self.publish(
             "nodes",
-            {"event": "alive", "node_id": node_id.hex(), "address": p["address"]},
+            {
+                # a reconnecting mid-drain node must not announce "alive"
+                # — subscribers (the serve controller's draining-node set)
+                # would un-track it and route traffic back onto a node
+                # the provider is about to terminate
+                "event": "draining" if entry.draining else "alive",
+                "node_id": node_id.hex(),
+                "address": p["address"],
+            },
         )
         logger.info(
             "node %s registered: %s %s",
@@ -1114,6 +1180,7 @@ class GcsServer:
                 "address": n.address,
                 # a restored-but-unattached node is not usable yet
                 "alive": n.alive and n.conn is not None,
+                "draining": n.draining,
                 "resources_total": n.resources_total.to_dict(),
                 "resources_available": n.resources_available.to_dict(),
                 "labels": n.labels,
@@ -1928,6 +1995,7 @@ class GcsServer:
             {
                 "node_id": n.node_id.hex(),
                 "alive": n.alive and n.conn is not None,
+                "draining": n.draining,
                 "labels": n.labels,
                 "resources_total": n.resources_total.to_dict(),
                 "resources_available": n.resources_available.to_dict(),
@@ -1941,19 +2009,382 @@ class GcsServer:
             "nodes": nodes,
         }
 
+    # ---- graceful drain (protocol v2) -----------------------------------
+    #
+    # DrainNode role-equivalent (ray: NodeInfoGcsService DrainNode,
+    # gcs_node_manager.cc) extended into zero-loss migration: a DRAINING
+    # node is excluded from lease grants and PG (re)placement, then —
+    # inside the announced deadline — its PG bundles are relocated, its
+    # sole-copy shm objects are pulled onto surviving nodes (so
+    # object_locations never goes empty: no lineage reconstruction), and
+    # its actors migrate (checkpoint hooks → state handoff that does not
+    # consume the restart budget; hook-less → fresh restart under
+    # max_restarts; no budget → left to serve until the kill).  On
+    # deadline expiry the GCS falls back to the hard _on_node_death path,
+    # so a stuck drain can never wedge the cluster.
+
+    @staticmethod
+    def _ckpt_key(actor_id: ActorID) -> str:
+        return f"__rt_actor_ckpt:{actor_id.hex()}"
+
     async def rpc_drain_node(self, conn, p):
-        """Mark a node for shutdown: stop scheduling onto it.  The node
-        stays alive until its raylet actually dies, so _on_node_death can
-        still scrub object locations / leases / actors when the provider
-        terminates it (marking it dead here would skip all of that)."""
+        """Start a graceful drain: stop scheduling onto the node, then
+        migrate its state within ``deadline_s``.  The node stays alive
+        until its raylet actually dies (or the deadline lapses), so
+        _on_node_death can still scrub whatever the drain did not move."""
+        node = self.nodes.get(NodeID.from_hex(p["node_id"]))
+        if node is None or not node.alive:
+            return {"accepted": False, "state": "unknown"}
+        reason = p.get("reason", "idle")
+        deadline_s = float(
+            p.get("deadline_s") or cfg.drain_deadline_default_s
+        )
+        if node.draining:
+            # idempotent re-request (a metadata watcher re-announcing):
+            # report the in-flight drain instead of restarting it
+            st = node.drain_status or {}
+            return {"accepted": True, "state": st.get("state", "draining")}
+        node.drain_reason = reason
+        node.drain_status = {
+            "state": "draining",
+            "reason": reason,
+            "deadline_s": deadline_s,
+            "started_at": time.time(),
+            "objects_total": 0,
+            "objects_moved": 0,
+            "actors_total": 0,
+            "actors_moved": 0,
+        }
+        node.draining = True  # parks the node in the scheduler index
+        self.record_cluster_event(
+            "WARNING", "gcs",
+            f"node draining ({reason}, deadline {deadline_s:g}s)",
+            node_id=node.node_id.hex(),
+        )
+        await self.publish(
+            "nodes",
+            {"event": "draining", "node_id": p["node_id"],
+             "reason": reason, "deadline_s": deadline_s},
+        )
+        self._drain_tasks[node.node_id] = (
+            asyncio.get_running_loop().create_task(
+                self._drain_node(node, deadline_s)
+            )
+        )
+        return {"accepted": True, "state": "draining"}
+
+    async def rpc_get_drain_status(self, conn, p):
         node = self.nodes.get(NodeID.from_hex(p["node_id"]))
         if node is None:
-            return False
-        node.draining = True
-        await self.publish(
-            "nodes", {"event": "draining", "node_id": p["node_id"]}
+            return {"state": "unknown"}
+        if not node.alive:
+            return dict(node.drain_status or {}, state="dead")
+        if node.drain_status is None:
+            return {"state": "none"}
+        return dict(node.drain_status)
+
+    async def _drain_node(self, node: NodeEntry, deadline_s: float):
+        """Deadline-bounded drain driver: on success the node sits fully
+        evacuated (still alive, still excluded) awaiting its kill; on
+        timeout or error the hard node-death path cleans up reactively."""
+        st = node.drain_status
+        try:
+            await asyncio.wait_for(
+                self._drain_node_inner(node, deadline_s), timeout=deadline_s
+            )
+        except Exception as e:  # noqa: BLE001 — incl. wait_for timeout
+            st["state"] = "failed"
+            st["error"] = repr(e)
+            logger.warning(
+                "drain of node %s failed (%r); falling back to hard "
+                "node-death cleanup", node.node_id, e,
+            )
+            self._drain_tasks.pop(node.node_id, None)
+            await self._on_node_death(
+                node.node_id, f"drain deadline expired/failed: {e!r}"
+            )
+            return
+        finally:
+            self._drain_tasks.pop(node.node_id, None)
+            self._mark_dirty()
+        st["state"] = "drained"
+        st["finished_at"] = time.time()
+        self.record_cluster_event(
+            "INFO", "gcs",
+            f"node drained ({st['reason']}): {st['objects_moved']} objects, "
+            f"{st['actors_moved']} actors migrated",
+            node_id=node.node_id.hex(),
         )
-        return True
+        await self.publish(
+            "nodes", {"event": "drained", "node_id": node.node_id.hex()}
+        )
+
+    async def _drain_node_inner(self, node: NodeEntry, deadline_s: float):
+        budget_end = time.monotonic() + deadline_s
+        # 1. the raylet stops accepting leases and lets in-flight tasks
+        # finish (GCS-side exclusion is authoritative; this closes the
+        # grant-in-flight window and arms the raylet's local refusals)
+        try:
+            await node.conn.call(
+                "drain",
+                {"reason": node.drain_reason, "deadline_s": deadline_s},
+                timeout=5.0,
+            )
+        except Exception:
+            logger.warning("raylet drain notify failed", exc_info=True)
+        # 2. relocate placement-group bundles living here: replacements
+        # land on surviving nodes (draining nodes are excluded from
+        # placement), so gang actors can restart into their own bundle
+        await self._drain_evict_pg_bundles(node)
+        # 3. evacuate sole-copy shm objects onto surviving nodes over the
+        # existing pull plane — object_locations never goes empty, so no
+        # get() ever needs lineage reconstruction
+        await self._drain_evacuate_objects(node)
+        # 4. migrate actors (checkpoint handoff / fresh restart)
+        await self._drain_migrate_actors(node)
+        # 5. give in-flight normal-task leases a bounded window to return
+        # naturally (clients return leases shortly after their queue
+        # drains); whatever remains is broken by the eventual node death,
+        # riding the task retry path
+        lease_grace = max(
+            0.0,
+            min(
+                (budget_end - time.monotonic()),
+                deadline_s * cfg.drain_lease_wait_frac,
+            ),
+        )
+        # actor leases are excluded: migrated actors' leases were already
+        # released above, and the ones that legitimately remain
+        # (on_drain="ignore", no restart budget) live until the node
+        # dies — waiting on them would burn the whole grace for nothing
+        lease_end = time.monotonic() + lease_grace
+        while time.monotonic() < lease_end:
+            if node.inflight_grants == 0 and not any(
+                lease.node_id == node.node_id and lease.actor_id is None
+                for lease in self.leases.values()
+            ):
+                break
+            await asyncio.sleep(0.05)
+        # 6. re-scan evacuation: a task that was in flight at phase 3
+        # may have stored a sole-copy result on the node since the first
+        # sweep — it must not be lost to the kill (the second pass is
+        # incremental: usually zero victims)
+        await self._drain_evacuate_objects(node)
+
+    async def _drain_evict_pg_bundles(self, node: NodeEntry):
+        nid = node.node_id
+        moved = False
+        for pg in list(self.placement_groups.values()):
+            if pg.state not in (PG_CREATED, PG_RESCHEDULING):
+                continue
+            lost = [
+                i for i, bn in enumerate(pg.bundle_nodes) if bn == nid
+            ]
+            if not lost:
+                continue
+            # break non-actor leases drawing from the evicted bundles —
+            # their tasks requeue onto the relocated bundle (actor leases
+            # are handled by the migration phase, which releases them
+            # itself once the actor's state is safe)
+            for lease in list(self.leases.values()):
+                if (
+                    lease.node_id == nid
+                    and lease.pg_ref is not None
+                    and lease.pg_ref[0] == pg.pg_id
+                    and lease.pg_ref[1] in lost
+                    and lease.actor_id is None
+                ):
+                    await self._release_lease(
+                        lease.lease_id, broken=True, kick=False
+                    )
+            for i in lost:
+                # accounting: only the UNLEASED remainder returns to the
+                # (parked) node pool — outstanding draws (gang-actor
+                # leases) are credited by their own _release_lease when
+                # the migration phase frees them, and the full bundle
+                # here would double-count them past resources_total
+                node.resources_available = node.resources_available.add(
+                    pg.bundle_available[i]
+                )
+                pg.bundle_nodes[i] = None
+                pg.bundle_available[i] = ResourceSet()
+            pg.state = PG_RESCHEDULING
+            if pg.pg_id not in self._pending_pgs:
+                self._pending_pgs.append(pg.pg_id)
+            await self.publish(
+                "placement_groups",
+                {"event": "rescheduling", "pg_id": pg.pg_id.hex()},
+            )
+            moved = True
+        if moved:
+            self._kick_pending()  # place the evicted bundles elsewhere now
+
+    def _drain_targets(self, node: NodeEntry) -> List[NodeEntry]:
+        return [
+            n for n in self.nodes.values()
+            if n.alive and n.conn is not None and not n.draining
+        ]
+
+    def _node_is_doomed(self, nid: NodeID) -> bool:
+        n = self.nodes.get(nid)
+        return n is None or not n.alive or n.draining
+
+    async def _drain_evacuate_objects(self, node: NodeEntry):
+        nid = node.node_id
+        st = node.drain_status
+        # an object needs evacuation when one copy is here and EVERY
+        # copy sits on a doomed (draining/dead) node — exact `== {nid}`
+        # would let an object replicated only across two concurrently
+        # draining nodes (a whole preempted slice) be evacuated by
+        # neither drain and lost to both kills; dual evacuation of the
+        # same object is harmless (the targets' pulls coalesce)
+        victims = [
+            oid for oid, locs in self.object_locations.items()
+            if nid in locs and all(self._node_is_doomed(l) for l in locs)
+        ]
+        sole = set(victims)
+        for oid, snid in self.spilled_objects.items():
+            # spilled-only objects (file on the draining node's disk, no
+            # live arena copy on a surviving node): a target's pull
+            # restores them straight off the spill file
+            if snid == nid and oid not in sole and all(
+                self._node_is_doomed(l)
+                for l in self.object_locations.get(oid, ())
+            ):
+                victims.append(oid)
+        # accumulate: the drain runs two sweeps (bulk + a post-settle
+        # re-scan for results stored mid-drain)
+        st["objects_total"] += len(victims)
+        if not victims:
+            return
+        targets = self._drain_targets(node)
+        if not targets:
+            raise rpc.RpcError(
+                "no surviving node to evacuate onto (sole-copy objects "
+                "would be lost)"
+            )
+        sem = asyncio.Semaphore(cfg.drain_evac_concurrency)
+
+        async def evacuate(i: int, oid: bytes):
+            async with sem:
+                # try each surviving node once, starting round-robin —
+                # the outer deadline bounds total time
+                errs = []
+                for k in range(len(targets)):
+                    t = targets[(i + k) % len(targets)]
+                    try:
+                        ok = await t.conn.call(
+                            "pull_object",
+                            {"object_id": oid, "timeout": 20.0},
+                            timeout=30.0,
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+                        continue
+                    if ok is True:
+                        st["objects_moved"] += 1
+                        return
+                raise rpc.RpcError(
+                    f"evacuation of {oid.hex()[:12]} failed on every "
+                    f"surviving node ({errs!r})"
+                )
+
+        await asyncio.gather(
+            *(evacuate(i, oid) for i, oid in enumerate(victims))
+        )
+
+    async def _drain_migrate_actors(self, node: NodeEntry):
+        import pickle
+
+        nid = node.node_id
+        st = node.drain_status
+        victims = [
+            a for a in self.actors.values()
+            if a.node_id == nid and a.state == ACTOR_ALIVE
+            and getattr(a, "on_drain", "migrate") != "ignore"
+        ]
+        st["actors_total"] = len(victims)
+        for actor in victims:
+            lease = self.leases.get(actor.lease_id)
+            wconn = (
+                self._worker_conns.get(lease.worker_id)
+                if lease is not None else None
+            )
+            ck = {"supported": False, "blob": None, "groups": []}
+            if wconn is not None and not wconn.closed:
+                try:
+                    # unbounded on purpose: a hung __rt_checkpoint__ is
+                    # exactly what the outer drain deadline exists for
+                    ck = await wconn.call(
+                        "checkpoint_actor",
+                        {"actor_id": actor.actor_id.binary()},
+                        timeout=-1,
+                    )
+                except Exception:
+                    logger.warning(
+                        "checkpoint of actor %s failed; migrating fresh",
+                        actor.actor_id, exc_info=True,
+                    )
+            groups = ck.get("groups") or []
+            reason = f"node draining ({st['reason']})"
+            if ck.get("supported"):
+                # stateful migration: intentional relocation, NOT a
+                # failure — does not consume the restart budget
+                self.kv[self._ckpt_key(actor.actor_id)] = pickle.dumps(
+                    {"blob": ck.get("blob"), "groups": groups}, protocol=5
+                )
+                self._mark_dirty()
+            elif groups:
+                # hook-less collective member: no user state to carry,
+                # but the membership envelope still rides along so the
+                # restarted process re-joins its groups
+                self.kv[self._ckpt_key(actor.actor_id)] = pickle.dumps(
+                    {"blob": None, "groups": groups}, protocol=5
+                )
+                self._mark_dirty()
+            if not ck.get("supported"):
+                can_restart = actor.max_restarts != 0 and (
+                    actor.max_restarts < 0
+                    or actor.restarts_used < actor.max_restarts
+                )
+                if not can_restart:
+                    # no budget: leave it serving — it dies with the node
+                    # exactly as it would today, and killing it early
+                    # would only shorten its remaining service time
+                    self.kv.pop(self._ckpt_key(actor.actor_id), None)
+                    continue
+                actor.restarts_used += 1
+            actor.state = ACTOR_RESTARTING
+            actor.worker_addr = None
+            self.record_cluster_event(
+                "WARNING", "gcs",
+                f"actor migrating off draining node "
+                f"({'with state' if ck.get('supported') else 'fresh'})",
+                actor_id=actor.actor_id.hex(),
+            )
+            await self.publish(
+                f"actor:{actor.actor_id.hex()}", {"state": ACTOR_RESTARTING}
+            )
+            old_lease = actor.lease_id
+            actor.lease_id = None
+            if old_lease is not None:
+                # kills the old worker (its state is safe now); the
+                # raylet's worker_died report finds no lease/ALIVE state
+                # to act on, so no double restart
+                await self._release_lease(old_lease, broken=True)
+            # shielded: once the old worker is gone the restart targets a
+            # SURVIVING node — a drain-deadline cancellation mid-restart
+            # must let it finish rather than strand the actor RESTARTING
+            # (strong ref held: the loop tracks tasks weakly, and an
+            # orphaned shield inner would otherwise be GC-able)
+            restart = asyncio.get_running_loop().create_task(
+                self._restart_actor(actor, reason)
+            )
+            self._restart_tasks.add(restart)
+            restart.add_done_callback(self._restart_tasks.discard)
+            await asyncio.shield(restart)
+            if actor.state == ACTOR_ALIVE:
+                st["actors_moved"] += 1
 
     def _pg_bundle_candidates(
         self, pg: PlacementGroupEntry, idx: int, demand: ResourceSet
@@ -1987,7 +2418,12 @@ class GcsServer:
         for i in cands:
             nid = pg.bundle_nodes[i]
             node = self.nodes.get(nid) if nid else None
+            # `not node.draining`: the general scheduler parks draining
+            # nodes in its index, but PG grants bypass the index and
+            # would otherwise keep placing fresh work onto a node the
+            # autoscaler/provider is about to terminate
             if (node and node.alive and node.conn is not None
+                    and not node.draining
                     and pg.bundle_available[i].covers(demand)):
                 return await self._grant_lease(
                     node, demand, conn, p, pg_ref=(pg.pg_id, i)
@@ -2095,6 +2531,7 @@ class GcsServer:
             ].subtract(demand)
         else:
             node.resources_available = node.resources_available.subtract(demand)
+        node.inflight_grants += 1
         try:
             reply = await node.conn.call(
                 "lease_worker",
@@ -2153,6 +2590,11 @@ class GcsServer:
                 node.resources_available = node.resources_available.add(demand)
             self._kick_pending()
             raise
+        finally:
+            # success continues to the LeaseEntry registration below with
+            # no await in between, so a drain's settle poll can never see
+            # "no inflight grant AND no lease" for a granted worker
+            node.inflight_grants -= 1
         lease = LeaseEntry(
             lease_id=lease_id,
             node_id=node.node_id,
@@ -2362,6 +2804,7 @@ class GcsServer:
             scheduling=p.get("strategy", {}),
             runtime_env=p.get("runtime_env"),
             detached=p.get("detached", False),
+            on_drain=p.get("on_drain", "migrate"),
             creator_conn=conn,
         )
         self.actors[actor_id] = entry
@@ -2452,6 +2895,7 @@ class GcsServer:
             return
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
+        self.kv.pop(self._ckpt_key(actor.actor_id), None)
         token = b"actor:" + actor.actor_id.binary()
         for oid in self._spec_ref_oids(actor.creation_spec):
             s = self.object_holders.get(oid)
@@ -2564,17 +3008,29 @@ class GcsServer:
                 await asyncio.sleep(0.02)
             if worker_conn is None:
                 raise rpc.RpcError("restarted worker never registered with GCS")
+            # graceful-drain handoff: a checkpoint blob (and collective
+            # group memberships) parked in the KV rides the creation
+            # replay — the worker restores state after __init__
+            create_payload = {
+                "actor_id": actor.actor_id.binary(),
+                "creation_spec": actor.creation_spec,
+                "accelerator_env": grant.get("accelerator_env", {}),
+            }
+            ck_raw = self.kv.get(self._ckpt_key(actor.actor_id))
+            if ck_raw is not None:
+                import pickle
+
+                try:
+                    ck = pickle.loads(ck_raw)
+                    create_payload["checkpoint"] = ck.get("blob")
+                    create_payload["collective_groups"] = ck.get(
+                        "groups") or []
+                except Exception:
+                    logger.exception("bad actor checkpoint record dropped")
             # No fixed deadline on __init__ replay — liveness comes from the
             # worker: its death breaks the duplex conn and fails this call.
-            await worker_conn.call(
-                "create_actor",
-                {
-                    "actor_id": actor.actor_id.binary(),
-                    "creation_spec": actor.creation_spec,
-                    "accelerator_env": grant.get("accelerator_env", {}),
-                },
-                timeout=-1,
-            )
+            await worker_conn.call("create_actor", create_payload, timeout=-1)
+            self.kv.pop(self._ckpt_key(actor.actor_id), None)
             actor.state = ACTOR_ALIVE
             actor.worker_addr = grant["worker_addr"]
             actor.node_id = NodeID.from_hex(grant["node_id"])

@@ -36,6 +36,12 @@ from ray_tpu.core import rpc
 
 logger = logging.getLogger(__name__)
 
+#: FaultPlan.delay_s's field default — a node.preempt plan that never set
+#: delay_s means "use the config drain deadline", not a 50 ms drain
+_PLAN_DELAY_DEFAULT = faults.FaultPlan.__dataclass_fields__[
+    "delay_s"
+].default
+
 
 @dataclass
 class WorkerEntry:
@@ -113,6 +119,10 @@ class Raylet:
         # tears the node down (cluster launcher `down` uses the RPC to
         # drain nodes it has no pid for, e.g. on other hosts)
         self.stop_requested = asyncio.Event()
+        # graceful drain: set by the GCS's drain notify (or by the local
+        # preemption watcher) — new leases are refused while in-flight
+        # work finishes inside the announced deadline
+        self.draining = False
 
     # ---- lifecycle -----------------------------------------------------
     async def start(self):
@@ -143,6 +153,8 @@ class Raylet:
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         self._tasks.append(loop.create_task(self._reaper_loop()))
+        if cfg.preempt_poll_interval_s > 0:
+            self._tasks.append(loop.create_task(self._preempt_watch_loop()))
         if cfg.memory_monitor_interval_s > 0:
             from ray_tpu.core.memory_monitor import MemoryMonitor
 
@@ -406,6 +418,89 @@ class Raylet:
                 return f.read(length if length is not None else -1)
         except OSError:
             return None
+
+    # ---- graceful drain / preemption ------------------------------------
+
+    async def rpc_drain(self, conn, p):
+        """GCS drain notify: stop accepting leases; in-flight tasks keep
+        running and finish inside the announced deadline (the GCS drain
+        task waits for their leases to return before declaring the node
+        drained)."""
+        self.draining = True
+        logger.warning(
+            "raylet %s draining (%s, deadline %.1fs): refusing new leases",
+            self.node_id, p.get("reason"), p.get("deadline_s", 0.0),
+        )
+        return True
+
+    async def _preempt_watch_loop(self):
+        """Preemption watcher: converts an announced termination (spot/
+        preemptible notice) into a graceful drain.  Two signal sources:
+
+        - the ``node.preempt`` chaos site — each poll is one hit with
+          the node id as context, so a seeded ``FaultPlan`` drives a
+          preemption deterministically (``delay_s`` carries the
+          announced deadline; 0/default falls back to
+          ``cfg.drain_deadline_default_s``);
+        - the GCE metadata stub (``RT_PREEMPT_METADATA``; see
+          autoscaler/tpu_provider.GceMetadataPreemption), polling the
+          instance's ``preempted`` flag the way a real TPU VM would.
+        """
+        source = None
+        if os.environ.get("RT_PREEMPT_METADATA"):
+            try:
+                from ray_tpu.autoscaler.tpu_provider import (
+                    GceMetadataPreemption,
+                )
+
+                source = GceMetadataPreemption()
+            except Exception:
+                logger.exception("metadata preemption source unavailable")
+        while True:
+            await asyncio.sleep(cfg.preempt_poll_interval_s)
+            if self.draining:
+                continue  # notice already delivered
+            deadline_s = 0.0
+            fault_ctl = faults.ACTIVE  # bind once: clear() races the check
+            if fault_ctl is not None:
+                plan = fault_ctl.hit("node.preempt", self.node_id.hex())
+                if plan is not None and plan.action in ("preempt", "error"):
+                    # delay_s carries the announced deadline; unset
+                    # (FaultPlan's 0.05 "delay" default) or non-positive
+                    # falls back to the config default — a fired plan
+                    # must always deliver a usable notice (the nth-hit
+                    # window is already consumed)
+                    d = plan.delay_s
+                    if d is None or d <= 0 or d == _PLAN_DELAY_DEFAULT:
+                        d = cfg.drain_deadline_default_s
+                    deadline_s = d
+            if not deadline_s and source is not None:
+                try:
+                    deadline_s = await asyncio.to_thread(source.poll)
+                except Exception:
+                    deadline_s = 0.0
+            if not deadline_s or deadline_s <= 0:
+                continue
+            logger.warning(
+                "raylet %s: preemption notice, %.1fs to termination — "
+                "requesting graceful drain", self.node_id, deadline_s,
+            )
+            self.draining = True
+            try:
+                await self.gcs.call(
+                    "drain_node",
+                    {
+                        "node_id": self.node_id.hex(),
+                        "reason": "preemption",
+                        "deadline_s": deadline_s,
+                    },
+                )
+            except Exception:
+                # GCS unreachable: un-arm so the next poll retries the
+                # notice (the kill is coming either way; retrying is the
+                # only useful move)
+                logger.exception("preemption drain request failed")
+                self.draining = False
 
     async def rpc_shutdown_node(self, conn, p):
         """Graceful remote shutdown (ray: `ray down` draining a node the
@@ -789,6 +884,13 @@ class Raylet:
         Returns its address."""
         from ray_tpu.core import runtime_env as rtenv_mod
 
+        if self.draining:
+            # belt-and-braces with the GCS-side exclusion: a grant that
+            # was in flight when the drain notify landed must not bind a
+            # fresh worker to a node about to be terminated
+            raise rpc.RpcError(
+                f"node {self.node_id.hex()[:12]} is draining; lease refused"
+            )
         resources = p["resources"]
         rtenv = p.get("runtime_env")
         rtenv_key = rtenv_mod.descriptor_key(rtenv)

@@ -339,6 +339,9 @@ class Runtime:
         # producing task ----
         self._lineage: Dict[bytes, dict] = {}          # task_id -> entry
         self._lineage_by_return: Dict[bytes, bytes] = {}  # oid -> task_id
+        # lineage re-executions started by this process — the drain
+        # plane's "zero reconstructions" acceptance counter
+        self.reconstructions = 0
 
         # subsystem RPC methods: method name -> async handler(conn, payload).
         # Libraries (util.collective is the first) claim a method name and
@@ -517,6 +520,8 @@ class Runtime:
             return True
         if method == "create_actor" and self._worker_server is not None:
             return await self._worker_server.handle_create_actor(payload)
+        if method == "checkpoint_actor" and self._worker_server is not None:
+            return await self._worker_server.handle_checkpoint_actor(payload)
         if method == "dump_stacks" and self._worker_server is not None:
             return await self._worker_server._handle(conn, "dump_stacks",
                                                      payload)
@@ -2195,6 +2200,7 @@ class Runtime:
         max_concurrency=None,
         concurrency_groups=None,
         method_groups=None,
+        on_drain="migrate",
     ) -> "ActorID":
         actor_id = ActorID.random()
         rtenv_desc = self._normalize_runtime_env(runtime_env)
@@ -2230,6 +2236,7 @@ class Runtime:
                     "strategy": strategy or {},
                     "detached": detached,
                     "runtime_env": rtenv_desc,
+                    "on_drain": on_drain,
                 },
             )
         )
@@ -2926,6 +2933,7 @@ class Runtime:
             return False
         entry["budget"] -= 1
         entry["inflight"] = True
+        self.reconstructions += 1
         try:
             logger.info(
                 "reconstructing object %s via task %s (budget left %d)",

@@ -103,6 +103,8 @@ class WorkerServer:
             return True
         if method == "create_actor":
             return await self.handle_create_actor(p)
+        if method == "checkpoint_actor":
+            return await self.handle_checkpoint_actor(p)
         if method == "bind_env":
             os.environ.update(p["env"])
             _apply_jax_platform(p["env"])
@@ -538,8 +540,73 @@ class WorkerServer:
         self.actor_instance = await loop.run_in_executor(
             self._exec, lambda: cls(*args, **kwargs)
         )
+        # graceful-drain handoff: restore the migrated state (opt-in
+        # __rt_checkpoint__/__rt_restore__ pair), then re-join any
+        # collective groups the predecessor process was a member of —
+        # the replacement-reform path, with survivors nudged via pubsub
+        blob = p.get("checkpoint")
+        restore = getattr(self.actor_instance, "__rt_restore__", None)
+        if blob is not None and callable(restore):
+            state = self.rt.deserialize(blob)
+            await loop.run_in_executor(self._exec, restore, state)
+            logger.info(
+                "actor %s state restored from drain checkpoint "
+                "(%d bytes)", self.actor_id, len(blob),
+            )
+        for g in p.get("collective_groups") or ():
+            try:
+                await self._rejoin_collective_group(g)
+            except Exception:
+                logger.exception(
+                    "collective group %r re-join failed after migration; "
+                    "the group stays un-reformed (destroy + re-init "
+                    "recovers)", g.get("group_name"),
+                )
         logger.info("actor %s created (%s)", self.actor_id, cls.__name__)
         return True
+
+    async def _rejoin_collective_group(self, g: dict):
+        """Re-join one group after a drain migration: publish the reform
+        event so the surviving ranks enter the same-world replacement
+        reform, then join under the predecessor's rank."""
+        from ray_tpu.util.collective import collective as col_mod
+
+        mgr = col_mod._manager()
+        self.rt.publish(
+            col_mod.reform_channel(g["group_name"]),
+            {
+                "world_size": g["world_size"],
+                "origin_rank": g["rank"],
+            },
+        )
+        await mgr.reform_group(
+            g["group_name"], g["world_size"], rank=g["rank"],
+            backend_name=g.get("backend"),
+        )
+        logger.info(
+            "re-joined collective group %r as rank %d after migration",
+            g["group_name"], g["rank"],
+        )
+
+    async def handle_checkpoint_actor(self, p) -> dict:
+        """Drain-time state capture (GCS → worker): runs the opt-in
+        ``__rt_checkpoint__`` hook and reports this process's collective
+        group memberships.  A half-implemented hook pair (rtlint RT113)
+        degrades to unsupported — the actor restarts fresh."""
+        groups = []
+        if "ray_tpu.util.collective.collective" in sys.modules:
+            from ray_tpu.util.collective import collective as col_mod
+
+            groups = col_mod.local_group_memberships()
+        inst = self.actor_instance
+        ck = getattr(inst, "__rt_checkpoint__", None) if inst else None
+        restore = getattr(inst, "__rt_restore__", None) if inst else None
+        if not callable(ck) or not callable(restore):
+            return {"supported": False, "blob": None, "groups": groups}
+        loop = asyncio.get_running_loop()
+        state = await loop.run_in_executor(self._exec, ck)
+        blob = self.rt.serialize(state).to_bytes()
+        return {"supported": True, "blob": blob, "groups": groups}
 
     async def handle_push_actor_task(self, spec, conn=None) -> dict:
         """Per-caller submission ordering, enforced by sequence number.

@@ -14,6 +14,7 @@ Rule ids are stable and grouped by family:
 - RT110 unpoliced-call-soon-backlog (backlog)
 - RT111 unbounded-serve-dispatch    (backlog)
 - RT112 unbounded-retry-loop        (retry)
+- RT113 half-checkpoint-pair        (checkpoint)
 
 The RT2xx series (actor-deadlock, objectref-leak, unserializable-
 capture, rank-divergent-collective) is the whole-program rtflow tier —
@@ -31,6 +32,7 @@ from ray_tpu.devtools.rules.backlog import (
     UnboundedServeDispatch,
     UnpolicedCallSoon,
 )
+from ray_tpu.devtools.rules.checkpoint import HalfCheckpointPair
 from ray_tpu.devtools.rules.concurrency import UnlockedLazyInit
 from ray_tpu.devtools.rules.persistence import NonAtomicWrite
 from ray_tpu.devtools.rules.remote_api import (
@@ -53,4 +55,5 @@ ALL_RULES = [
     UnpolicedCallSoon,
     UnboundedServeDispatch,
     UnboundedRetryLoop,
+    HalfCheckpointPair,
 ]

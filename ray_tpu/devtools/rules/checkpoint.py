@@ -1,0 +1,76 @@
+"""RT113: half-implemented actor checkpoint hook pair.
+
+The graceful-drain plane migrates an actor's state off a preempted node
+only when the class implements BOTH ``__rt_checkpoint__`` and
+``__rt_restore__`` (worker_main.handle_checkpoint_actor treats a half
+pair as unsupported).  A class defining exactly one of the two *looks*
+migration-capable but silently degrades to a fresh restart — state loss
+that surfaces only during an actual preemption, which is exactly when
+nobody is watching.
+
+Scope: any class definition carrying exactly one hook of the pair
+(plain ``def``/``async def`` or a class-level assignment to the hook
+name).  The hook names are runtime-specific, so false positives outside
+actor classes are implausible.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+_HOOKS = ("__rt_checkpoint__", "__rt_restore__")
+
+
+def _class_hook_names(node: ast.ClassDef) -> set:
+    found = set()
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if stmt.name in _HOOKS:
+                found.add(stmt.name)
+        elif isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) and tgt.id in _HOOKS:
+                    found.add(tgt.id)
+    return found
+
+
+class _CheckpointPairVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        found = _class_hook_names(node)
+        if len(found) == 1:
+            have = next(iter(found))
+            missing = next(h for h in _HOOKS if h != have)
+            self.ctx.add(
+                self.rule, node,
+                message=(
+                    f"class {node.name} defines {have} without {missing}: "
+                    f"the drain plane treats a half pair as "
+                    f"not-checkpointable and the actor silently migrates "
+                    f"FRESH (state lost) on node preemption"
+                ),
+                hint=f"implement {missing} (the pair is all-or-nothing), "
+                     f"or drop {have} if fresh restarts are intended",
+            )
+        self.generic_visit(node)
+
+
+class HalfCheckpointPair(Rule):
+    id = "RT113"
+    name = "half-checkpoint-pair"
+    description = (
+        "class defines exactly one of __rt_checkpoint__/__rt_restore__ — "
+        "drain migration silently degrades to a fresh restart"
+    )
+    hint = (
+        "implement both hooks (state handoff) or neither (explicit "
+        "fresh-restart semantics)"
+    )
+    visitor_cls = _CheckpointPairVisitor

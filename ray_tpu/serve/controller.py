@@ -90,10 +90,36 @@ class ServeControllerActor:
         self._reconcile_mutex = threading.Lock()
         self._interval = 0.5
         self._stop = threading.Event()
+        # nodes with a graceful drain in flight (GCS "nodes" pubsub):
+        # replicas on them enter the drain-then-stop flow — replaced and
+        # routed around BEFORE the node dies — instead of dying with it
+        self._draining_nodes: set = set()
+        try:
+            from ray_tpu.core.runtime import get_runtime
+
+            get_runtime().subscribe("nodes", self._on_node_event)
+            # seed with drains already in flight: their "draining" event
+            # was published before this controller subscribed (controller
+            # restart / serve.start during a preemption window)
+            for n in get_runtime().nodes():
+                if n.get("draining"):
+                    self._draining_nodes.add(n["node_id"])
+        except Exception:
+            logger.warning("node-event subscribe failed", exc_info=True)
         self._thread = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True
         )
         self._thread.start()
+
+    def _on_node_event(self, msg: dict):
+        """GCS pubsub callback (io loop): track draining nodes."""
+        nid = msg.get("node_id")
+        if nid is None:
+            return
+        if msg.get("event") == "draining":
+            self._draining_nodes.add(nid)
+        elif msg.get("event") in ("dead", "alive"):
+            self._draining_nodes.discard(nid)
 
     # -- deploy API ------------------------------------------------------
     def deploy_application(
@@ -250,10 +276,50 @@ class ServeControllerActor:
         if changed:
             self._publish_routes_version()
 
+    def _actor_nodes(self) -> Dict[str, str]:
+        """actor_id hex -> node_id hex for every live actor (one GCS
+        read per reconcile pass, and only while a node is draining)."""
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        rows = rt._run(rt.gcs.call("list_actors", {}))
+        return {
+            r["actor_id"]: r["node_id"]
+            for r in rows
+            if r.get("node_id") and r["state"] == "ALIVE"
+        }
+
     def _reconcile_locked(self) -> bool:
         changed = False
+        draining_nodes = set(self._draining_nodes)
+        actor_nodes: Dict[str, str] = (
+            self._actor_nodes() if draining_nodes else {}
+        )
         for st in self._snapshot():
             alive = self._check_health(st.replicas)
+            if draining_nodes:
+                # replicas on a draining node: drain-then-stop NOW — they
+                # leave the route table (and get replaced below via
+                # to_create) while the node is still alive to finish
+                # their in-flight requests, instead of dying with it
+                evacuating = [
+                    r for r in alive
+                    if actor_nodes.get(r._actor_id.hex()) in draining_nodes
+                ]
+                if evacuating:
+                    alive = [r for r in alive if r not in evacuating]
+                    with self._lock:
+                        if self._is_current(st):
+                            deadline = (
+                                time.monotonic() + st.drain_timeout_s()
+                            )
+                            for r in evacuating:
+                                st.draining.append((r, deadline))
+                            logger.info(
+                                "deployment %s: %d replica(s) on draining "
+                                "node(s) entered drain-then-stop",
+                                st.name, len(evacuating),
+                            )
             with self._lock:
                 if not self._is_current(st):
                     continue  # redeployed/deleted while we probed
@@ -270,6 +336,10 @@ class ServeControllerActor:
                     num_tpus=opts.get("num_tpus"),
                     resources=opts.get("resources"),
                     max_restarts=0,
+                    # this controller owns replica relocation (the
+                    # drain-then-stop flow above); the GCS drain plane
+                    # must not also checkpoint/restart-migrate them
+                    on_drain="ignore",
                 ).remote(
                     d.func_or_class, d.init_args, d.init_kwargs, None,
                     st.app_name,

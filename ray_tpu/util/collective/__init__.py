@@ -55,6 +55,7 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     get_rank,
     init_collective_group,
     is_group_initialized,
+    local_group_memberships,
     recv,
     recv_async,
     reducescatter,

@@ -46,6 +46,16 @@ logger = logging.getLogger(__name__)
 RPC_METHOD = "collective"  # the one method name the subsystem claims
 
 
+def reform_channel(group_name: str) -> str:
+    """GCS pubsub channel carrying drain-migration reform events for one
+    group: a member that migrated off a draining node publishes here
+    right before re-joining under its old rank, and every surviving
+    member's subscription enters the same-world replacement reform — the
+    group proactively re-forms *before* the preempted node dies instead
+    of poisoning after it."""
+    return f"collective:reform:{group_name}"
+
+
 class _Mailbox:
     """Arrived-but-unconsumed chunks for one (group, src, tag) stream.
 
@@ -89,6 +99,13 @@ class CollectiveManager:
     def __init__(self, rt):
         self.rt = rt
         self.groups: Dict[str, GroupHandle] = {}
+        # groups currently mid-reform in this process (a drain-migration
+        # reform event arriving while one is running must not start a
+        # second, racing rendezvous); an event that lands mid-reform is
+        # parked here and replayed when the current reform finishes
+        # (two members of one group migrating near-simultaneously)
+        self._reforming: set = set()
+        self._pending_reform: Dict[str, dict] = {}
         self._inbox: Dict[tuple, _Mailbox] = {}
         # conn → {(group, peer_rank)}: every connection known to carry
         # a group's traffic, for death detection (inbound recorded at
@@ -299,7 +316,64 @@ class CollectiveManager:
             server.disable_inline_execution(
                 f"collective group {spec.name!r} member"
             )
+        # drain-migration reform events: when a peer rank migrates off a
+        # draining node, its restored process publishes on the group's
+        # reform channel and every member (we included) enters the
+        # same-world replacement reform.  Subscribing AFTER install means
+        # a fresh/migrated member can never consume its own publish.
+        try:
+            await self.rt.subscribe_async(
+                reform_channel(spec.name),
+                lambda msg, _g=spec.name: self._on_reform_event(_g, msg),
+            )
+        except Exception:
+            logger.warning(
+                "reform-channel subscribe failed for group %r "
+                "(drain-driven proactive reform disabled here)",
+                spec.name, exc_info=True,
+            )
         return gh
+
+    def _on_reform_event(self, group_name: str, msg: dict):
+        """Pubsub callback (io loop): a migrated member is re-joining —
+        survivors reform at unchanged world size, keeping their ranks."""
+        gh = self.groups.get(group_name)
+        if gh is None:
+            return  # not currently a member (mid-reform or torn down)
+        origin = msg.get("origin_rank")
+        if origin is not None and origin == gh.spec.rank:
+            # our own old process's event echoed back (the predecessor of
+            # a migrated member is still subscribed while it is killed) —
+            # never reform against ourselves
+            return
+        if group_name in self._reforming:
+            # park it: the migrating member behind this event still
+            # needs a rendezvous round after the current one completes
+            self._pending_reform[group_name] = msg
+            return
+        world_size = int(msg.get("world_size", gh.spec.world_size))
+        self._reforming.add(group_name)
+
+        async def go():
+            try:
+                await self.reform_group(group_name, world_size)
+                logger.info(
+                    "group %r proactively re-formed after a member "
+                    "migration (rank %s moved)", group_name, origin,
+                )
+            except Exception:
+                logger.exception(
+                    "drain-driven reform of group %r failed; the group "
+                    "is left uninitialized (destroy + re-init recovers)",
+                    group_name,
+                )
+            finally:
+                self._reforming.discard(group_name)
+                pending = self._pending_reform.pop(group_name, None)
+                if pending is not None:
+                    self._on_reform_event(group_name, pending)
+
+        self.rt._spawn(go())
 
     async def init_group(self, group_name: str, world_size: int, rank: int,
                          backend_name: str) -> GroupHandle:
@@ -680,6 +754,30 @@ def is_group_initialized(group_name: str = DEFAULT_GROUP_NAME) -> bool:
         return group_name in _manager().groups
     except Exception:
         return False
+
+
+def local_group_memberships() -> List[dict]:
+    """Groups THIS process is a member of — the drain plane's migration
+    envelope (worker_main.handle_checkpoint_actor ships it so a migrated
+    actor's new process can re-join under its old ranks).  Passive: never
+    instantiates a manager, so a process that never touched collectives
+    reports [] without side effects."""
+    try:
+        rt = get_runtime()
+    except Exception:
+        return []
+    mgr = _managers.get(id(rt))
+    if mgr is None or mgr.rt is not rt:
+        return []
+    return [
+        {
+            "group_name": name,
+            "world_size": gh.spec.world_size,
+            "rank": gh.spec.rank,
+            "backend": gh.spec.backend,
+        }
+        for name, gh in mgr.groups.items()
+    ]
 
 
 def get_rank(group_name: str = DEFAULT_GROUP_NAME) -> int:

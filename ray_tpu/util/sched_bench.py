@@ -51,7 +51,8 @@ class StubRaylet:
                 "worker_id": WorkerID.random().binary(),
                 "worker_addr": f"10.1.0.{self.idx}:{9000 + self._worker_seq}",
             }
-        if method in ("release_worker", "drain_node", "delete_objects"):
+        if method in ("release_worker", "drain_node", "drain",
+                      "delete_objects"):
             return True
         if method == "ping":
             return True

@@ -739,6 +739,64 @@ class TestUnboundedRetryLoop:
 
 
 # ---------------------------------------------------------------------------
+# RT113 half-checkpoint-pair
+# ---------------------------------------------------------------------------
+
+
+class TestHalfCheckpointPair:
+    def test_flags_checkpoint_without_restore(self):
+        src = """
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def __rt_checkpoint__(self):
+                return {"n": self.n}
+        """
+        assert rule_ids(src, rules=["RT113"]) == ["RT113"]
+
+    def test_flags_restore_without_checkpoint(self):
+        src = """
+        class Counter:
+            def __rt_restore__(self, state):
+                self.n = state["n"]
+        """
+        assert rule_ids(src, rules=["RT113"]) == ["RT113"]
+
+    def test_silent_on_full_pair(self):
+        # the compliant twin: both hooks — drain migration carries state
+        src = """
+        class Counter:
+            def __rt_checkpoint__(self):
+                return {"n": self.n}
+
+            def __rt_restore__(self, state):
+                self.n = state["n"]
+        """
+        assert rule_ids(src, rules=["RT113"]) == []
+
+    def test_silent_on_neither_hook(self):
+        # hook-less classes restart fresh by design — not a finding
+        src = """
+        class Plain:
+            def work(self):
+                return 1
+        """
+        assert rule_ids(src, rules=["RT113"]) == []
+
+    def test_flags_assigned_hook_alias(self):
+        # a class-level assignment is still "defines the hook"
+        src = """
+        def _save(self):
+            return self.state
+
+        class Aliased:
+            __rt_checkpoint__ = _save
+        """
+        assert rule_ids(src, rules=["RT113"]) == ["RT113"]
+
+
+# ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, parse errors
 # ---------------------------------------------------------------------------
 

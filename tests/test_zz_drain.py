@@ -1,0 +1,633 @@
+"""Graceful node drain & preemption-aware migration.
+
+Drives the drain protocol v2 end to end: an announced preemption (the
+``node.preempt`` chaos site / ``ChaosController.preempt_node``) turns
+into a deadline-bounded drain — sole-copy objects evacuate over the
+pull plane (no lineage reconstruction), checkpointable actors migrate
+with state (``__rt_checkpoint__``/``__rt_restore__``), hook-less actors
+restart fresh under their ``max_restarts`` budget, serve replicas enter
+the controller's drain-then-stop flow, and collective groups proactively
+re-form before the kill.  Deadline expiry falls back to the hard
+``_on_node_death`` path.
+
+NOTE on the filename: sorts past the tier-1 870 s truncation window on
+purpose (see test_zz_chaos.py) — multi-process drain tests are slow.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.common import faults
+from ray_tpu.common.faults import ChaosController
+from ray_tpu.core.runtime import get_runtime
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+    os.environ.pop("RT_FAULTS", None)
+
+
+def _list_actor(actor_id_hex: str) -> dict:
+    rt = get_runtime()
+    rows = rt._run(rt.gcs.call("list_actors", {}))
+    for r in rows:
+        if r["actor_id"] == actor_id_hex:
+            return r
+    raise AssertionError(f"actor {actor_id_hex} not in list_actors")
+
+
+def _drain_status(node_id_hex: str) -> dict:
+    rt = get_runtime()
+    return rt._run(
+        rt.gcs.call("get_drain_status", {"node_id": node_id_hex})
+    )
+
+
+# ---------------------------------------------------------------------------
+# Scheduling exclusion (the satellite audit fix)
+# ---------------------------------------------------------------------------
+
+
+class TestDrainSchedulingExclusion:
+    def test_pg_lease_grant_skips_draining_bundle_node(self):
+        """Regression pin for the audit fix: _try_grant_pg_lease used to
+        check only node.alive, so PG leases kept landing on a node the
+        autoscaler was about to terminate."""
+        from ray_tpu.common.constants import PG_CREATED
+        from ray_tpu.common.ids import NodeID, PlacementGroupID, WorkerID
+        from ray_tpu.common.resources import ResourceSet
+        from ray_tpu.core.gcs import (
+            GcsServer,
+            NodeEntry,
+            PlacementGroupEntry,
+        )
+
+        class _RayletConn:
+            closed = False
+
+            def __init__(self):
+                self.lease_calls = 0
+
+            async def call(self, method, p, **kw):
+                assert method == "lease_worker"
+                self.lease_calls += 1
+                return {
+                    "worker_id": WorkerID.random().binary(),
+                    "worker_addr": "127.0.0.1:1",
+                }
+
+            async def notify(self, *a, **kw):
+                return True
+
+        class _ClientConn:
+            closed = False
+            peer_info: dict = {}
+
+        async def main():
+            gcs = GcsServer()
+            nid = NodeID.random()
+            raylet = _RayletConn()
+            node = NodeEntry(
+                node_id=nid, address="127.0.0.1:1",
+                resources_total=ResourceSet({"CPU": 4}),
+                resources_available=ResourceSet({"CPU": 2}),
+                labels={}, conn=raylet,
+            )
+            gcs.nodes[nid] = node
+            gcs.scheduler.index_node(node)
+            pgid = PlacementGroupID.random()
+            pg = PlacementGroupEntry(
+                pg_id=pgid, name=None, strategy="PACK",
+                bundles=[ResourceSet({"CPU": 2})], state=PG_CREATED,
+                owner_job=None, detached=False, bundle_nodes=[nid],
+                bundle_available=[ResourceSet({"CPU": 2})],
+            )
+            gcs.placement_groups[pgid] = pg
+            demand = ResourceSet({"CPU": 1})
+            p = {"resources": {"CPU": 1}}
+            # healthy node: the grant goes through (sanity of the stub)
+            grant = await gcs._try_grant_pg_lease(
+                pg, [0], demand, _ClientConn(), p
+            )
+            assert grant is not None and raylet.lease_calls == 1
+            # draining node with bundle capacity to spare: NO grant
+            node.draining = True
+            grant = await gcs._try_grant_pg_lease(
+                pg, [0], demand, _ClientConn(), p
+            )
+            assert grant is None
+            assert raylet.lease_calls == 1, "leased onto a draining node"
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Object evacuation: sole copies survive without reconstruction
+# ---------------------------------------------------------------------------
+
+
+class TestObjectEvacuation:
+    def test_graceful_drain_preserves_sole_copy_object(self):
+        """The sole copy of a task result lives on the preempted node;
+        the drain must push it to a survivor so get() never reconstructs
+        (assert via the runtime's reconstruction counter)."""
+        cluster = Cluster(initialize_head=True, connect=True,
+                          head_node_args={"num_cpus": 2})
+        try:
+            victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+
+            @ray_tpu.remote(resources={"pre": 0.5})
+            def big():
+                return np.arange(300_000, dtype=np.int64)  # > inline cap
+
+            @ray_tpu.remote(resources={"pre": 0.5})
+            def marker():
+                return True
+
+            ref = big.remote()
+            # same-resource marker task: its completion implies big()'s
+            # result is stored (without pulling the big object here,
+            # which would create a second copy and unmake the test)
+            assert ray_tpu.get(marker.remote(), timeout=120) is True
+
+            chaos = ChaosController(cluster, seed=11)
+            node, state = chaos.preempt_node(node=victim, deadline_s=15.0)
+            assert state == "drained", f"drain did not complete: {state}"
+            st = _drain_status(victim.node_id)
+            assert st["objects_moved"] >= 1
+
+            out = ray_tpu.get(ref, timeout=60)
+            assert out.shape == (300_000,) and out[-1] == 299_999
+            assert get_runtime().reconstructions == 0
+            assert [e["event"] for e in chaos.log] == [
+                "node_preempt", "node_kill",
+            ]
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+
+    def test_in_flight_task_result_survives_drain(self):
+        """A task whose lease grant is IN FLIGHT when the drain begins
+        (worker still spawning) stores its sole-copy result mid-drain:
+        the settle phase must wait for the grant+lease (not conclude
+        "nothing here"), and the post-settle evacuation re-scan must
+        carry the result off before the kill."""
+        cluster = Cluster(initialize_head=True, connect=True,
+                          head_node_args={"num_cpus": 2})
+        try:
+            victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+
+            @ray_tpu.remote(resources={"pre": 0.5})
+            def slow_big():
+                time.sleep(1.0)
+                return np.arange(150_000, dtype=np.int64)
+
+            ref = slow_big.remote()
+            time.sleep(0.3)  # grant in flight / worker spawning
+
+            chaos = ChaosController(cluster, seed=13)
+            _, state = chaos.preempt_node(node=victim, deadline_s=15.0)
+            assert state == "drained", f"drain did not complete: {state}"
+            st = _drain_status(victim.node_id)
+            assert st["objects_moved"] >= 1, st  # the re-scan sweep
+
+            out = ray_tpu.get(ref, timeout=60)
+            assert out[-1] == 149_999
+            assert get_runtime().reconstructions == 0
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Actor migration
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class CkptCounter:
+    """Checkpointable: migrates with state, consuming no restart budget."""
+
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def pid(self):
+        return os.getpid()
+
+    def __rt_checkpoint__(self):
+        return {"n": self.n}
+
+    def __rt_restore__(self, state):
+        self.n = state["n"]
+
+
+@ray_tpu.remote
+class PlainCounter:
+    """Hook-less: restarts fresh under its max_restarts budget."""
+
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+    def value(self):
+        return self.n
+
+
+@ray_tpu.remote
+class HangingCkpt:
+    """Checkpoint that never returns: the drain deadline must fire."""
+
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def __rt_checkpoint__(self):
+        time.sleep(120)
+        return {}
+
+    def __rt_restore__(self, state):
+        self.n = state.get("n", 0)
+
+
+def _two_zone_cluster():
+    """head (driver) + a preemptible node; a survivor with the same
+    custom resource is added later so migrated work has somewhere to go
+    (and initial placement is deterministic)."""
+    cluster = Cluster(initialize_head=True, connect=True,
+                      head_node_args={"num_cpus": 2})
+    victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+    cluster.wait_for_nodes(timeout=60)
+    return cluster, victim
+
+
+class TestActorMigration:
+    def test_checkpointable_actor_migrates_with_state(self):
+        cluster, victim = _two_zone_cluster()
+        try:
+            a = CkptCounter.options(
+                num_cpus=0, resources={"pre": 0.5}, max_restarts=0
+            ).remote()
+            for _ in range(3):
+                ray_tpu.get(a.inc.remote(), timeout=120)
+            pid_before = ray_tpu.get(a.pid.remote(), timeout=60)
+
+            cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            chaos = ChaosController(cluster, seed=5)
+            _, state = chaos.preempt_node(node=victim, deadline_s=15.0)
+            assert state == "drained", f"drain did not complete: {state}"
+
+            assert ray_tpu.get(a.value.remote(), timeout=120) == 3
+            assert ray_tpu.get(a.pid.remote(), timeout=60) != pid_before
+            row = _list_actor(a._actor_id.hex())
+            # an intentional migration is not a failure: budget untouched
+            assert row["restarts_used"] == 0 and row["state"] == "ALIVE"
+            st = _drain_status(victim.node_id)
+            assert st["actors_moved"] == 1
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+    def test_hookless_actor_restarts_fresh_under_budget(self):
+        cluster, victim = _two_zone_cluster()
+        try:
+            a = PlainCounter.options(
+                num_cpus=0, resources={"pre": 0.5}, max_restarts=2
+            ).remote()
+            for _ in range(3):
+                ray_tpu.get(a.inc.remote(), timeout=120)
+
+            cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            chaos = ChaosController(cluster, seed=5)
+            _, state = chaos.preempt_node(node=victim, deadline_s=15.0)
+            assert state == "drained", f"drain did not complete: {state}"
+
+            # fresh restart: state reset, one restart consumed
+            assert ray_tpu.get(a.value.remote(), timeout=120) == 0
+            row = _list_actor(a._actor_id.hex())
+            assert row["restarts_used"] == 1 and row["state"] == "ALIVE"
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+    def test_deadline_expiry_falls_back_to_hard_node_death(self):
+        """A wedged __rt_checkpoint__ consumes the whole drain budget:
+        the GCS must fall back to the hard node-death path (never wedge
+        the cluster), and the actor still recovers via the reactive
+        restart machinery."""
+        cluster, victim = _two_zone_cluster()
+        try:
+            a = HangingCkpt.options(
+                num_cpus=0, resources={"pre": 0.5}, max_restarts=1,
+                max_task_retries=2,
+            ).remote()
+            ray_tpu.get(a.inc.remote(), timeout=120)
+
+            cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            chaos = ChaosController(cluster, seed=5)
+            _, state = chaos.preempt_node(node=victim, deadline_s=2.0)
+            assert state in ("failed", "dead"), state
+
+            # the node went through the hard-death path
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                alive = {
+                    n["node_id"]: n["alive"] for n in ray_tpu.nodes()
+                }
+                if alive.get(victim.node_id) is False:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("victim never marked dead")
+
+            # ...and the actor recovered reactively, fresh, on budget
+            assert ray_tpu.get(a.value.remote(), timeout=120) == 0
+            row = _list_actor(a._actor_id.hex())
+            assert row["restarts_used"] == 1 and row["state"] == "ALIVE"
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The node.preempt chaos site (raylet watcher, env-armed)
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptChaosSite:
+    def test_site_delivers_notice_and_node_self_drains(self):
+        """A seeded node.preempt plan inherited via RT_FAULTS makes the
+        raylet's watcher report a preemption (delay_s = announced
+        deadline) — the GCS drains the node without any driver-side
+        intervention."""
+        cluster = Cluster(initialize_head=True, connect=True,
+                          head_node_args={"num_cpus": 2})
+        try:
+            # armed AFTER the head started: only the next raylet
+            # subprocess inherits the plan
+            os.environ["RT_FAULTS"] = json.dumps([
+                {"site": "node.preempt", "action": "preempt",
+                 "nth": 1, "count": 1, "delay_s": 10.0},
+            ])
+            victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            os.environ.pop("RT_FAULTS", None)
+            cluster.wait_for_nodes(timeout=60)
+
+            deadline = time.monotonic() + 30
+            st = {}
+            while time.monotonic() < deadline:
+                st = _drain_status(victim.node_id)
+                if st.get("state") in ("draining", "drained"):
+                    break
+                time.sleep(0.2)
+            assert st.get("state") in ("draining", "drained"), st
+            assert st.get("reason") == "preemption"
+            # the node is excluded from scheduling while it drains
+            nodes = {n["node_id"]: n for n in ray_tpu.nodes()}
+            assert nodes[victim.node_id]["draining"] is True
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Serve: replicas on a draining node enter drain-then-stop
+# ---------------------------------------------------------------------------
+
+
+class TestServeDrain:
+    def test_replica_drains_instead_of_dying_with_node(self):
+        from ray_tpu import serve
+
+        cluster = Cluster(initialize_head=True, connect=True,
+                          head_node_args={"num_cpus": 4})
+        try:
+            victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            serve.start()
+
+            @serve.deployment(ray_actor_options={
+                "num_cpus": 0, "resources": {"pre": 0.5},
+            })
+            class Echo:
+                def __call__(self, x=0):
+                    return {"pid": os.getpid(), "x": x}
+
+            h = serve.run(Echo.bind(), name="drainapp", route_prefix=None)
+            first = h.remote(x=1).result(timeout_s=120)
+            assert first["x"] == 1
+
+            # give the replacement somewhere to run, then preempt
+            cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            chaos = ChaosController(cluster, seed=2)
+            chaos.preempt_node(node=victim, deadline_s=15.0, kill=False)
+
+            # the controller's reconcile must move the replica into
+            # drain-then-stop and spin a replacement on the survivor
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+            deadline = time.monotonic() + 60
+            status = {}
+            while time.monotonic() < deadline:
+                status = ray_tpu.get(ctrl.get_status.remote(), timeout=30)
+                d = status.get("drainapp", {}).get("Echo", {})
+                if d.get("running_replicas", 0) >= 1 and ray_tpu.get(
+                    ctrl.get_routes.remote(), timeout=30
+                )["apps"]["drainapp"]["Echo"]["replicas"]:
+                    second = h.remote(x=2).result(timeout_s=60)
+                    if second["pid"] != first["pid"]:
+                        break
+                time.sleep(0.3)
+            else:
+                raise AssertionError(
+                    f"replacement replica never took over: {status}"
+                )
+
+            # now the kill: service must keep answering
+            chaos.kill_node(victim)
+            out = h.remote(x=3).result(timeout_s=120)
+            assert out["x"] == 3 and out["pid"] != first["pid"]
+            serve.delete("drainapp")
+        finally:
+            try:
+                serve.shutdown()
+            except Exception:
+                pass
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The acceptance scenario: object + stateful actor + collective rank,
+# one seeded preemption, zero loss
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class CkptRank:
+    """A collective rank with user state: both migrate together."""
+
+    def __init__(self):
+        self.tag = None
+
+    def init(self, world, rank, group):
+        col.init_collective_group(world, rank, group_name=group)
+        self.tag = 100 * rank
+        return rank
+
+    def allreduce(self, arr, group):
+        return col.allreduce(arr, group_name=group)
+
+    def rank(self, group):
+        return col.get_rank(group)
+
+    def get_tag(self):
+        return self.tag
+
+    def __rt_checkpoint__(self):
+        return {"tag": self.tag}
+
+    def __rt_restore__(self, state):
+        self.tag = state["tag"]
+
+
+def _rank_data(rank: int, n: int = 65536) -> np.ndarray:
+    rng = np.random.RandomState(4321 + rank)
+    return rng.randint(-1024, 1024, size=n).astype(np.float32)
+
+
+class TestPreemptionAcceptance:
+    def test_seeded_preemption_migrates_everything(self):
+        """A node holding the sole copy of an object, a checkpointable
+        stateful actor (which is rank 2 of a 4-rank group) receives an
+        injected preemption with a 5 s deadline: zero driver-visible
+        task failures, zero lineage re-executions, state intact, and a
+        bit-exact allreduce among the proactively re-formed group."""
+        cluster = Cluster(initialize_head=True, connect=True,
+                          head_node_args={"num_cpus": 4,
+                                          "resources": {"h": 4.0}})
+        try:
+            victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+
+            group = "drain-accept"
+            home = [
+                CkptRank.options(num_cpus=0, resources={"h": 0.5}).remote()
+                for _ in range(3)
+            ]
+            moving = CkptRank.options(
+                num_cpus=0, resources={"pre": 0.4}, max_restarts=0
+            ).remote()
+            members = [home[0], home[1], moving, home[2]]  # ranks 0,1,2,3
+            assert ray_tpu.get(
+                [m.init.remote(4, i, group) for i, m in enumerate(members)],
+                timeout=120,
+            ) == [0, 1, 2, 3]
+            datas = [_rank_data(i) for i in range(4)]
+            expected = datas[0] + datas[1] + datas[2] + datas[3]
+            warm = ray_tpu.get(
+                [m.allreduce.remote(datas[i], group)
+                 for i, m in enumerate(members)],
+                timeout=120,
+            )
+            for o in warm:
+                assert np.array_equal(o, expected)
+
+            @ray_tpu.remote(resources={"pre": 0.4})
+            def big():
+                return np.arange(250_000, dtype=np.int64)
+
+            @ray_tpu.remote(resources={"pre": 0.4})
+            def marker():
+                return True
+
+            ref = big.remote()
+            assert ray_tpu.get(marker.remote(), timeout=120) is True
+
+            # a survivor that can host the migrated rank
+            cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+
+            chaos = ChaosController(cluster, seed=1234)
+            _, state = chaos.preempt_node(node=victim, deadline_s=5.0)
+            assert state == "drained", (
+                f"drain missed the 5 s deadline: {state} "
+                f"({_drain_status(victim.node_id)})"
+            )
+
+            # sole-copy object survived WITHOUT reconstruction
+            out = ray_tpu.get(ref, timeout=60)
+            assert out[-1] == 249_999
+            assert get_runtime().reconstructions == 0
+
+            # actor state rode the checkpoint
+            assert ray_tpu.get(moving.get_tag.remote(), timeout=120) == 200
+            row = _list_actor(moving._actor_id.hex())
+            assert row["restarts_used"] == 0
+
+            # the group proactively re-formed: same ranks, new member
+            # address — wait for every member to report its rank (the
+            # survivors' reform rides pubsub and may lag the drain by a
+            # beat), then demand a bit-exact allreduce
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    ranks = ray_tpu.get(
+                        [m.rank.remote(group) for m in members], timeout=30
+                    )
+                    if ranks == [0, 1, 2, 3]:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.3)
+            else:
+                raise AssertionError("group never finished re-forming")
+
+            out = ray_tpu.get(
+                [m.allreduce.remote(datas[i], group)
+                 for i, m in enumerate(members)],
+                timeout=120,
+            )
+            for o in out:
+                assert np.array_equal(o, expected)
+
+            # the chaos schedule is replayable from its log
+            assert [e["event"] for e in chaos.log] == [
+                "node_preempt", "node_kill",
+            ]
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
