@@ -51,6 +51,7 @@ def __getattr__(name):
         "TaskCancelledError",
         "RuntimeEnvSetupError",
         "NodeDiedError",
+        "FencedError",
     ):
         # error types at the package top level, like ray.exceptions'
         # re-exports (ray: python/ray/exceptions.py)

@@ -126,7 +126,11 @@ class Autoscaler:
         """
         # draining nodes (idle teardown or a preemption notice) are not
         # supply: counting them would suppress the replacement launch
-        # that proactive evacuation needs capacity for
+        # that proactive evacuation needs capacity for.  SUSPECT nodes
+        # (health plane) DO count: the scheduler merely deprioritizes
+        # them, so their queued demand is transient — launching
+        # replacement capacity for every load stall would turn each
+        # suspicion into a billable scale-up/scale-down flap
         free = [
             ResourceSet(n["resources_available"])
             for n in state["nodes"]
@@ -224,6 +228,14 @@ class Autoscaler:
         idle_ids = set()
         for n in state["nodes"]:
             if not n["alive"]:
+                continue
+            if n.get("suspect"):
+                # a failure-suspected node is unreachable-ish right now:
+                # its idle drain would stall on the evacuation pulls and
+                # fall back to a hard kill — let the health plane decide
+                # its fate first (the idle clock also resets: suspicion
+                # usually means the idleness read is stale)
+                self._idle_since.pop(n["node_id"], None)
                 continue
             if n["idle"]:
                 idle_ids.add(n["node_id"])

@@ -77,6 +77,30 @@ D("rpc_connect_timeout_s", float, 30.0)
 D("rpc_call_timeout_s", float, 120.0)
 D("heartbeat_interval_s", float, 1.0)
 D("node_death_timeout_s", float, 10.0)
+
+# --- adaptive failure detection (common/health.py phi-accrual detector;
+# reference role: GcsHealthCheckManager) ---
+# suspicion level (phi = -log10 P(silence)) at which a node enters
+# SUSPECT: deprioritized for new leases / pulls / serve routing, but
+# nothing is killed, reformed, or restarted
+D("health_phi_suspect", float, 3.0)
+# suspicion level that CONFIRMS death (with the wall-clock floor/cap
+# below): recovery machinery (fencing, actor restart, reform) fires
+D("health_phi_death", float, 8.0)
+# rolling inter-heartbeat history window per node
+D("health_window", int, 64)
+# std-deviation floor as a fraction of the mean interval: keeps a
+# metronome-regular history (std ~ 0) from exploding phi on the first
+# late beat — the dominant false-positive mode of accrual detectors
+D("health_min_std_frac", float, 0.35)
+# heartbeats of history required before the adaptive verdict applies
+# (below it, the fixed node_death_timeout_s path decides alone)
+D("health_min_samples", int, 5)
+# wall-clock death FLOOR as a fraction of node_death_timeout_s: phi can
+# confirm death no earlier than this much silence (a whole-process GC /
+# CPU stall on the GCS host must not mass-kill fast-heartbeat nodes);
+# node_death_timeout_s itself remains the hard CAP regardless of phi
+D("health_death_floor_frac", float, 0.5)
 # how long clients (raylets, drivers, workers) keep re-dialing a dead GCS
 # before declaring the cluster lost
 D("gcs_reconnect_max_downtime_s", float, 60.0)
@@ -121,6 +145,12 @@ D("collective_chunk_bytes", int, 4 * 1024 * 1024)  # ring transfer chunk
 D("collective_shm_min_bytes", int, 64 * 1024)
 D("collective_op_timeout_s", float, 120.0)  # per-wait peer-traffic budget
 D("collective_rendezvous_timeout_s", float, 60.0)
+# peer-conn loss on a SUSPECT node defers poisoning until the GCS
+# confirms the node's fate (dead -> poison, recovered -> no-op); this
+# bounds the wait (unresolved past it poisons — fail-safe), with
+# collective_confirm_poll_s the re-check cadence
+D("collective_confirm_death_timeout_s", float, 15.0)
+D("collective_confirm_poll_s", float, 0.25)
 
 # --- streaming generator returns (reference: num_returns="streaming")
 D("streaming_backpressure_items", int, 64)  # unacked items before the

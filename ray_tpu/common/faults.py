@@ -57,10 +57,17 @@ __all__ = [
     "ChaosController",
     "FaultController",
     "FaultPlan",
+    "LINKS_ACTIVE",
+    "LOCAL_ENDPOINT",
     "clear",
+    "cut_link",
+    "heal_link",
     "install",
+    "link_is_cut",
+    "link_log",
     "plans_from_json",
     "plans_to_json",
+    "set_local_endpoint",
     "trace",
 ]
 
@@ -235,6 +242,121 @@ _activate_from_env()
 
 
 # ---------------------------------------------------------------------------
+# Network partitions: a directional link-cut registry
+# ---------------------------------------------------------------------------
+#
+# A partition is NOT a FaultPlan: it is silence, not an error — frames
+# between two logical endpoints simply stop arriving, in one direction
+# or both, until the link heals.  The registry below is keyed by
+# (src_endpoint, dst_endpoint); the rpc layer consults it at the
+# ``rpc.link`` site (outbound in Connection._write_frames, inbound in
+# Connection._dispatch_msg) whenever both endpoints of a connection are
+# known.  Endpoints are logical names: "gcs" for the control plane, the
+# node id hex for a raylet and every worker/driver attached to that
+# node.  Cuts carry heal-after semantics (a monotonic deadline) so a
+# scripted transient partition self-heals bit-reproducibly; every
+# cut/heal (including auto-heals) is recorded in ``link_log()`` — the
+# replayable half of the determinism contract.
+
+#: (src, dst) -> monotonic heal deadline (math.inf = until heal_link)
+_LINKS: Dict[tuple, float] = {}
+_LINKS_LOCK = threading.Lock()
+_LINK_LOG: List[Dict[str, Any]] = []
+
+#: fast-path flag: when False the rpc.link site is one module-attr
+#: load + branch (same zero-alloc discipline as ACTIVE)
+LINKS_ACTIVE: bool = False
+
+#: this process's logical endpoint ("gcs", a node id hex, ...); set
+#: once by the process entrypoint (gcs/raylet/worker main, or
+#: Runtime.connect for drivers).  None = unlabeled: never cut.
+LOCAL_ENDPOINT: Optional[str] = None
+
+
+def set_local_endpoint(name: str, force: bool = False) -> None:
+    """Label this process for the link-cut site.  First writer wins
+    unless ``force`` — an in-process Raylet/Runtime pair must not
+    relabel the process its entrypoint already named."""
+    global LOCAL_ENDPOINT
+    if LOCAL_ENDPOINT is None or force:
+        LOCAL_ENDPOINT = name
+
+
+def cut_link(src: str, dst: str, duration_s: Optional[float] = None) -> None:
+    """Cut the directional link src -> dst: frames from ``src`` to
+    ``dst`` are dropped.  ``duration_s`` arms auto-heal after that many
+    seconds; None cuts until ``heal_link``."""
+    global LINKS_ACTIVE
+    deadline = (
+        float("inf") if duration_s is None
+        else time.monotonic() + duration_s
+    )
+    with _LINKS_LOCK:
+        _LINKS[(src, dst)] = deadline
+        _LINK_LOG.append({"event": "cut", "src": src, "dst": dst,
+                          "duration_s": duration_s})
+        LINKS_ACTIVE = True
+
+
+def heal_link(src: Optional[str] = None, dst: Optional[str] = None) -> None:
+    """Heal cut links: both endpoints named heals EXACTLY that one
+    direction (src -> dst; the asymmetric-route scenarios depend on
+    healing one leg of a bidirectional cut); one endpoint named heals
+    every cut touching it; neither heals all."""
+    global LINKS_ACTIVE
+    with _LINKS_LOCK:
+        for key in list(_LINKS):
+            s, d = key
+            if src is not None and dst is not None:
+                match = (s, d) == (src, dst)
+            elif src is not None:
+                match = src in (s, d)
+            elif dst is not None:
+                match = dst in (s, d)
+            else:
+                match = True
+            if match:
+                del _LINKS[key]
+                _LINK_LOG.append({"event": "heal", "src": s, "dst": d})
+        if not _LINKS:
+            LINKS_ACTIVE = False
+
+
+def link_is_cut(src: Optional[str], dst: Optional[str]) -> bool:
+    """True when frames src -> dst are currently dropped.  Auto-heals
+    (and logs) cuts whose deadline lapsed — heal-after needs no timer."""
+    global LINKS_ACTIVE
+    if src is None or dst is None:
+        return False
+    with _LINKS_LOCK:
+        deadline = _LINKS.get((src, dst))
+        if deadline is None:
+            return False
+        if time.monotonic() >= deadline:
+            del _LINKS[(src, dst)]
+            _LINK_LOG.append({"event": "auto_heal", "src": src, "dst": dst})
+            if not _LINKS:
+                LINKS_ACTIVE = False
+            return False
+        return True
+
+
+def link_log() -> List[Dict[str, Any]]:
+    """Ordered cut/heal/auto-heal events applied in this process."""
+    with _LINKS_LOCK:
+        return [dict(e) for e in _LINK_LOG]
+
+
+def clear_links() -> None:
+    """Drop every cut and the log (test teardown)."""
+    global LINKS_ACTIVE
+    with _LINKS_LOCK:
+        _LINKS.clear()
+        _LINK_LOG.clear()
+        LINKS_ACTIVE = False
+
+
+# ---------------------------------------------------------------------------
 # ChaosController: driver-side process-level faults
 # ---------------------------------------------------------------------------
 
@@ -363,3 +485,99 @@ class ChaosController:
         self.cluster.remove_node(node, allow_graceful=graceful)
         self._record("node_kill", node_id=node.node_id, graceful=graceful)
         return node
+
+    # -- network partitions ----------------------------------------------
+    def _endpoint_of(self, x) -> str:
+        """Resolve a partition side to its logical endpoint: "gcs", a
+        ClusterNode, or a node-id hex string."""
+        if x == "gcs":
+            return "gcs"
+        nid = getattr(x, "node_id", None)
+        return nid if nid is not None else str(x)
+
+    def _chaos_call(self, address: str, method: str, payload: dict) -> bool:
+        """One-shot rpc to a cluster process (best-effort: a process
+        already dead just misses the install, which is what a real
+        partition would do to it too)."""
+        import asyncio
+
+        from ray_tpu.core import rpc
+
+        async def drive():
+            conn = await rpc.connect(address, name="chaos->proc",
+                                     timeout=5.0)
+            try:
+                return await conn.call(method, payload, timeout=5.0)
+            finally:
+                await conn.close()
+
+        try:
+            asyncio.run(drive())
+            return True
+        except Exception:
+            return False
+
+    def _broadcast_chaos(self, method: str, payload: dict) -> None:
+        """Install a link-cut table change in EVERY cluster process:
+        the GCS, each raylet (which fans out to its workers), and this
+        process (the driver).  Installing a cut in an uninvolved
+        process is harmless — the registry only matches by endpoint."""
+        self._chaos_call(self.cluster.address, method, payload)
+        for n in list(self.cluster._nodes):
+            self._chaos_call(n.address, method, payload)
+        # this (driver) process applies the change in-process
+        if method == "chaos_partition":
+            cut_link(payload["src"], payload["dst"],
+                     payload.get("duration_s"))
+        else:
+            heal_link(payload.get("src"), payload.get("dst"))
+
+    def partition(self, a, b="gcs",
+                  duration_s: Optional[float] = None) -> tuple:
+        """Cut the network between ``a`` and ``b`` in BOTH directions
+        (a, b: ClusterNode, node-id hex, or "gcs").  Frames between the
+        two endpoints — raylet<->GCS, worker<->GCS, raylet<->raylet
+        transfers, driver<->worker pushes — are silently dropped (real
+        partition semantics: silence, not errors) until ``heal()`` or
+        the ``duration_s`` auto-heal.  Returns (endpoint_a, endpoint_b).
+
+        A process spawned AFTER the cut does not inherit it (the
+        registry is per-process state); partition before spawning, or
+        re-issue."""
+        ea, eb = self._endpoint_of(a), self._endpoint_of(b)
+        for src, dst in ((ea, eb), (eb, ea)):
+            self._broadcast_chaos(
+                "chaos_partition",
+                {"src": src, "dst": dst, "duration_s": duration_s},
+            )
+        self._record("partition", a=ea, b=eb, duration_s=duration_s)
+        return ea, eb
+
+    def cut(self, src, dst, duration_s: Optional[float] = None) -> tuple:
+        """Directional half of partition(): only src -> dst frames drop
+        (dst still reaches src) — the asymmetric-route failure mode."""
+        es, ed = self._endpoint_of(src), self._endpoint_of(dst)
+        self._broadcast_chaos(
+            "chaos_partition",
+            {"src": es, "dst": ed, "duration_s": duration_s},
+        )
+        self._record("cut", src=es, dst=ed, duration_s=duration_s)
+        return es, ed
+
+    def heal(self, a=None, b=None) -> None:
+        """Heal partitions: both sides named heals that pair (BOTH
+        directions — the inverse of partition()), one side heals every
+        cut touching it, none heals everything.  Directional heals of a
+        single leg go through ``heal_link`` on the target processes
+        directly (the ``cut()`` twin)."""
+        ea = self._endpoint_of(a) if a is not None else None
+        eb = self._endpoint_of(b) if b is not None else None
+        if ea is not None and eb is not None:
+            # heal_link with both endpoints is exact-direction: undo
+            # the bidirectional partition() install leg by leg
+            for src, dst in ((ea, eb), (eb, ea)):
+                self._broadcast_chaos("chaos_heal",
+                                      {"src": src, "dst": dst})
+        else:
+            self._broadcast_chaos("chaos_heal", {"src": ea, "dst": eb})
+        self._record("heal", a=ea, b=eb)

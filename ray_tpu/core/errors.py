@@ -80,3 +80,22 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class NodeDiedError(RayTpuError):
     pass
+
+
+class FencedError(RayTpuError):
+    """The sender's node incarnation is stale: the cluster declared that
+    node dead (and bumped its incarnation), so RPCs from the old life
+    are rejected.  A raylet receiving this must fence itself — kill its
+    workers, discard its object copies and spill files, and re-register
+    fresh — closing the split-brain window a healed partition opens
+    (two live copies of a named actor, stale lease grants
+    double-executing tasks)."""
+
+
+def is_fenced(exc: BaseException) -> bool:
+    """True when ``exc`` is a FencedError, locally raised or carried
+    inside an rpc.RemoteCallError from a peer's fence check."""
+    if isinstance(exc, FencedError):
+        return True
+    remote = getattr(exc, "remote_exception", None)
+    return isinstance(remote, FencedError)

@@ -33,6 +33,11 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.common.config import cfg
+from ray_tpu.common.health import (
+    PhiAccrualDetector,
+    death_confirmed,
+    is_suspect,
+)
 from ray_tpu.common.constants import (
     PG_CREATED,
     PG_PENDING,
@@ -43,6 +48,7 @@ from ray_tpu.common.constants import (
 from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu.common.resources import ResourceSet
 from ray_tpu.core import rpc
+from ray_tpu.core.errors import FencedError
 
 logger = logging.getLogger(__name__)
 
@@ -61,6 +67,17 @@ class NodeEntry:
     labels: Dict[str, str]
     conn: rpc.Connection
     alive: bool = True
+    # adaptive failure detection (common/health.py): phi crossed the
+    # suspect threshold — the node is DEPRIORITIZED (leases, pulls,
+    # serve routing) but nothing is killed until phi confirms death.
+    # Cleared the moment a heartbeat arrives.
+    suspect: bool = False
+    # monotonically-increasing life counter, bumped on every fresh
+    # (re)registration and on _on_node_death — the fencing token: RPCs
+    # carrying a stale incarnation are rejected with FencedError, so a
+    # zombie raylet on the far side of a healed partition can never
+    # keep serving objects or leases alongside its replacement
+    incarnation: int = 0
     draining: bool = False  # drain requested: stop scheduling onto it
     # drain protocol v2 (rpc_drain_node): why and until when
     drain_reason: Optional[str] = None  # "idle" | "preemption"
@@ -88,7 +105,7 @@ class NodeEntry:
                 sched.note_available_change(self, old, value)
             return
         object.__setattr__(self, name, value)
-        if name in ("alive", "draining", "conn"):
+        if name in ("alive", "draining", "conn", "suspect"):
             sched = self._sched
             if sched is not None:
                 sched.rebucket(self)
@@ -195,7 +212,12 @@ class PendingLease:
 
 _NBUCKETS = 64          # utilization buckets (~1.6% granularity)
 _FULL_BUCKET = _NBUCKETS        # max-utilization >= 1.0
-_PARKED_BUCKET = _NBUCKETS + 1  # dead / draining / not-yet-attached
+_SUSPECT_BUCKET = _NBUCKETS + 1  # alive but failure-suspected: scanned
+#   LAST by every strategy, so a suspect node costs placement
+#   preference (nothing new lands there while healthy capacity exists)
+#   without costing an outage — the DRAINING parking machinery, one
+#   notch softer
+_PARKED_BUCKET = _NBUCKETS + 2  # dead / draining / not-yet-attached
 
 
 class Scheduler:
@@ -251,6 +273,8 @@ class Scheduler:
     def _bucket_of(self, n: NodeEntry) -> int:
         if not n.alive or n.conn is None or n.draining:
             return _PARKED_BUCKET
+        if n.suspect:
+            return _SUSPECT_BUCKET
         u = n.resources_available.utilization(n.resources_total)
         if u >= 1.0:
             return _FULL_BUCKET
@@ -324,15 +348,20 @@ class Scheduler:
         if stype == "spread":
             # least-utilized first (bucket-granular); the "full" bucket
             # still gets scanned last — a node can be max-utilized in one
-            # resource yet cover a demand on another
+            # resource yet cover a demand on another; SUSPECT nodes are
+            # the last resort in every strategy (alive, but failure-
+            # suspected: new work prefers healthy capacity)
             node = self._first_covering(demand, range(0, _FULL_BUCKET + 1))
+            if node is None:
+                node = self._first_covering(demand, (_SUSPECT_BUCKET,))
             if node is None:
                 self._note_nofit(key)
             return node
         # default: hybrid binpack — prefer the most-utilized node that
         # still fits while below the spread threshold, so small tasks pack
         # and big clusters don't fragment (ray: hybrid_scheduling_policy.cc
-        # in spirit); above-threshold nodes next, max-utilized last
+        # in spirit); above-threshold nodes next, max-utilized, then
+        # suspect nodes last
         thresh_b = min(
             int(cfg.sched_spread_threshold * _NBUCKETS), _NBUCKETS
         )
@@ -343,7 +372,7 @@ class Scheduler:
             )
         if node is None:
             node = self._first_covering(
-                demand, (_FULL_BUCKET,)
+                demand, (_FULL_BUCKET, _SUSPECT_BUCKET)
             )
         if node is None:
             self._note_nofit(key)
@@ -505,7 +534,8 @@ _READONLY_RPCS = frozenset({
     "metrics_push", "get_metrics", "get_job_info", "get_job_logs",
     "list_jobs", "list_events", "report_event", "get_worker_death_info",
     "cluster_store_stats", "dump_worker_stacks", "cancel_lease_requests",
-    "dump_tasks", "publish",
+    "dump_tasks", "publish", "chaos_partition", "chaos_heal",
+    "node_health",
 })
 
 
@@ -534,6 +564,12 @@ class GcsServer:
             )
             self.checkpoint_objects._get_state = self._snapshot_object_state
         self.nodes: Dict[NodeID, NodeEntry] = {}
+        # health plane: per-node phi-accrual detectors (alive, attached
+        # nodes only) and the monotonic incarnation counters (persisted
+        # — fencing must survive a GCS restart, or a zombie could
+        # re-enter through the reborn control plane)
+        self.node_health: Dict[NodeID, PhiAccrualDetector] = {}
+        self.node_incarnations: Dict[NodeID, int] = {}
         self.actors: Dict[ActorID, ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (ns, name)
         self.jobs: Dict[JobID, dict] = {}
@@ -612,6 +648,7 @@ class GcsServer:
                 "address": n.address,
                 "resources": n.resources_total.to_dict(),
                 "labels": n.labels,
+                "incarnation": n.incarnation,
                 # a restart must not silently re-admit a node the
                 # provider is mid-way through terminating
                 "draining": n.draining,
@@ -625,6 +662,7 @@ class GcsServer:
         return {
             "version": 1,
             "nodes": nodes,
+            "node_incarnations": dict(self.node_incarnations),
             "actors": actors,
             "named_actors": dict(self.named_actors),
             "jobs": {j: dict(v) for j, v in self.jobs.items()},
@@ -670,6 +708,7 @@ class GcsServer:
         connections that never touched the GCS.
         """
         now = time.monotonic()
+        self.node_incarnations.update(st.get("node_incarnations", {}))
         for nid, n in st["nodes"].items():
             self.nodes[nid] = entry = NodeEntry(
                 node_id=nid,
@@ -679,6 +718,7 @@ class GcsServer:
                 labels=n["labels"],
                 conn=None,
                 alive=True,
+                incarnation=n.get("incarnation", 0),
                 last_heartbeat=now,
             )
             if n.get("draining"):
@@ -850,7 +890,15 @@ class GcsServer:
                     "address": n.address,
                     "resources": n.resources_total.to_dict(),
                     "labels": n.labels,
+                    "incarnation": n.incarnation,
                 }))
+                # the fencing token must be crash-durable with the ack:
+                # a restarted GCS re-admitting a zombie at its old
+                # incarnation would re-open the split-brain window
+                recs.append((
+                    "put", "node_incarnations", nid,
+                    self.node_incarnations.get(nid, n.incarnation),
+                ))
         elif method == "register_job":
             # a fresh registration has no job_id in the payload (the GCS
             # generates one); its row rides the debounced snapshot and the
@@ -921,6 +969,24 @@ class GcsServer:
             subs.discard(conn)
 
     # ---- health --------------------------------------------------------
+    #
+    # Adaptive failure detection (reference role: GcsHealthCheckManager,
+    # gcs_health_check_manager.h, upgraded from fixed-timeout to
+    # phi-accrual — common/health.py).  Verdicts per alive node:
+    #
+    #   phi >= health_phi_suspect  -> SUSPECT: parked in the scheduler's
+    #       last-resort bucket, deprioritized for pulls and serve
+    #       routing; NOTHING killed/reformed/restarted.  Cleared by the
+    #       next heartbeat.
+    #   phi >= health_phi_death AND silence >= floor -> confirmed DEAD
+    #       (floor = health_death_floor_frac x node_death_timeout_s: a
+    #       whole-process stall must not mass-kill fast-heartbeat nodes)
+    #   silence > node_death_timeout_s -> DEAD regardless of phi (hard
+    #       cap: adaptive detection never detects SLOWER than the old
+    #       fixed detector)
+    #
+    # Nodes without enough history (or restored without a conn) keep the
+    # fixed-timeout behavior.
     async def _health_loop(self):
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
@@ -932,9 +998,42 @@ class GcsServer:
             except Exception:
                 pass
             now = time.monotonic()
+            death_floor = (
+                cfg.node_death_timeout_s * cfg.health_death_floor_frac
+            )
             for node in list(self.nodes.values()):
-                if node.alive and now - node.last_heartbeat > cfg.node_death_timeout_s:
-                    await self._on_node_death(node.node_id, "heartbeat timeout")
+                if not node.alive:
+                    continue
+                elapsed = now - node.last_heartbeat
+                det = self.node_health.get(node.node_id)
+                if det is None or not det.ready() or node.conn is None:
+                    if elapsed > cfg.node_death_timeout_s:
+                        await self._on_node_death(
+                            node.node_id, "heartbeat timeout"
+                        )
+                    continue
+                phi = det.phi(now)
+                if death_confirmed(phi, elapsed, cfg.health_phi_death,
+                                   death_floor, cfg.node_death_timeout_s):
+                    await self._on_node_death(
+                        node.node_id,
+                        f"failure detector confirmed death "
+                        f"(phi={phi:.1f}, silent {elapsed:.2f}s)",
+                    )
+                elif is_suspect(phi, cfg.health_phi_suspect) and not node.suspect:
+                    node.suspect = True  # re-buckets to last-resort
+                    self.record_cluster_event(
+                        "WARNING", "gcs",
+                        f"node suspected (phi={phi:.1f}, silent "
+                        f"{elapsed:.2f}s): deprioritized, not killed",
+                        node_id=node.node_id.hex(),
+                    )
+                    await self.publish("nodes", {
+                        "event": "suspect",
+                        "node_id": node.node_id.hex(),
+                        "incarnation": node.incarnation,
+                        "phi": phi,
+                    })
             # Compact cancelled/abandoned pending-lease entries: kicks
             # drop them lazily, but kicks are event-driven — a saturated
             # cluster with clients re-requesting on LEASE_PENDING every
@@ -953,12 +1052,20 @@ class GcsServer:
 
     async def _on_node_death(self, node_id: NodeID, reason: str):
         self._mark_dirty()
-        if self.checkpoint is not None:
-            self.checkpoint.flush()
         node = self.nodes.get(node_id)
         if not node or not node.alive:
             return
         node.alive = False
+        node.suspect = False  # parked now; suspicion is moot
+        # fence the dead life: bump the incarnation counter PAST the
+        # node's, so every RPC the old life may still send (a healed
+        # partition, a zombie raylet) is rejected with FencedError
+        self.node_incarnations[node_id] = max(
+            self.node_incarnations.get(node_id, 0), node.incarnation
+        ) + 1
+        self.node_health.pop(node_id, None)
+        if self.checkpoint is not None:
+            self.checkpoint.flush()
         # a drain in flight for this node is moot now (the failure path
         # pops itself before calling here, so this never self-cancels)
         drain_task = self._drain_tasks.pop(node_id, None)
@@ -1015,7 +1122,13 @@ class GcsServer:
                 "placement_groups",
                 {"event": "rescheduling", "pg_id": pg.pg_id.hex()},
             )
-        await self.publish("nodes", {"event": "dead", "node_id": node_id.hex()})
+        await self.publish("nodes", {
+            "event": "dead",
+            "node_id": node_id.hex(),
+            # the NEW (fenced-to) incarnation: peers raise their
+            # watermark past the dead life's token
+            "incarnation": self.node_incarnations[node_id],
+        })
         self._kick_pending()
 
     async def _on_job_finished(self, job_id: JobID):
@@ -1054,8 +1167,47 @@ class GcsServer:
         return True
 
     # ---- nodes ---------------------------------------------------------
+    def _check_node_fence(self, node_id: NodeID, inc) -> None:
+        """Reject an RPC carrying a stale node incarnation.  ``inc`` is
+        the sender's claimed incarnation (None = legacy/fresh caller:
+        no check).  The raised FencedError reaches the zombie raylet as
+        a RemoteCallError and triggers its self-fence (kill workers,
+        discard object copies, re-register fresh)."""
+        if inc is None:
+            return
+        cur = self.node_incarnations.get(node_id, 0)
+        if inc < cur:
+            raise FencedError(
+                f"node {node_id.hex()[:12]} incarnation {inc} is stale "
+                f"(current {cur}): the node was declared dead — fence "
+                f"yourself (kill workers, discard objects) and "
+                f"re-register fresh"
+            )
+
     async def rpc_register_node(self, conn, p):
         node_id = NodeID(p["node_id"])
+        # incarnation assignment: a fresh registration (no claimed
+        # incarnation) always starts a NEW life; a reconnect claiming
+        # the CURRENT incarnation keeps its life (transient conn loss /
+        # GCS restart — its object copies and leases are still valid);
+        # a stale claim is fenced — the raylet must purge before
+        # re-joining (closing the healed-partition split brain)
+        prev_inc = p.get("incarnation")
+        cur = self.node_incarnations.get(node_id, 0)
+        prev_entry = self.nodes.get(node_id)
+        if prev_inc is not None:
+            self._check_node_fence(node_id, prev_inc)
+            if prev_entry is not None and not prev_entry.alive:
+                # counter bump lost (pre-fencing snapshot): still treat
+                # a re-registration from a declared-dead life as fenced
+                raise FencedError(
+                    f"node {node_id.hex()[:12]} was declared dead; "
+                    f"purge and re-register fresh"
+                )
+            inc = max(prev_inc, cur)
+        else:
+            inc = cur + 1
+        self.node_incarnations[node_id] = inc
         entry = NodeEntry(
             node_id=node_id,
             address=p["address"],
@@ -1063,6 +1215,7 @@ class GcsServer:
             resources_available=ResourceSet(p["resources"]),
             labels=p.get("labels", {}),
             conn=conn,
+            incarnation=inc,
         )
         # Re-registration (GCS restarted, raylet re-attaching): the fresh
         # available pool must re-absorb reservations that survive a
@@ -1148,6 +1301,15 @@ class GcsServer:
         self.nodes[node_id] = entry
         self.scheduler.index_node(entry)
         self._conn_node[conn] = node_id
+        # label the conn for the partition plane + start a fresh
+        # inter-heartbeat history (stale stats from the previous life
+        # would poison the adaptive detector's first verdicts)
+        conn.peer_endpoint = node_id.hex()
+        self.node_health[node_id] = PhiAccrualDetector(
+            window=cfg.health_window,
+            min_std_frac=cfg.health_min_std_frac,
+            min_samples=cfg.health_min_samples,
+        )
         await self.publish(
             "nodes",
             {
@@ -1158,19 +1320,40 @@ class GcsServer:
                 "event": "draining" if entry.draining else "alive",
                 "node_id": node_id.hex(),
                 "address": p["address"],
+                "incarnation": inc,
             },
         )
         logger.info(
-            "node %s registered: %s %s",
-            node_id, p["address"], entry.resources_total,
+            "node %s registered: %s %s (incarnation %d)",
+            node_id, p["address"], entry.resources_total, inc,
         )
         self._kick_pending()
-        return {"gcs_time": time.time()}
+        return {"gcs_time": time.time(), "incarnation": inc}
 
     async def rpc_heartbeat(self, conn, p):
-        node = self.nodes.get(NodeID(p["node_id"]))
+        node_id = NodeID(p["node_id"])
+        # fencing: a zombie's heartbeat is the rendezvous where it
+        # LEARNS it was declared dead (the heal-side of a partition)
+        self._check_node_fence(node_id, p.get("incarnation"))
+        node = self.nodes.get(node_id)
         if node:
-            node.last_heartbeat = time.monotonic()
+            now = time.monotonic()
+            node.last_heartbeat = now
+            det = self.node_health.get(node_id)
+            if det is not None:
+                det.heartbeat(now)
+            if node.suspect:
+                node.suspect = False  # un-parks in the scheduler index
+                self.record_cluster_event(
+                    "INFO", "gcs", "suspected node recovered",
+                    node_id=node_id.hex(),
+                )
+                await self.publish("nodes", {
+                    "event": "recovered",
+                    "node_id": node_id.hex(),
+                    "incarnation": node.incarnation,
+                })
+                self._kick_pending()
         return True
 
     async def rpc_get_nodes(self, conn, p):
@@ -1180,6 +1363,8 @@ class GcsServer:
                 "address": n.address,
                 # a restored-but-unattached node is not usable yet
                 "alive": n.alive and n.conn is not None,
+                "suspect": n.suspect,
+                "incarnation": n.incarnation,
                 "draining": n.draining,
                 "resources_total": n.resources_total.to_dict(),
                 "resources_available": n.resources_available.to_dict(),
@@ -1230,6 +1415,23 @@ class GcsServer:
 
     async def rpc_register_worker(self, conn, p):
         self._worker_conns[WorkerID(p["worker_id"])] = conn
+        # workers/drivers share their node's fate under a partition:
+        # label the conn so the link-cut site can match it
+        if p.get("node_id"):
+            conn.peer_endpoint = p["node_id"]
+        return True
+
+    # ---- chaos (network-partition installs; see common/faults.py) ------
+    async def rpc_chaos_partition(self, conn, p):
+        from ray_tpu.common import faults
+
+        faults.cut_link(p["src"], p["dst"], p.get("duration_s"))
+        return True
+
+    async def rpc_chaos_heal(self, conn, p):
+        from ray_tpu.common import faults
+
+        faults.heal_link(p.get("src"), p.get("dst"))
         return True
 
     # ---- kv ------------------------------------------------------------
@@ -1256,6 +1458,9 @@ class GcsServer:
     # ---- object directory ---------------------------------------------
     async def rpc_add_object_location(self, conn, p):
         oid = p["object_id"]
+        # a zombie raylet's announce must not re-enter the directory:
+        # its arena is about to be (or was) discarded by the fence
+        self._check_node_fence(NodeID(p["node_id"]), p.get("incarnation"))
         if oid in self._freed_tombstones:
             return False  # announce raced the free; do not resurrect
         self.object_locations.setdefault(oid, set()).add(NodeID(p["node_id"]))
@@ -1268,6 +1473,7 @@ class GcsServer:
 
     async def rpc_add_spilled_location(self, conn, p):
         oid = p["object_id"]
+        self._check_node_fence(NodeID(p["node_id"]), p.get("incarnation"))
         # A spill can race the object's free: the raylet picked the victim
         # before delete_objects arrived.  Registering a spilled location
         # for a freed object would orphan the file forever — refuse, and
@@ -1310,7 +1516,13 @@ class GcsServer:
         for nid in locs or ():
             node = self.nodes.get(nid)
             if node and node.alive:
-                out.append({"node_id": nid.hex(), "address": node.address})
+                # pullers prefer non-suspect copies: a stalled/partition-
+                # suspected node would cost a full pull timeout per try
+                out.append({
+                    "node_id": nid.hex(),
+                    "address": node.address,
+                    "suspect": node.suspect,
+                })
         spilled = None
         snid = self.spilled_objects.get(oid)
         if snid is not None:
@@ -1437,18 +1649,25 @@ class GcsServer:
             key=lambda i: -sum(pg.bundles[i]._fp.values()),
         )
 
-    def _place_bundles(self, pg: PlacementGroupEntry) -> Optional[Dict[int, NodeID]]:
+    def _place_bundles(
+        self, pg: PlacementGroupEntry, include_suspect: bool = False
+    ) -> Optional[Dict[int, NodeID]]:
         """Choose a node for every unplaced bundle, or None if impossible now.
 
         Works against a scratch copy of availability so the decision is
         atomic: either every missing bundle fits, or nothing is reserved.
         (The reference does this with a 2-phase prepare/commit across
         raylets — bundle_scheduling_policy.cc; here one atomic pass.)
+        Suspect nodes are excluded unless ``include_suspect`` — the
+        caller retries with them only when healthy capacity can't place
+        the gang (a transient stall must not block PG creation, but it
+        must not attract fresh gangs either).
         """
         alive = {
             n.node_id: n
             for n in self.nodes.values()
             if n.alive and n.conn is not None and not n.draining
+            and (include_suspect or not n.suspect)
         }
         avail = {nid: n.resources_available for nid, n in alive.items()}
         missing = [i for i in range(len(pg.bundles)) if pg.bundle_nodes[i] is None]
@@ -1493,6 +1712,13 @@ class GcsServer:
 
     def _try_place_pg(self, pg: PlacementGroupEntry) -> bool:
         assignment = self._place_bundles(pg)
+        if assignment is None and any(
+            n.suspect and n.alive and n.conn is not None and not n.draining
+            for n in self.nodes.values()
+        ):
+            # healthy capacity can't place the gang: fall back to
+            # suspect nodes rather than park the PG behind a stall
+            assignment = self._place_bundles(pg, include_suspect=True)
         if assignment is None:
             return False
         for i, nid in assignment.items():
@@ -1995,6 +2221,10 @@ class GcsServer:
             {
                 "node_id": n.node_id.hex(),
                 "alive": n.alive and n.conn is not None,
+                # suspect nodes still COUNT as supply (autoscaler: a
+                # transient stall must not launch replacement capacity)
+                # but must not be idle-drained while their fate is open
+                "suspect": n.suspect,
                 "draining": n.draining,
                 "labels": n.labels,
                 "resources_total": n.resources_total.to_dict(),
@@ -2220,10 +2450,14 @@ class GcsServer:
             self._kick_pending()  # place the evicted bundles elsewhere now
 
     def _drain_targets(self, node: NodeEntry) -> List[NodeEntry]:
-        return [
+        targets = [
             n for n in self.nodes.values()
             if n.alive and n.conn is not None and not n.draining
         ]
+        # healthy targets first: evacuating onto a failure-suspected
+        # node risks a second move (or a loss) moments later
+        targets.sort(key=lambda n: n.suspect)
+        return targets
 
     def _node_is_doomed(self, nid: NodeID) -> bool:
         n = self.nodes.get(nid)
@@ -2876,6 +3110,7 @@ class GcsServer:
             "actor_id": actor.actor_id.binary(),
             "state": actor.state,
             "worker_addr": actor.worker_addr,
+            "node_id": actor.node_id.hex() if actor.node_id else None,
             "name": actor.name,
             "death_cause": actor.death_cause,
             "resources": actor.resources,
@@ -3048,6 +3283,15 @@ class GcsServer:
 
     async def rpc_worker_died(self, conn, p):
         """Raylet reports a worker process exited."""
+        if p.get("node_id") is not None and p.get("incarnation") is not None:
+            # a zombie's death report must not break its replacement's
+            # state (notify: swallow instead of raise)
+            try:
+                self._check_node_fence(
+                    NodeID(p["node_id"]), p["incarnation"]
+                )
+            except FencedError:
+                return False
         wid = WorkerID(p["worker_id"])
         # keep a bounded trail of death reasons so drivers can enrich
         # their WorkerCrashedError (e.g. "killed by the memory monitor")
@@ -3094,6 +3338,25 @@ class GcsServer:
             for a in self.actors.values()
         ]
 
+    async def rpc_node_health(self, conn, p):
+        """Health-plane observability: per-node suspicion level, silence,
+        and incarnation (what the dashboard/tests/bench read instead of
+        groping NodeEntry internals)."""
+        now = time.monotonic()
+        out = {}
+        for nid, n in self.nodes.items():
+            det = self.node_health.get(nid)
+            out[nid.hex()] = {
+                "alive": n.alive and n.conn is not None,
+                "suspect": n.suspect,
+                "incarnation": n.incarnation,
+                "phi": det.phi(now) if det is not None else None,
+                "silent_s": now - n.last_heartbeat,
+                "mean_interval_s": det.mean() if det is not None else None,
+                "samples": len(det._intervals) if det is not None else 0,
+            }
+        return out
+
     async def rpc_ping(self, conn, p):
         return {"time": time.time(), "uptime": time.time() - self._start_time}
 
@@ -3125,6 +3388,11 @@ def main():
 
     logging.basicConfig(level=logging.INFO,
                         format="[gcs] %(levelname)s %(message)s")
+
+    # partition plane: this process IS the control-plane endpoint
+    from ray_tpu.common import faults as _faults
+
+    _faults.set_local_endpoint("gcs")
 
     # SIGUSR1 → dump all thread stacks to stderr (the gcs log): the
     # zero-dependency "where is it stuck" probe

@@ -33,6 +33,7 @@ from ray_tpu.common import faults
 from ray_tpu.common.config import cfg
 from ray_tpu.common.ids import NodeID, WorkerID
 from ray_tpu.core import rpc
+from ray_tpu.core.errors import FencedError, is_fenced
 
 logger = logging.getLogger(__name__)
 
@@ -123,6 +124,17 @@ class Raylet:
         # preemption watcher) — new leases are refused while in-flight
         # work finishes inside the announced deadline
         self.draining = False
+        # incarnation fencing: this life's token (assigned by the GCS at
+        # registration, carried on every raylet->GCS and peer->raylet
+        # RPC); a FencedError reply means the cluster declared this life
+        # dead — _fence_self kills the workers, discards the object
+        # copies, and re-registers fresh
+        self.incarnation = 0
+        self._fencing = False
+        # peer incarnation watermarks (node hex -> highest incarnation
+        # seen, via the "nodes" pubsub channel and peer RPC payloads):
+        # an inbound peer RPC below the watermark is rejected
+        self._node_incs: Dict[str, int] = {}
 
     # ---- lifecycle -----------------------------------------------------
     async def start(self):
@@ -131,6 +143,9 @@ class Raylet:
             os.unlink(self.store_path)
         self.store = ShmStore(self.store_path, self.store_capacity, create=True)
         await self.server.start()
+        # partition plane: this raylet (and every worker it spawns) is
+        # the node's logical endpoint
+        faults.set_local_endpoint(self.node_id.hex())
         # Reconnecting channel: a GCS crash/restart no longer kills the
         # node — the raylet re-dials, re-registers (same node_id), and the
         # GCS restores cluster state from its checkpoint (gcs.py
@@ -140,16 +155,13 @@ class Raylet:
             self.gcs_address, self._handle, name="raylet->gcs",
             on_reconnect=self._register_with_gcs,
             on_give_up=self._on_gcs_lost,
+            peer_endpoint="gcs",
         )
-        await self.gcs.call(
-            "register_node",
-            {
-                "node_id": self.node_id.binary(),
-                "address": self.server.address,
-                "resources": self.resources,
-                "labels": self.labels,
-            },
-        )
+        reply = await self.gcs.call("register_node", self._register_payload())
+        self.incarnation = int((reply or {}).get("incarnation", 0) or 0)
+        # incarnation watermarks for peer->raylet fencing ride the
+        # "nodes" pubsub channel (suspect/dead/alive events carry them)
+        await self.gcs.call("subscribe", {"channel": "nodes"})
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         self._tasks.append(loop.create_task(self._reaper_loop()))
@@ -168,18 +180,58 @@ class Raylet:
             self.node_id, self.server.address, self.store_path, self.store_capacity,
         )
 
+    def _register_payload(self, fresh: bool = False) -> dict:
+        return {
+            "node_id": self.node_id.binary(),
+            "address": self.server.address,
+            "resources": self.resources,
+            "labels": self.labels,
+            # claim the current life on reconnects so object copies and
+            # leases carry over; None starts a NEW incarnation
+            "incarnation": (
+                None if fresh or not self.incarnation else self.incarnation
+            ),
+        }
+
     async def _register_with_gcs(self, conn):
-        """Re-attach to a reborn GCS over a fresh connection."""
-        await conn.call(
-            "register_node",
-            {
-                "node_id": self.node_id.binary(),
-                "address": self.server.address,
-                "resources": self.resources,
-                "labels": self.labels,
-            },
+        """Re-attach to a reborn GCS over a fresh connection.  NB: runs
+        inside ReconnectingConnection._ensure — must use ``conn``
+        directly (self.gcs.call would deadlock on the redial lock)."""
+        try:
+            reply = await conn.call("register_node", self._register_payload())
+        except rpc.RemoteCallError as e:
+            if not is_fenced(e):
+                raise
+            # declared dead while we were away (partition healed): purge
+            # this life's state, then join as a fresh incarnation.  The
+            # _fencing guard holds across the purge AND the fresh
+            # registration: leases are refused meanwhile, and a
+            # concurrent peer-fence (_fence_self off a rejected pull)
+            # must not purge a second time — it would destroy the
+            # rebuilt arena and kill workers just leased to the new
+            # incarnation.  Conversely, if a _fence_self purge is
+            # already in flight (it set _fencing before blocking on
+            # this redial's lock), skip the purge here and only
+            # re-register fresh.
+            already_fencing = self._fencing
+            self._fencing = True
+            try:
+                if not already_fencing:
+                    await self._purge_for_fence(
+                        "re-registration rejected: stale incarnation"
+                    )
+                reply = await conn.call(
+                    "register_node", self._register_payload(fresh=True)
+                )
+            finally:
+                if not already_fencing:
+                    self._fencing = False
+        self.incarnation = int((reply or {}).get("incarnation", 0) or 0)
+        await conn.call("subscribe", {"channel": "nodes"})
+        logger.info(
+            "raylet %s re-registered with GCS (incarnation %d)",
+            self.node_id, self.incarnation,
         )
-        logger.info("raylet %s re-registered with GCS", self.node_id)
 
     def _on_gcs_lost(self):
         if not self._closing:
@@ -226,9 +278,29 @@ class Raylet:
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
             try:
-                await self.gcs.notify(
-                    "heartbeat", {"node_id": self.node_id.binary()}
+                # a CALL, not a notify: the reply channel is where a
+                # zombie learns it was fenced.  urgent=True writes the
+                # tiny frame ahead of any per-tick BATCH accumulation
+                # and skips transport flow-control waits — a loaded
+                # tick must not delay the detector's input (that delay
+                # IS the false-positive mode the phi detector absorbs).
+                # The timeout is ONE interval: delivery is one-way for
+                # liveness (the reply only carries fencing), and a lost
+                # heartbeat must not block the next one past the
+                # detector's death floor — that would turn a healed
+                # sub-threshold partition into a false death.
+                await self.gcs.call(
+                    "heartbeat",
+                    {
+                        "node_id": self.node_id.binary(),
+                        "incarnation": self.incarnation,
+                    },
+                    timeout=max(cfg.heartbeat_interval_s, 0.2),
+                    urgent=True,
                 )
+            except rpc.RemoteCallError as e:
+                if is_fenced(e):
+                    await self._fence_self(str(e.remote_exception))
             except Exception:
                 pass
             # collect dead worker processes
@@ -328,6 +400,7 @@ class Raylet:
             reply = await self.gcs.call("add_spilled_location", {
                 "object_id": oid,
                 "node_id": self.node_id.binary(),
+                "incarnation": self.incarnation,
                 "size": size,
             })
         except Exception:
@@ -508,6 +581,122 @@ class Raylet:
         the same close() path SIGTERM takes — workers killed, arena
         unlinked, node deregistered."""
         self.stop_requested.set()
+        return True
+
+    # ---- incarnation fencing --------------------------------------------
+
+    async def _purge_for_fence(self, reason: str):
+        """Discard everything this (declared-dead) life owned: workers
+        are hard-killed (a named actor must never execute on two nodes
+        at once — the replacement is already running elsewhere), the
+        shm arena is destroyed and re-created empty (our object copies
+        were dropped from the directory at death; serving them again
+        would resurrect stale locations), and spill files are deleted."""
+        logger.error(
+            "raylet %s FENCED (%s): killing %d worker(s), discarding "
+            "object copies, re-registering fresh",
+            self.node_id, reason, len(self.workers),
+        )
+        for w in list(self.workers.values()):
+            self._hard_kill_worker(w)
+        self.workers.clear()
+        self._idle_by_env.clear()
+        self._tpu_chips_free = set(range(int(self.resources.get("TPU", 0))))
+        for oid in list(self._spilled):
+            self._drop_spill_file(oid)
+        try:
+            self.store.destroy()
+        except Exception:
+            logger.exception("fenced arena teardown failed")
+        try:
+            self.store = ShmStore(
+                self.store_path, self.store_capacity, create=True
+            )
+        except Exception:
+            logger.exception("fenced arena rebuild failed")
+        self.draining = False
+
+    async def _fence_self(self, reason: str):
+        """A FencedError reached us (stale incarnation — the cluster
+        declared this node dead, e.g. across a healed partition): purge
+        this life and re-register as a fresh incarnation.  Failure to
+        re-register leaves the stale token in place, so the next
+        heartbeat's fence reply retries the whole sequence."""
+        if self._fencing or self._closing:
+            return
+        self._fencing = True
+        try:
+            await self._purge_for_fence(reason)
+            reply = await self.gcs.call(
+                "register_node", self._register_payload(fresh=True)
+            )
+            self.incarnation = int((reply or {}).get("incarnation", 0) or 0)
+            await self.gcs.call("subscribe", {"channel": "nodes"})
+            logger.warning(
+                "raylet %s re-joined as incarnation %d",
+                self.node_id, self.incarnation,
+            )
+        except Exception:
+            logger.exception(
+                "fence recovery failed; retrying on next heartbeat"
+            )
+        finally:
+            self._fencing = False
+
+    def _note_peer_inc(self, p) -> None:
+        """peer->raylet fencing: reject RPCs whose sender's incarnation
+        sits below this node's watermark (learned from the GCS "nodes"
+        pubsub and from peer payloads themselves), and raise the
+        watermark on newer tokens."""
+        fn, fi = p.get("from_node"), p.get("from_inc")
+        if fn is None or fi is None:
+            return
+        known = self._node_incs.get(fn, 0)
+        if fi < known:
+            raise FencedError(
+                f"peer {fn[:12]} incarnation {fi} is stale (watermark "
+                f"{known}): fence yourself and re-register"
+            )
+        if fi > known:
+            self._node_incs[fn] = fi
+
+    def _peer_stamp(self) -> dict:
+        return {
+            "from_node": self.node_id.hex(),
+            "from_inc": self.incarnation,
+        }
+
+    async def rpc_publish(self, conn, p):
+        """GCS pubsub push (we subscribe to "nodes"): keep incarnation
+        watermarks current so stale peers are rejected promptly."""
+        if p.get("channel") != "nodes":
+            return True
+        msg = p.get("message") or {}
+        nid, inc = msg.get("node_id"), msg.get("incarnation")
+        if nid and inc is not None and inc > self._node_incs.get(nid, 0):
+            self._node_incs[nid] = inc
+        return True
+
+    # ---- chaos (network-partition installs; see common/faults.py) ------
+    async def rpc_chaos_partition(self, conn, p):
+        faults.cut_link(p["src"], p["dst"], p.get("duration_s"))
+        # workers share the node's network fate: fan the cut out
+        for w in list(self.workers.values()):
+            if w.conn is not None and not w.conn.closed:
+                try:
+                    await w.conn.notify("chaos_partition", p)
+                except Exception:
+                    pass
+        return True
+
+    async def rpc_chaos_heal(self, conn, p):
+        faults.heal_link(p.get("src"), p.get("dst"))
+        for w in list(self.workers.values()):
+            if w.conn is not None and not w.conn.closed:
+                try:
+                    await w.conn.notify("chaos_heal", p)
+                except Exception:
+                    pass
         return True
 
     async def rpc_spill_now(self, conn, p):
@@ -884,12 +1073,15 @@ class Raylet:
         Returns its address."""
         from ray_tpu.core import runtime_env as rtenv_mod
 
-        if self.draining:
+        if self.draining or self._fencing:
             # belt-and-braces with the GCS-side exclusion: a grant that
             # was in flight when the drain notify landed must not bind a
-            # fresh worker to a node about to be terminated
+            # fresh worker to a node about to be terminated (or one
+            # mid-fence, whose workers are being purged)
             raise rpc.RpcError(
-                f"node {self.node_id.hex()[:12]} is draining; lease refused"
+                f"node {self.node_id.hex()[:12]} is "
+                f"{'draining' if self.draining else 'fencing'}; "
+                f"lease refused"
             )
         resources = p["resources"]
         rtenv = p.get("runtime_env")
@@ -1042,7 +1234,9 @@ class Raylet:
         try:
             await self.gcs.notify(
                 "worker_died",
-                {"worker_id": w.worker_id.binary(), "reason": reason},
+                {"worker_id": w.worker_id.binary(), "reason": reason,
+                 "node_id": self.node_id.binary(),
+                 "incarnation": self.incarnation},
             )
         except Exception:
             pass
@@ -1108,6 +1302,10 @@ class Raylet:
             loc for loc in locations if loc["node_id"] != self.node_id.hex()
         ]
         random.shuffle(peers)
+        # health plane: non-suspect copies first (stable sort keeps the
+        # shuffle within each class) — a failure-suspected replica costs
+        # a full transfer timeout per attempt, so it is the last resort
+        peers.sort(key=lambda loc: bool(loc.get("suspect")))
         if not peers and self.store.contains(oid):
             return True
         last_err = None
@@ -1125,6 +1323,14 @@ class Raylet:
                 last_err = e
                 continue
             except Exception as e:
+                if is_fenced(e):
+                    # a peer rejected OUR incarnation: this whole life
+                    # is stale — fence now (kills workers, discards
+                    # copies); the pull fails with the node's old life
+                    asyncio.get_running_loop().create_task(
+                        self._fence_self("peer rejected our incarnation")
+                    )
+                    return False
                 last_err = e
                 transient = True
                 continue
@@ -1135,9 +1341,13 @@ class Raylet:
     async def _pull_from(self, oid: bytes, loc, all_peers) -> bool:
         """Fetch one object from `loc` (chunked + pipelined when large,
         striped across additional replicas when available)."""
-        peer = await self._peer(loc["address"])
+        peer = await self._peer(loc["address"], loc.get("node_id"))
+        # every peer->raylet RPC carries the sender's incarnation: a
+        # zombie's fetch is rejected (FencedError) by any peer whose
+        # watermark advanced past the dead life
+        stamp = self._peer_stamp()
         meta = await peer.call(
-            "fetch_object_meta", {"object_id": oid},
+            "fetch_object_meta", {"object_id": oid, **stamp},
             timeout=cfg.rpc_call_timeout_s,
         )
         if meta is None:
@@ -1146,7 +1356,7 @@ class Raylet:
         chunk = cfg.transfer_chunk_bytes
         if size <= chunk:
             data = await peer.call(
-                "fetch_object", {"object_id": oid},
+                "fetch_object", {"object_id": oid, **stamp},
                 timeout=cfg.rpc_call_timeout_s,
             )
             if data is None:
@@ -1169,7 +1379,9 @@ class Raylet:
             if other is loc:
                 continue
             try:
-                sources.append(await self._peer(other["address"]))
+                sources.append(
+                    await self._peer(other["address"], other.get("node_id"))
+                )
             except Exception:
                 continue
         offsets = list(range(0, size, chunk))
@@ -1183,7 +1395,8 @@ class Raylet:
                 try:
                     data = await src.call(
                         "fetch_object_chunk",
-                        {"object_id": oid, "offset": off, "length": length},
+                        {"object_id": oid, "offset": off, "length": length,
+                         **stamp},
                         timeout=cfg.rpc_call_timeout_s,
                     )
                 except Exception:
@@ -1191,7 +1404,8 @@ class Raylet:
                 if (data is None or len(data) != length) and src is not peer:
                     data = await peer.call(
                         "fetch_object_chunk",
-                        {"object_id": oid, "offset": off, "length": length},
+                        {"object_id": oid, "offset": off, "length": length,
+                         **stamp},
                         timeout=cfg.rpc_call_timeout_s,
                     )
                 if data is None or len(data) != length:
@@ -1233,12 +1447,14 @@ class Raylet:
             {
                 "object_id": oid,
                 "node_id": self.node_id.binary(),
+                "incarnation": self.incarnation,
                 "size": size,
             },
         )
 
     async def rpc_fetch_object(self, conn: rpc.Connection, p):
         """A remote raylet asks for an object's bytes (small objects)."""
+        self._note_peer_inc(p)
         oid = p["object_id"]
         pin = self.store.get(oid)
         if pin is None:
@@ -1249,6 +1465,7 @@ class Raylet:
             pin.release()
 
     async def rpc_fetch_object_meta(self, conn: rpc.Connection, p):
+        self._note_peer_inc(p)
         oid = p["object_id"]
         pin = self.store.get(oid)
         if pin is None:
@@ -1260,6 +1477,7 @@ class Raylet:
             pin.release()
 
     async def rpc_fetch_object_chunk(self, conn: rpc.Connection, p):
+        self._note_peer_inc(p)
         oid = p["object_id"]
         off, ln = p["offset"], p["length"]
         pin = self.store.get(oid)
@@ -1293,11 +1511,15 @@ class Raylet:
         st["restore_count"] = self._restore_count
         return st
 
-    async def _peer(self, address: str) -> rpc.Connection:
+    async def _peer(self, address: str,
+                    node_hex: Optional[str] = None) -> rpc.Connection:
         c = self._peer_conns.get(address)
         if c is None or c.closed:
-            c = await rpc.connect(address, name=f"raylet->{address}")
+            c = await rpc.connect(address, name=f"raylet->{address}",
+                                  peer_endpoint=node_hex)
             self._peer_conns[address] = c
+        elif node_hex is not None and c.peer_endpoint is None:
+            c.peer_endpoint = node_hex
         return c
 
 

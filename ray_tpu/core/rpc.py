@@ -109,12 +109,17 @@ class Connection:
         handler: Callable[["Connection", str, Any], Awaitable[Any]],
         name: str = "",
         on_close: Optional[Callable[["Connection"], None]] = None,
+        peer_endpoint: Optional[str] = None,
     ):
         self.reader = reader
         self.writer = writer
         self.handler = handler
         self.name = name
         self.on_close = on_close
+        # logical endpoint of the peer ("gcs", a node id hex) when known
+        # — the key the faults.py link-cut (network partition) site
+        # matches on; None = unlabeled, never cut
+        self.peer_endpoint = peer_endpoint
         self._msg_ids = itertools.count()
         self._pending: Dict[int, asyncio.Future] = {}
         self._send_lock = asyncio.Lock()
@@ -132,17 +137,24 @@ class Connection:
         self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
 
     # -- sending ---------------------------------------------------------
-    async def _send(self, msg) -> None:
+    async def _send(self, msg, urgent: bool = False) -> None:
         bufs = _dump(msg)
         async with self._send_lock:
             if self._closed:
                 raise ConnectionLost(f"connection {self.name} is closed")
             # preserve program order with the coalesced path: anything
-            # queued this tick goes on the wire before this message
-            if self._out_batch:
+            # queued this tick goes on the wire before this message.
+            # urgent (order-independent liveness traffic — heartbeats)
+            # skips both the flush and the drain: its tiny frame must
+            # not queue behind a large coalesced batch or a slow peer's
+            # flow control — a loaded tick would otherwise delay the
+            # detector's input past heartbeat_interval_s and manufacture
+            # the exact false positive the health plane exists to avoid
+            if self._out_batch and not urgent:
                 self._flush_out_batch()
             self._write_frames(bufs)
-            await self.writer.drain()
+            if not urgent:
+                await self.writer.drain()
 
     def _write_frames(self, bufs):
         """Synchronous frame write (header + buffers, no await between
@@ -154,6 +166,12 @@ class Connection:
         as separate writes costs 2-3 syscalls per message — the dominant
         per-RPC term for control-plane traffic.  Large buffers still pass
         through uncopied (a memcpy of a big payload beats nothing)."""
+        # chaos site rpc.link (outbound): a cut (local -> peer) link
+        # swallows the frame — partition semantics are silence, not an
+        # error, so the sender's call simply never completes
+        if faults.LINKS_ACTIVE and self.peer_endpoint is not None:
+            if faults.link_is_cut(faults.LOCAL_ENDPOINT, self.peer_endpoint):
+                return
         fault_ctl = faults.ACTIVE  # bind once: clear() races the check
         if fault_ctl is not None:
             # chaos site rpc.send.frame: drop (frame vanishes — the peer
@@ -185,8 +203,11 @@ class Connection:
         for b in bufs:
             self.writer.write(b)
 
-    async def call(self, method: str, payload: Any = None, timeout: float = None):
-        """timeout=None → config default; timeout<0 → wait forever."""
+    async def call(self, method: str, payload: Any = None,
+                   timeout: float = None, urgent: bool = False):
+        """timeout=None → config default; timeout<0 → wait forever.
+        ``urgent`` writes the request as its own lone frame ahead of any
+        coalesced batch queued this tick (liveness traffic only)."""
         if timeout is None:
             timeout = cfg.rpc_call_timeout_s
         elif timeout < 0:
@@ -195,7 +216,7 @@ class Connection:
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
         try:
-            await self._send((REQUEST, msg_id, method, payload))
+            await self._send((REQUEST, msg_id, method, payload), urgent)
             return await asyncio.wait_for(fut, timeout=timeout)
         finally:
             self._pending.pop(msg_id, None)
@@ -281,8 +302,9 @@ class Connection:
             self._flush_out_batch()
         await self.writer.drain()
 
-    async def notify(self, method: str, payload: Any = None) -> None:
-        await self._send((NOTIFY, 0, method, payload))
+    async def notify(self, method: str, payload: Any = None,
+                     urgent: bool = False) -> None:
+        await self._send((NOTIFY, 0, method, payload), urgent)
 
     # -- receiving -------------------------------------------------------
     async def _read_frame(self):
@@ -333,6 +355,11 @@ class Connection:
         loop) — chaos site ``rpc.recv.msg`` guards the real dispatch,
         so drop/delay/dup/error faults apply per MESSAGE (batched and
         plain frames alike)."""
+        # chaos site rpc.link (inbound): frames from a cut (peer ->
+        # local) link were "lost in the network" — drop before dispatch
+        if faults.LINKS_ACTIVE and self.peer_endpoint is not None:
+            if faults.link_is_cut(self.peer_endpoint, faults.LOCAL_ENDPOINT):
+                return
         fault_ctl = faults.ACTIVE  # bind once: clear() races the check
         if fault_ctl is not None:
             plan = fault_ctl.hit("rpc.recv.msg", f"{self.name}:{method}")
@@ -556,10 +583,12 @@ class ReconnectingConnection:
         ] = None,
         on_give_up: Optional[Callable[[], None]] = None,
         max_downtime_s: float = None,
+        peer_endpoint: Optional[str] = None,
     ):
         self.address = address
         self.handler = handler
         self.name = name
+        self.peer_endpoint = peer_endpoint  # applied to every dialed conn
         self.on_reconnect = on_reconnect
         self.on_give_up = on_give_up
         self.max_downtime_s = (
@@ -599,7 +628,8 @@ class ReconnectingConnection:
                 conn = None
                 try:
                     conn = await connect(
-                        self.address, self.handler, name=self.name
+                        self.address, self.handler, name=self.name,
+                        peer_endpoint=self.peer_endpoint,
                     )
                     if self.on_reconnect and not first_attempt:
                         await self.on_reconnect(conn)
@@ -626,25 +656,28 @@ class ReconnectingConnection:
                             f"for {self.max_downtime_s:.0f}s ({e!r})"
                         ) from e
 
-    async def call(self, method: str, payload: Any = None, timeout: float = None):
+    async def call(self, method: str, payload: Any = None,
+                   timeout: float = None, urgent: bool = False):
         while True:
             conn = await self._ensure()
             try:
-                return await conn.call(method, payload, timeout=timeout)
+                return await conn.call(method, payload, timeout=timeout,
+                                       urgent=urgent)
             except ConnectionLost:
                 if self._closed:
                     raise
                 continue  # _ensure() re-dials with its own deadline
 
-    async def notify(self, method: str, payload: Any = None) -> None:
+    async def notify(self, method: str, payload: Any = None,
+                     urgent: bool = False) -> None:
         conn = await self._ensure()
         try:
-            await conn.notify(method, payload)
+            await conn.notify(method, payload, urgent=urgent)
         except ConnectionLost:
             if self._closed:
                 raise
             conn = await self._ensure()
-            await conn.notify(method, payload)
+            await conn.notify(method, payload, urgent=urgent)
 
     @property
     def closed(self) -> bool:
@@ -667,6 +700,7 @@ async def connect(
     name: str = "",
     on_close: Optional[Callable[[Connection], None]] = None,
     timeout: float = None,
+    peer_endpoint: Optional[str] = None,
 ) -> Connection:
     if timeout is None:
         timeout = cfg.rpc_connect_timeout_s
@@ -685,7 +719,7 @@ async def connect(
         sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
     conn = Connection(
         reader, writer, handler or _null_handler, name=name or address,
-        on_close=on_close,
+        on_close=on_close, peer_endpoint=peer_endpoint,
     )
     conn.start()
     return conn

@@ -198,6 +198,12 @@ class SchedClassState:
 _PENDING_RESULT = object()  # lazy marker: locally-pending result, no async waiter yet
 
 
+def _ignore_pubsub(msg):
+    """Placeholder callback holding the "nodes" channel slot: the
+    runtime's internal node-event hook runs in _gcs_handler regardless
+    of which user callback (if any) owns the slot."""
+
+
 def lease_pending_backoff() -> Backoff:
     """Backoff between LEASE_PENDING re-requests.  The request_lease
     call itself parks at the GCS until woken or expired, so this sleep
@@ -428,18 +434,39 @@ class Runtime:
         self._run(self._connect(), timeout=cfg.rpc_connect_timeout_s + 5)
 
     async def _connect(self):
+        # partition plane: drivers and workers share their node's
+        # logical endpoint (first writer wins — worker_main labels
+        # worker processes before this runs)
+        from ray_tpu.common import faults as _faults
+
+        _faults.set_local_endpoint(self.node_id)
         # Reconnecting channel: survives GCS restarts (the GCS restores
         # its tables from the checkpoint; we re-register our identity).
         self.gcs = rpc.ReconnectingConnection(
             self.gcs_address, self._gcs_handler, name=f"{self.mode}->gcs",
             on_reconnect=self._reattach_gcs,
+            peer_endpoint="gcs",
         )
         self.raylet = await rpc.connect(
-            self.raylet_address, name=f"{self.mode}->raylet"
+            self.raylet_address, name=f"{self.mode}->raylet",
+            peer_endpoint=self.node_id,
         )
         await self.gcs.call(
-            "register_worker", {"worker_id": self.worker_id.binary()}
+            "register_worker",
+            {"worker_id": self.worker_id.binary(), "node_id": self.node_id},
         )
+        # node-event subscription (health plane): a "dead" event closes
+        # our conns to that node's workers.  Under a silent partition a
+        # TCP conn to a dead node never breaks on its own — pushes would
+        # blackhole forever — and after the partition HEALS, a stale
+        # conn could reach a zombie worker the GCS already replaced
+        # (split-brain).  The GCS's death verdict is the authority;
+        # closing the conn routes the actor pump through get_actor to
+        # the replacement.  setdefault: a user subscribe("nodes") (the
+        # serve controller) replaces the callback, not the subscription;
+        # _gcs_handler runs our internal hook regardless.
+        self._subscriptions.setdefault("nodes", _ignore_pubsub)
+        await self.gcs.call("subscribe", {"channel": "nodes"})
         if self.mode == "driver":
             reply = await self.gcs.call("register_job", {"pid": os.getpid()})
             self.job_id = JobID(reply["job_id"])
@@ -465,8 +492,10 @@ class Runtime:
 
     async def _reattach_gcs(self, conn):
         await conn.call(
-            "register_worker", {"worker_id": self.worker_id.binary()}
+            "register_worker",
+            {"worker_id": self.worker_id.binary(), "node_id": self.node_id},
         )
+        self._subscriptions.setdefault("nodes", _ignore_pubsub)
         if self.mode == "driver" and self.job_id is not None:
             await conn.call(
                 "register_job",
@@ -474,6 +503,26 @@ class Runtime:
             )
         for channel in list(self._subscriptions):
             await conn.call("subscribe", {"channel": channel})
+
+    def _on_node_event_internal(self, msg: dict) -> None:
+        """io-loop hook for GCS "nodes" events: when a node is declared
+        DEAD, close every cached conn labeled with it.  The close fails
+        pending pushes with ConnectionLost, so the actor pump requeues
+        and re-resolves through get_actor — landing on the restarted
+        actor instead of blackholing into (or, post-heal, split-braining
+        with) the dead node's zombie workers."""
+        if msg.get("event") != "dead":
+            return
+        nid = msg.get("node_id")
+        if not nid:
+            return
+        for aid, conn in list(self._actor_conns.items()):
+            if conn.peer_endpoint == nid and not conn.closed:
+                self._actor_conns.pop(aid, None)
+                self._loop.create_task(conn.close())
+        for addr, conn in list(self._worker_conns.items()):
+            if conn.peer_endpoint == nid and not conn.closed:
+                self._loop.create_task(conn.close())
 
     def _job_hex(self) -> Optional[str]:
         """Job attribution for specs: the driver's own job, or (in a
@@ -505,6 +554,13 @@ class Runtime:
     async def _gcs_handler(self, conn, method, payload):
         # GCS-initiated pushes (actor restarts target workers; pubsub)
         if method == "publish":
+            if payload.get("channel") == "nodes":
+                # internal health-plane hook, independent of whatever
+                # user callback holds the channel slot
+                try:
+                    self._on_node_event_internal(payload["message"])
+                except Exception:
+                    logger.exception("node-event hook failed")
             cb = self._subscriptions.get(payload.get("channel"))
             if cb is not None:
                 try:
@@ -932,6 +988,13 @@ class Runtime:
         collective group and a task stream to the same peer ride one
         TCP connection."""
         return await self._connect_worker(addr)
+
+    async def peer_connection_to(self, addr: str,
+                                 node_hex: Optional[str] = None):
+        """peer_connection with the peer's node identity, so the conn is
+        labeled for the partition plane (collective backends know their
+        members' nodes; plain addr callers keep the unlabeled form)."""
+        return await self._connect_worker(addr, node_hex)
 
     def _deliver_stream_item(self, conn, p: dict):
         tid = p["task_id"]
@@ -1860,7 +1923,9 @@ class Runtime:
                 pass
             else:
                 try:
-                    conn = await self._connect_worker(grant["worker_addr"])
+                    conn = await self._connect_worker(
+                        grant["worker_addr"], grant.get("node_id")
+                    )
                 except (OSError, rpc.RpcError, asyncio.TimeoutError) as e:
                     # the granted worker died in the grant→dial window
                     # (crash, OOM kill, injected chaos).  Return the
@@ -1899,15 +1964,19 @@ class Runtime:
             st.requests_inflight -= 1
         self._pump_class(class_key, resources, strategy)
 
-    async def _connect_worker(self, addr: str) -> rpc.Connection:
+    async def _connect_worker(self, addr: str,
+                              node_hex: Optional[str] = None) -> rpc.Connection:
         conn = self._worker_conns.get(addr)
         if conn is None or conn.closed:
             conn = await rpc.connect(
                 addr, self._worker_inbound, name=f"->worker@{addr}",
                 on_close=self._on_worker_conn_closed,
+                peer_endpoint=node_hex,
             )
             conn.peer_info["addr"] = addr
             self._worker_conns[addr] = conn
+        elif node_hex is not None and conn.peer_endpoint is None:
+            conn.peer_endpoint = node_hex
         return conn
 
     def _on_worker_conn_closed(self, conn) -> None:
@@ -2274,7 +2343,9 @@ class Runtime:
                         await pending_backoff.wait()
                         continue
                     raise
-            conn = await self._connect_worker(grant["worker_addr"])
+            conn = await self._connect_worker(
+                grant["worker_addr"], grant.get("node_id")
+            )
             # No wall-clock deadline on __init__: arbitrarily long startup
             # (jax import, backend init, first compile) is legal as long as
             # the worker process is alive — its death breaks this TCP
@@ -2339,6 +2410,9 @@ class Runtime:
                     conn = await rpc.connect(
                         info["worker_addr"], self._worker_inbound,
                         name="->actor",
+                        # label for the partition plane: the actor's
+                        # hosting node is its network identity
+                        peer_endpoint=info.get("node_id"),
                     )
                     self._actor_conns[actor_id] = conn
                     self._actor_addrs[actor_id] = info["worker_addr"]

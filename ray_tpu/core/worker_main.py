@@ -124,6 +124,18 @@ class WorkerServer:
             return True
         if method == "ping":
             return {"pid": os.getpid(), "actor": bool(self.actor_instance)}
+        if method == "chaos_partition":
+            # raylet fan-out of a network-partition install: this worker
+            # shares its node's network fate (common/faults.py link cuts)
+            from ray_tpu.common import faults
+
+            faults.cut_link(p["src"], p["dst"], p.get("duration_s"))
+            return True
+        if method == "chaos_heal":
+            from ray_tpu.common import faults
+
+            faults.heal_link(p.get("src"), p.get("dst"))
+            return True
         if method == "dump_stacks":
             # on-demand stack capture (reference role: the dashboard's
             # py-spy integration, dashboard/modules/reporter/
@@ -998,6 +1010,12 @@ def main():
     gcs_addr = os.environ["RT_GCS_ADDR"]
     node_id = os.environ["RT_NODE_ID"]
     store_path = os.environ["RT_STORE_PATH"]
+
+    # partition plane: a worker shares its node's logical endpoint — a
+    # node partition cuts the workers' links too (common/faults.py)
+    from ray_tpu.common import faults as _faults
+
+    _faults.set_local_endpoint(node_id)
 
     rt = Runtime(
         gcs_address=gcs_addr,

@@ -15,6 +15,7 @@ Rule ids are stable and grouped by family:
 - RT111 unbounded-serve-dispatch    (backlog)
 - RT112 unbounded-retry-loop        (retry)
 - RT113 half-checkpoint-pair        (checkpoint)
+- RT114 wall-clock-liveness         (clock)
 
 The RT2xx series (actor-deadlock, objectref-leak, unserializable-
 capture, rank-divergent-collective) is the whole-program rtflow tier —
@@ -33,6 +34,7 @@ from ray_tpu.devtools.rules.backlog import (
     UnpolicedCallSoon,
 )
 from ray_tpu.devtools.rules.checkpoint import HalfCheckpointPair
+from ray_tpu.devtools.rules.clock import WallClockLiveness
 from ray_tpu.devtools.rules.concurrency import UnlockedLazyInit
 from ray_tpu.devtools.rules.persistence import NonAtomicWrite
 from ray_tpu.devtools.rules.remote_api import (
@@ -56,4 +58,5 @@ ALL_RULES = [
     UnboundedServeDispatch,
     UnboundedRetryLoop,
     HalfCheckpointPair,
+    WallClockLiveness,
 ]

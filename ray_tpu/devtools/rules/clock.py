@@ -1,0 +1,168 @@
+"""RT114: wall-clock arithmetic deciding a liveness verdict.
+
+``time.time()`` is NTP-disciplined: it steps — backward on slew
+corrections, forward after a suspend, by whole seconds when a host's
+clock is wrong at boot.  Liveness math (heartbeat ages, death
+timeouts, drain deadlines) built on it turns every clock step into a
+mass false-positive: one NTP correction on the GCS host and the whole
+fleet's ``now - last_heartbeat`` jumps past ``node_death_timeout_s``
+at once — the exact detection-storm the adaptive health plane exists
+to prevent.  Liveness intervals must ride ``time.monotonic()``.
+
+Scope, tuned for precision over recall:
+
+- Only ``Compare`` expressions are candidates (a verdict is a
+  comparison; plain wall-clock *timestamps* — logging, ``started_at``
+  bookkeeping — are legal and common).
+- The comparison's subtree must contain the wall clock: a direct
+  ``time.time()`` call (module-attribute or ``from time import time``
+  alias form), or a local name assigned from one in the same function
+  (the idiomatic ``now = time.time()`` ... ``now - last > timeout``
+  shape).  Reassigning the name from another source clears it.
+- AND the subtree must reference a liveness-marked name: an identifier
+  or attribute containing ``heartbeat``, ``timeout``, ``deadline``,
+  ``expire``, ``liveness``, or ``ttl`` (config knobs like
+  ``cfg.node_death_timeout_s`` and locals like ``drain_deadline``
+  both match).
+
+Wall-clock comparisons against *calendar* quantities (cron schedules,
+certificate expiry dates parsed from wall time) are rare in this tree;
+carry a justified ``rtlint: disable=RT114`` where one is real.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+#: identifier substrings that mark a liveness/deadline quantity
+_LIVENESS_MARKERS = (
+    "heartbeat", "timeout", "deadline", "expire", "liveness", "ttl",
+)
+
+
+def _is_wall_clock_call(node: ast.AST, time_aliases: set) -> bool:
+    """``time.time()`` / ``<alias>.time()`` attribute form, or a bare
+    ``time()`` call whose name was imported from the time module."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return (
+            fn.attr == "time"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in time_aliases
+        )
+    if isinstance(fn, ast.Name):
+        return fn.id in time_aliases and fn.id != "time_module"
+    return False
+
+
+def _subtree_has_wall_clock(node: ast.AST, time_aliases: set,
+                            wall_names: set) -> bool:
+    for sub in ast.walk(node):
+        if _is_wall_clock_call(sub, time_aliases):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in wall_names:
+            return True
+    return False
+
+
+def _subtree_has_liveness_name(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        ident = ""
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        if ident:
+            low = ident.lower()
+            if any(m in low for m in _LIVENESS_MARKERS):
+                return True
+    return False
+
+
+class _ClockVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        # module aliases that resolve to the time module, and bare names
+        # bound to time.time via `from time import time [as t]`
+        self._module_aliases = {"time"}
+        self._fn_aliases: set = set()
+        # per-scope names assigned from a wall-clock call
+        # (`now = time.time()`); innermost scope last
+        self._wall_scopes: list = [set()]
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            if alias.name == "time":
+                self._module_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    self._fn_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self._wall_scopes.append(set())
+        super().visit_FunctionDef(node)
+        self._wall_scopes.pop()
+
+    def visit_AsyncFunctionDef(self, node):
+        self._wall_scopes.append(set())
+        super().visit_AsyncFunctionDef(node)
+        self._wall_scopes.pop()
+
+    def visit_Assign(self, node: ast.Assign):
+        aliases = self._module_aliases | self._fn_aliases
+        names = [
+            t.id for t in node.targets if isinstance(t, ast.Name)
+        ]
+        if names:
+            scope = self._wall_scopes[-1]
+            if _is_wall_clock_call(node.value, aliases):
+                scope.update(names)
+            else:
+                # reassigned from something else (e.g. time.monotonic):
+                # the name no longer carries wall-clock taint
+                scope.difference_update(names)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        aliases = self._module_aliases | self._fn_aliases
+        wall_names = set().union(*self._wall_scopes)
+        if _subtree_has_wall_clock(node, aliases, wall_names) and (
+            _subtree_has_liveness_name(node)
+        ):
+            self.ctx.add(
+                self.rule, node,
+                message="wall-clock time.time() arithmetic compared "
+                        "against a heartbeat/timeout/deadline quantity "
+                        "— one NTP step turns this into a mass false "
+                        "liveness verdict",
+                hint="use time.monotonic() for liveness intervals; "
+                     "time.time() is for human-facing timestamps only",
+            )
+        self.generic_visit(node)
+
+
+class WallClockLiveness(Rule):
+    id = "RT114"
+    name = "wall-clock-liveness"
+    description = (
+        "time.time() arithmetic compared against a heartbeat/timeout/"
+        "deadline value — liveness verdicts must ride time.monotonic() "
+        "(an NTP step would mass-trigger false deaths)"
+    )
+    hint = (
+        "compute liveness intervals from time.monotonic(); keep "
+        "time.time() for human-facing timestamps"
+    )
+    visitor_cls = _ClockVisitor

@@ -94,16 +94,24 @@ class ServeControllerActor:
         # replicas on them enter the drain-then-stop flow — replaced and
         # routed around BEFORE the node dies — instead of dying with it
         self._draining_nodes: set = set()
+        # failure-SUSPECTED nodes (health plane): replicas there are NOT
+        # killed or replaced — they are only penalized in the routers'
+        # pow-2 pick via the route table's per-deployment suspect set,
+        # so a transient stall costs routing preference, not a failover
+        self._suspect_nodes: set = set()
+        self._suspect_replicas: Dict[tuple, tuple] = {}  # (app, dep) -> ids
         try:
             from ray_tpu.core.runtime import get_runtime
 
             get_runtime().subscribe("nodes", self._on_node_event)
-            # seed with drains already in flight: their "draining" event
-            # was published before this controller subscribed (controller
+            # seed with drains/suspicions already in flight: their events
+            # were published before this controller subscribed (controller
             # restart / serve.start during a preemption window)
             for n in get_runtime().nodes():
                 if n.get("draining"):
                     self._draining_nodes.add(n["node_id"])
+                if n.get("suspect"):
+                    self._suspect_nodes.add(n["node_id"])
         except Exception:
             logger.warning("node-event subscribe failed", exc_info=True)
         self._thread = threading.Thread(
@@ -112,14 +120,21 @@ class ServeControllerActor:
         self._thread.start()
 
     def _on_node_event(self, msg: dict):
-        """GCS pubsub callback (io loop): track draining nodes."""
+        """GCS pubsub callback (io loop): track draining and
+        failure-suspected nodes."""
         nid = msg.get("node_id")
         if nid is None:
             return
-        if msg.get("event") == "draining":
+        event = msg.get("event")
+        if event == "draining":
             self._draining_nodes.add(nid)
-        elif msg.get("event") in ("dead", "alive"):
+        elif event in ("dead", "alive"):
             self._draining_nodes.discard(nid)
+            self._suspect_nodes.discard(nid)
+        if event == "suspect":
+            self._suspect_nodes.add(nid)
+        elif event == "recovered":
+            self._suspect_nodes.discard(nid)
 
     # -- deploy API ------------------------------------------------------
     def deploy_application(
@@ -292,8 +307,11 @@ class ServeControllerActor:
     def _reconcile_locked(self) -> bool:
         changed = False
         draining_nodes = set(self._draining_nodes)
+        suspect_nodes = set(self._suspect_nodes)
         actor_nodes: Dict[str, str] = (
-            self._actor_nodes() if draining_nodes else {}
+            self._actor_nodes()
+            if (draining_nodes or suspect_nodes
+                or self._suspect_replicas) else {}
         )
         for st in self._snapshot():
             alive = self._check_health(st.replicas)
@@ -373,6 +391,25 @@ class ServeControllerActor:
                             time.monotonic() + st.drain_timeout_s(),
                         ))
                 if victim is not None:
+                    changed = True
+            # health plane: replicas hosted on failure-SUSPECTED nodes
+            # stay in the route table (nothing is failed over for a
+            # suspicion) but are marked so routers penalize them in the
+            # pow-2 pick; set changes bump the routes version
+            key = (st.app_name, st.name)
+            suspect_ids = tuple(sorted(
+                r._actor_id.hex()
+                for r in st.replicas
+                if actor_nodes.get(r._actor_id.hex()) in suspect_nodes
+            )) if (suspect_nodes or self._suspect_replicas.get(key)) else ()
+            with self._lock:
+                if self._is_current(st) and (
+                    self._suspect_replicas.get(key, ()) != suspect_ids
+                ):
+                    if suspect_ids:
+                        self._suspect_replicas[key] = suspect_ids
+                    else:
+                        self._suspect_replicas.pop(key, None)
                     changed = True
             if st.draining:
                 # NOT folded into `changed`: a drained victim already
@@ -500,6 +537,11 @@ class ServeControllerActor:
                         "replicas": list(st.replicas),
                         "max_ongoing": st.deployment.max_ongoing_requests,
                         "traffic": st.traffic_wire(),
+                        # replica actor-id hexes on failure-suspected
+                        # nodes: routers penalize, never drop
+                        "suspect": list(
+                            self._suspect_replicas.get((app_name, name), ())
+                        ),
                     }
                     for name, st in states.items()
                 }

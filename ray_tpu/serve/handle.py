@@ -27,6 +27,7 @@ class Router:
         self._replicas: List[Any] = []
         self._version = -1
         self._inflight: Dict[Any, int] = {}
+        self._suspect_ids: set = set()  # actor hexes on suspect nodes
         self._last_refresh = 0.0
         self._lock = threading.Lock()
         # deployment policy, learned on refresh: concurrency cap per
@@ -57,6 +58,11 @@ class Router:
             self._replicas = entry["replicas"]
             self.max_ongoing = entry.get("max_ongoing", 100)
             self.traffic = entry.get("traffic")
+            # health plane: replicas on failure-suspected nodes — the
+            # pow-2 pick avoids them while any healthy replica exists
+            # (penalty, not removal: a transient stall must not turn
+            # into a failover)
+            self._suspect_ids = set(entry.get("suspect") or ())
             self._inflight = {
                 r: self._inflight.get(r, 0) for r in self._replicas
             }
@@ -77,6 +83,16 @@ class Router:
             time.sleep(0.1)
             self._refresh(force=True)
         with self._lock:
+            # suspect penalty: sample from healthy replicas while any
+            # exist; an all-suspect deployment degrades to the plain
+            # pow-2 pick (penalized capacity beats no capacity)
+            if self._suspect_ids:
+                healthy = [
+                    r for r in replicas
+                    if r._actor_id.hex() not in self._suspect_ids
+                ]
+                if healthy:
+                    replicas = healthy
             if len(replicas) == 1:
                 chosen = replicas[0]
             else:

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.common.config import cfg
@@ -182,7 +183,65 @@ class CollectiveManager:
                 f"connection (member died?) during group "
                 f"{group!r} traffic"
             )
-            self.fail_group(group, err, propagate=True)
+            # health-plane gate: poisoning (and the reform it triggers)
+            # fires off CONFIRMED death, not suspicion — a conn lost
+            # while the member's node is merely SUSPECT (a stall or a
+            # partition in progress) parks until the GCS resolves the
+            # node's fate.  A healthy-node conn loss (worker kill,
+            # injected reset) poisons immediately, as before.
+            self.rt._spawn(self._confirm_then_fail(group, peer_rank, err))
+
+    async def _confirm_then_fail(self, group: str, peer_rank: int,
+                                 err: Exception):
+        gh = self.groups.get(group)
+        if gh is None or gh.failed is not None:
+            return
+        member = (
+            gh.spec.members[peer_rank]
+            if peer_rank < len(gh.spec.members) else None
+        )
+        deferred = False
+        if member is not None and member.node_id:
+            deadline = (
+                time.monotonic() + cfg.collective_confirm_death_timeout_s
+            )
+            while time.monotonic() < deadline:
+                if gh.failed is not None:
+                    return  # somebody else (a fail relay) resolved it
+                try:
+                    # node_health, not get_nodes: a multi-member stall
+                    # spawns one poller per lost conn, and each poll
+                    # must not serialize the whole cluster's resource
+                    # tables on the GCS loop it is waiting on
+                    rows = await self.rt.gcs.call("node_health", {},
+                                                  timeout=5.0)
+                except Exception:
+                    break  # GCS unreachable: poison (fail-safe)
+                row = rows.get(member.node_id)
+                if row is None or not row.get("alive"):
+                    break  # confirmed dead: poison
+                if not row.get("suspect"):
+                    if deferred:
+                        # the node RECOVERED from suspicion: the conn
+                        # loss may have been partition debris — only a
+                        # live re-dial distinguishes "member fine" from
+                        # "member died during the stall"
+                        try:
+                            peer = await self.rt.peer_connection_to(
+                                member.addr, member.node_id
+                            )
+                            await peer.call(RPC_METHOD, {"op": "ping"},
+                                            timeout=5.0)
+                            return  # member reachable: no poison
+                        except Exception:
+                            pass
+                    break  # healthy node, dead conn: a real member loss
+                deferred = True  # SUSPECT: hold the verdict
+                await asyncio.sleep(cfg.collective_confirm_poll_s)
+        gh = self.groups.get(group)
+        if gh is None or gh.failed is not None:
+            return
+        self.fail_group(group, err, propagate=True)
 
     # ---- failure -------------------------------------------------------
     def _drop_chunk_shm(self, msg: dict):

@@ -100,7 +100,9 @@ class RpcRingBackend(RuntimeBackend):
     async def _conn(self, peer_rank: int):
         m = self.spec.member(peer_rank)
         try:
-            conn = await self.rt.peer_connection(m.addr)
+            # node-labeled dial: the partition plane (faults.py link
+            # cuts) must see collective peer traffic too
+            conn = await self.rt.peer_connection_to(m.addr, m.node_id)
         except (OSError, asyncio.TimeoutError) as e:
             raise CollectiveGroupError(
                 f"cannot reach {self.spec.describe_member(peer_rank)}: "

@@ -129,3 +129,73 @@ class TestSerialization:
         ctx.register_reducer(Weird, lambda w: (Weird, (w.v + 1,)))
         out = ctx.deserialize(ctx.serialize(Weird(1)).to_bytes())
         assert out.v == 2
+
+
+class TestPhiAccrualDetector:
+    """common/health.py: the adaptive failure detector's math contract
+    (the cluster-level behavior lives in test_zz_partition.py)."""
+
+    def _warm(self, interval=0.1, n=50, jitter=0.0, seed=0):
+        import random
+
+        from ray_tpu.common.health import PhiAccrualDetector
+
+        rng = random.Random(seed)
+        d = PhiAccrualDetector(min_std_frac=0.35, min_samples=5)
+        t = 0.0
+        for _ in range(n):
+            t += interval * (1 + rng.uniform(-jitter, jitter))
+            d.heartbeat(t)
+        return d, t
+
+    def test_phi_zero_at_arrival_and_monotonic_with_silence(self):
+        d, t = self._warm(jitter=0.05)
+        assert d.phi(t) == 0.0
+        phis = [d.phi(t + s) for s in (0.1, 0.2, 0.4, 0.8, 1.6)]
+        assert phis == sorted(phis)
+        assert phis[-1] > 50  # long silence: unbounded suspicion
+
+    def test_not_ready_before_min_samples(self):
+        from ray_tpu.common.health import PhiAccrualDetector
+
+        d = PhiAccrualDetector(min_samples=5)
+        for i in range(4):
+            d.heartbeat(i * 0.1)
+        assert not d.ready()
+        assert d.phi(10.0) == 0.0  # fixed-timeout fallback decides
+
+    def test_regular_history_tolerates_2x_stall(self):
+        """The false-positive mode the detector exists to remove: a
+        metronome-regular history (std ~ 0) plus one 2x-late beat must
+        NOT cross the death threshold (the std floor absorbs it)."""
+        from ray_tpu.common.config import cfg
+
+        d, t = self._warm(jitter=0.02)
+        phi_2x = d.phi(t + 0.2)  # a 2x load stall
+        assert phi_2x < cfg.health_phi_death
+        # ...while a true partition's silence still explodes
+        assert d.phi(t + 1.0) > cfg.health_phi_death
+
+    def test_adapts_to_loaded_cadence(self):
+        """Sustained 2x load (intervals double) becomes the new normal:
+        the same absolute gap that was suspicious before is absorbed
+        after the history adapts."""
+        d, t = self._warm(interval=0.1, jitter=0.05)
+        before = d.phi(t + 0.4)
+        for _ in range(80):  # sustained 2x-slow heartbeats
+            t += 0.2
+            d.heartbeat(t)
+        after = d.phi(t + 0.4)
+        assert after < before
+
+    def test_death_verdict_floor_and_cap(self):
+        from ray_tpu.common.health import death_confirmed
+
+        # phi says dead but silence is under the floor: NOT dead
+        assert not death_confirmed(99.0, 0.4, 8.0, 1.0, 2.0)
+        # phi + floor satisfied: dead
+        assert death_confirmed(9.0, 1.2, 8.0, 1.0, 2.0)
+        # silence past the cap: dead regardless of phi
+        assert death_confirmed(0.0, 2.1, 8.0, 1.0, 2.0)
+        # neither: alive
+        assert not death_confirmed(3.0, 1.2, 8.0, 1.0, 2.0)

@@ -797,6 +797,86 @@ class TestHalfCheckpointPair:
 
 
 # ---------------------------------------------------------------------------
+# RT114 wall-clock-liveness
+# ---------------------------------------------------------------------------
+
+
+class TestWallClockLiveness:
+    def test_flags_direct_wall_clock_against_timeout_config(self):
+        src = """
+        import time
+        from ray_tpu.common.config import cfg
+
+        def reap(nodes):
+            for n in nodes:
+                if time.time() - n.last_heartbeat > cfg.node_death_timeout_s:
+                    kill(n)
+        """
+        assert rule_ids(src, rules=["RT114"]) == ["RT114"]
+
+    def test_flags_assigned_now_variable_shape(self):
+        # the idiomatic `now = time.time()` ... `now - last > timeout`
+        src = """
+        import time
+        from ray_tpu.common.config import cfg
+
+        def reap(nodes):
+            now = time.time()
+            for n in nodes:
+                if now - n.last_heartbeat > cfg.node_death_timeout_s:
+                    kill(n)
+        """
+        assert rule_ids(src, rules=["RT114"]) == ["RT114"]
+
+    def test_flags_from_import_alias_against_deadline(self):
+        src = """
+        from time import time as wall
+
+        def expired(entry, deadline_s):
+            return wall() - entry.start > deadline_s
+        """
+        assert rule_ids(src, rules=["RT114"]) == ["RT114"]
+
+    def test_silent_on_monotonic_liveness(self):
+        # the compliant twin: the SAME verdict on time.monotonic()
+        src = """
+        import time
+        from ray_tpu.common.config import cfg
+
+        def reap(nodes):
+            now = time.monotonic()
+            for n in nodes:
+                if now - n.last_heartbeat > cfg.node_death_timeout_s:
+                    kill(n)
+        """
+        assert rule_ids(src, rules=["RT114"]) == []
+
+    def test_silent_on_wall_clock_timestamps(self):
+        # plain wall-clock bookkeeping (no liveness verdict) is legal
+        src = """
+        import time
+
+        def stamp(info):
+            info["started_at"] = time.time()
+            return info["started_at"] < 2e9
+        """
+        assert rule_ids(src, rules=["RT114"]) == []
+
+    def test_reassignment_clears_wall_taint(self):
+        # `now` rebound from monotonic before the compare: not a finding
+        src = """
+        import time
+
+        def wait(deadline_s):
+            now = time.time()
+            log(now)
+            now = time.monotonic()
+            return now > deadline_s
+        """
+        assert rule_ids(src, rules=["RT114"]) == []
+
+
+# ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, parse errors
 # ---------------------------------------------------------------------------
 

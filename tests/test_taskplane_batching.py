@@ -236,6 +236,51 @@ def test_large_payload_burst_respects_byte_cap():
     asyncio.run(main())
 
 
+def test_urgent_heartbeat_jumps_coalesced_batch():
+    """Health-plane latency pin: an ``urgent`` notify (the raylet
+    heartbeat) must hit the wire as its own lone frame AHEAD of a big
+    per-tick coalesced batch queued on the same connection — a loaded
+    tick must not delay the failure detector's input past the
+    heartbeat interval (the exact delay that manufactures false
+    positives under load)."""
+
+    async def main():
+        arrivals = []
+
+        async def handler(conn, method, payload):
+            arrivals.append(method)
+            return True
+
+        srv = rpc.Server(handler)
+        await srv.start()
+        conn = await rpc.connect(srv.address, name="t")
+        try:
+            # one tick's worth of coalescing traffic, queued first
+            futs = [conn.call_soon("bulk", b"x" * 4096) for _ in range(64)]
+            assert conn._out_batch, "burst did not queue"
+            t0 = asyncio.get_running_loop().time()
+            # the heartbeat is order-independent liveness traffic: it
+            # must NOT flush the queued batch ahead of itself
+            await conn.notify("heartbeat", {"n": 1}, urgent=True)
+            dt = asyncio.get_running_loop().time() - t0
+            await asyncio.gather(*futs)
+            # a sync barrier so every notify has been dispatched
+            await conn.call("sync", None)
+            hb_pos = arrivals.index("heartbeat")
+            first_bulk = arrivals.index("bulk")
+            assert hb_pos < first_bulk, (
+                f"heartbeat arrived at {hb_pos}, after the batch "
+                f"(first bulk at {first_bulk}) — urgent frames are "
+                "queueing behind per-tick coalescing"
+            )
+            assert dt < 0.5, f"urgent notify send took {dt:.3f}s"
+        finally:
+            await conn.close()
+            await srv.close()
+
+    asyncio.run(main())
+
+
 def test_warm_template_cache_stays_picklable(cluster):
     """The spec-template caches hold runtime-bound state (the Runtime,
     its loop futures).  Pickling a RemoteFunction or ActorMethod after
