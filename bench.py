@@ -349,6 +349,35 @@ def bench_taskplane_alloc_churn(ray_tpu, window=1000, rounds=5):
     return ((c1 - c0) * th0 + (n1 - n0)) / (rounds * window)
 
 
+def bench_taskplane_alloc_churn_tasks(ray_tpu, window=1000, rounds=5):
+    """Normal-task twin of the alloc-churn row: gen0 container
+    allocations per windowed `.remote()` NORMAL task (submit + reply +
+    get), same (gen0 collections x threshold + count delta)/calls
+    methodology.  This is the path the data-plane-v2 slotted-lineage +
+    compact-template work targets (r10 band: ~25/call via the per-call
+    spec dict, lineage dict + live-returns set, and unbounded parked
+    lease requests; ~4/call after; <= 9 pinned by
+    tests/test_taskplane_batching.py)."""
+    import gc
+
+    @ray_tpu.remote
+    def noop():
+        return b"ok"
+
+    ray_tpu.get(noop.remote(), timeout=60)
+    for _ in range(3):  # steady state: leases, promotion, allocator
+        ray_tpu.get([noop.remote() for _ in range(window)], timeout=120)
+    gc.collect()
+    th0 = gc.get_threshold()[0]
+    c0 = gc.get_stats()[0]["collections"]
+    n0 = gc.get_count()[0]
+    for _ in range(rounds):
+        ray_tpu.get([noop.remote() for _ in range(window)], timeout=120)
+    c1 = gc.get_stats()[0]["collections"]
+    n1 = gc.get_count()[0]
+    return ((c1 - c0) * th0 + (n1 - n0)) / (rounds * window)
+
+
 def bench_tasks_sync(ray_tpu, duration_s=3.0):
     @ray_tpu.remote
     def noop():
@@ -437,6 +466,115 @@ def bench_multi_client_put(ray_tpu, n_clients=4, mb_per_client=512,
     wall = time.perf_counter() - t0
     total = sum(m for m, _ in out)
     return total / wall / 1e9
+
+
+def bench_put_bandwidth_matrix(ray_tpu):
+    """Data-plane-v2 put matrix: size x clients x inline/vectored.
+
+    Small sizes report puts/s (the create/seal round trip, not memcpy,
+    dominates); large sizes report GB/s (memcpy-bound).  The `_noinline`
+    twin of the 4KB row runs with the slab disabled, isolating the
+    inline fast path's win; the multi-client rows use worker processes
+    writing the shared arena concurrently (sharded-index contention
+    surface).  Returns {row_name: value}."""
+    import gc
+    import numpy as np
+    from ray_tpu.common.config import cfg as _cfg
+
+    out = {}
+
+    def drain():
+        gc.collect()
+        time.sleep(0.5)
+
+    # -- single-client small puts: inline slab vs forced create path --
+    from ray_tpu.core.runtime import get_runtime
+
+    del _cfg  # knobs ride the store-level switch below
+    store = get_runtime().store
+    small = b"s" * 4096
+    # noinline first: its create-path warm round faults the arena ranges
+    # the slab refills will recycle, so the inline row measures the warm
+    # steady state (cold first-touch is paid once per range, by design at
+    # slab batch-reserve time)
+    for label, enabled in (("noinline", False), ("inline", True)):
+        store.set_slab_enabled(enabled)
+        try:
+            n = 2500
+            refs = [ray_tpu.put(small) for _ in range(n)]  # warm
+            del refs
+            drain()
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                refs = [ray_tpu.put(small) for _ in range(n)]
+                best = max(best, n / (time.perf_counter() - t0))
+                del refs
+                drain()
+            out[f"put_4kb_1c_{label}_per_s"] = best
+        finally:
+            store.set_slab_enabled(True)
+
+    # -- single-client medium/large puts (vectored path, GB/s) --
+    for size_mb, total_mb in ((0.25, 128), (64, 1024)):
+        buf = np.random.bytes(int(size_mb * 1024 * 1024))
+        def one_round():
+            refs, moved = [], 0
+            t0 = time.perf_counter()
+            while moved < total_mb * 1024 * 1024:
+                refs.append(ray_tpu.put(buf))
+                moved += len(buf)
+            dt = time.perf_counter() - t0
+            del refs
+            return moved / dt / 1e9
+        one_round()
+        drain()
+        key = f"put_{size_mb:g}mb_1c_gb_per_s".replace(".", "p")
+        out[key] = one_round()
+        drain()
+
+    # -- multi-client rows: 4 workers writing the arena concurrently --
+    @ray_tpu.remote
+    def putter(n_small, large_mb):
+        import time as _t
+        res = {}
+        if n_small:
+            payload = b"m" * 4096
+            refs = [ray_tpu.put(payload) for _ in range(200)]  # warm
+            del refs
+            t0 = _t.perf_counter()
+            refs = [ray_tpu.put(payload) for _ in range(n_small)]
+            res["small"] = (n_small, _t.perf_counter() - t0)
+            del refs
+        if large_mb:
+            import numpy as _np
+            buf = _np.random.bytes(32 * 1024 * 1024)
+            moved, refs = 0, []
+            t0 = _t.perf_counter()
+            while moved < large_mb * 1024 * 1024:
+                refs.append(ray_tpu.put(buf))
+                moved += len(buf)
+            res["large"] = (moved, _t.perf_counter() - t0)
+            del refs
+        return res
+
+    n_clients = 4
+    ray_tpu.get(  # warm leases + arenas
+        [putter.remote(50, 32) for _ in range(n_clients)], timeout=120,
+    )
+    t0 = time.perf_counter()
+    rs = ray_tpu.get(
+        [putter.remote(2000, 0) for _ in range(n_clients)], timeout=300,
+    )
+    wall = time.perf_counter() - t0
+    out["put_4kb_4c_per_s"] = sum(r["small"][0] for r in rs) / wall
+    t0 = time.perf_counter()
+    rs = ray_tpu.get(
+        [putter.remote(0, 256) for _ in range(n_clients)], timeout=300,
+    )
+    wall = time.perf_counter() - t0
+    out["put_32mb_4c_gb_per_s"] = sum(r["large"][0] for r in rs) / wall / 1e9
+    return out
 
 
 def bench_broadcast_1gib(ray_tpu, n_readers=8, gib=1.0):
@@ -1179,6 +1317,8 @@ def main():
         ("tasks_sync_single_client", bench_tasks_sync, "tasks/s"),
         ("tasks_async_single_client", bench_tasks_async, "tasks/s"),
         ("taskplane_alloc_churn", bench_taskplane_alloc_churn, "allocs/call"),
+        ("taskplane_alloc_churn_tasks", bench_taskplane_alloc_churn_tasks,
+         "allocs/call"),
         ("put_gigabytes_per_s", bench_put_gigabytes, "GB/s"),
         ("multi_client_put_gigabytes_per_s", bench_multi_client_put, "GB/s"),
         ("get_calls_per_s", bench_get_calls, "gets/s"),
@@ -1196,6 +1336,20 @@ def main():
                     emit(name, v, unit, baseline=BASELINES.get(name))
                 except Exception as e:  # noqa: BLE001
                     emit(name, 0.0, unit, error=repr(e))
+            # put matrix (data plane v2): size x clients x inline/
+            # vectored — puts/s for round-trip-bound small sizes, GB/s
+            # for memcpy-bound large ones
+            if remaining() > 120:
+                try:
+                    m = bench_put_bandwidth_matrix(ray_tpu)
+                    for name, v in m.items():
+                        emit(
+                            name, v,
+                            "puts/s" if "per_s" in name
+                            and "gb" not in name else "GB/s",
+                        )
+                except Exception as e:  # noqa: BLE001
+                    emit("put_bandwidth_matrix", 0.0, "rows", error=repr(e))
             # broadcast row: seconds, lower = better, so vs_baseline is
             # inverted (reference seconds / ours); single-host shm vs the
             # reference's 50-node network broadcast — topology noted

@@ -137,6 +137,24 @@ def _get_lib():
                     ctypes.POINTER(ctypes.c_uint64),
                 ]
                 lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.rt_store_seal2.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                ]
+                lib.rt_store_reserve_slots.restype = ctypes.c_uint64
+                lib.rt_store_reserve_slots.argtypes = [
+                    ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+                    ctypes.POINTER(ctypes.c_uint64),
+                ]
+                lib.rt_store_release_slots.argtypes = [
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.c_uint64,
+                ]
+                lib.rt_store_publish_slot.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                    ctypes.c_uint64, ctypes.c_int,
+                ]
+                lib.rt_store_max_slab_slots.restype = ctypes.c_uint64
+                lib.rt_store_max_slab_slots.argtypes = []
                 lib.rt_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
                 lib.rt_store_get.argtypes = [
                     ctypes.c_void_p, ctypes.c_char_p,
@@ -265,21 +283,45 @@ class ShmStore:
         # already resident) at zero madvise overhead.
         self._populate_hw = 0
         self._can_populate = True
+        # Inline-put slot slab (data plane v2): per-process batches of
+        # pre-registered, pre-faulted fixed-size blocks in power-of-two
+        # size classes (256 B .. put_inline_max_bytes, waste ≤ 2x).  A
+        # payload under the threshold skips the create/seal round trip
+        # entirely — write into a free slot of the smallest fitting
+        # class, publish the sealed entry under ONE shard-lock
+        # acquisition (rt_store_publish_slot).  Replenished in batches so
+        # the allocator lock and the first-touch page faults are paid once
+        # per batch, not per put (BENCH.md multi-client terms (a)+(b)).
+        self._slab_lock = threading.Lock()
+        self._slab_classes: dict = {}       # slot_size -> [free offsets]
+        self._slab_pending: dict = {}       # oid -> (off, view, slot_size)
+        self._slab_max = -1                 # -1 until sized from config
+        self._slab_disabled = False         # arena pressure: fall back
+        self._slab_misses = 0               # skips since disable (re-probe)
 
     # -- write path ------------------------------------------------------
-    def create(self, object_id: bytes, size: int) -> memoryview:
-        """Reserve space; returns a writable view. Must seal() or abort()."""
-        object_id = _check_id(object_id)
+    def _put_fault_check(self, object_id: bytes) -> None:
+        """Chaos site ``store.put``: fires once per put/reserve attempt —
+        the same point v1's create() fired — so seeded traces are
+        unchanged by the vectored/inline rebuild."""
         fault_ctl = faults.ACTIVE  # bind once: clear() races the check
         if fault_ctl is not None:
-            # chaos site store.put: an injected arena-pressure failure —
-            # callers must survive it exactly like a genuinely full
-            # arena (spill request + bounded retry in _write_to_store)
+            # an injected arena-pressure failure — callers must survive
+            # it exactly like a genuinely full arena (spill request +
+            # bounded retry in _write_to_store)
             plan = fault_ctl.hit("store.put", object_id.hex())
             if plan is not None and plan.action == "error":
                 raise StoreFullError(
                     f"injected arena put failure for {object_id.hex()[:12]}"
                 )
+
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        """Reserve space; returns a writable view. Must seal() or abort()."""
+        object_id = _check_id(object_id)
+        self._put_fault_check(object_id)
+        return self._create_raw(object_id, size)
+
+    def _create_raw(self, object_id: bytes, size: int) -> memoryview:
         off = ctypes.c_uint64()
         rc = self._lib.rt_store_create_object(
             self._h, object_id, ctypes.c_uint64(size), ctypes.byref(off)
@@ -317,30 +359,253 @@ class ShmStore:
 
     def abort(self, object_id: bytes) -> None:
         object_id = _check_id(object_id)
+        with self._slab_lock:
+            pend = self._slab_pending.pop(object_id, None)
+            if pend is not None:
+                # slab reservation: the slot goes back to the freelist —
+                # nothing was published, the index never saw the id
+                off, view, slot_size = pend
+                view.release()
+                self._slab_classes.setdefault(slot_size, []).append(off)
+                return
         self._lib.rt_store_abort(self._h, object_id)
         v = self._created_views.pop(bytes(object_id), None)
         if v is not None:
             v.release()
 
-    def put(self, object_id: bytes, data, *, protect: bool = False) -> None:
-        """Convenience one-shot: create + copy + seal.  ``protect=True``
-        marks the entry as a primary copy BEFORE sealing (sealed+unpinned
-        entries are LRU-evictable the instant the seal lands)."""
-        data = memoryview(data).cast("B")
-        buf = self.create(object_id, data.nbytes)
-        buf[:] = data
-        if protect and not self.protect(object_id):
-            # between create and here the entry can only vanish via a bug
-            # (it is unsealed and creator-pinned) — surface, don't let the
-            # caller believe the primary is eviction-proof.  Abort first:
-            # an unsealed creator-pinned entry is otherwise unreclaimable
-            # until this client detaches, and a retried put would hit
-            # ObjectExistsError.
-            self.abort(object_id)
-            raise StoreError(
-                f"protect failed for {bytes(object_id).hex()[:12]}"
+    # -- vectored single-pass put path (data plane v2) --------------------
+    #
+    # reserve() → write payload into the returned view → commit().  Small
+    # payloads ride the pre-registered inline slab (one shard-lock publish,
+    # no create/seal round trip, pages pre-faulted at batch-reserve time);
+    # everything else rides create + the atomic protect+seal (seal2).  The
+    # ``store.put`` chaos site fires once per reserve attempt, exactly
+    # where v1's create() fired.
+
+    _SLAB_MIN_CLASS = 256  # smallest slot class (bytes)
+
+    def _slab_threshold(self) -> int:
+        if self._slab_max >= 0:
+            return self._slab_max
+        from ray_tpu.common.config import cfg
+
+        self._slab_max = max(0, cfg.put_inline_max_bytes)
+        return self._slab_max
+
+    @classmethod
+    def _slab_class(cls, size: int) -> int:
+        """Smallest power-of-two slot class holding ``size`` (waste
+        stays under 2x the payload, not a full max-size slot)."""
+        c = cls._SLAB_MIN_CLASS
+        while c < size:
+            c <<= 1
+        return c
+
+    def _slab_refill_locked(self, slot_size: int) -> bool:
+        """Reserve a fresh batch of ``slot_size`` slots (caller holds
+        _slab_lock)."""
+        from ray_tpu.common.config import cfg
+
+        batch = max(1, cfg.put_inline_slab_slots)
+        offs = (ctypes.c_uint64 * batch)()
+        got = self._lib.rt_store_reserve_slots(
+            self._h, slot_size, batch, offs,
+        )
+        if not got:
+            # arena pressure or ledger full: disable, re-probe after a
+            # while (puts fall back to the evicting create path meanwhile)
+            self._slab_disabled = True
+            self._slab_misses = 0
+            return False
+        free = self._slab_classes.setdefault(slot_size, [])
+        for i in range(got):
+            off = offs[i]
+            # touch-ahead: batch-fault the slot's pages ONCE here so no
+            # put ever pays a first-touch trap (multi-client term (a)).
+            # Gated on the same populate high-water mark the create path
+            # keeps: recycled offsets are already resident, and an
+            # madvise syscall per refilled slot on resident pages was
+            # measurable against the slab's own win.
+            end = off + slot_size
+            if self._can_populate and end > self._populate_hw:
+                try:
+                    start = max(off, self._populate_hw) & ~0xFFF
+                    self._mm.madvise(
+                        23, start, min(len(self._mm), end) - start,
+                    )
+                except (OSError, ValueError):
+                    self._can_populate = False
+                self._populate_hw = end
+            free.append(off)
+        return True
+
+    def set_slab_enabled(self, enabled: bool) -> None:
+        """Force the inline slab off (sticky — no pressure re-probe) or
+        re-arm it; the bench matrix's `_noinline` twin and tests use
+        this to isolate the fast path."""
+        if not enabled:
+            self.shrink_slab()
+            self._slab_forced_off = True
+        else:
+            self._slab_forced_off = False
+            with self._slab_lock:
+                self._slab_disabled = False
+                self._slab_misses = 0
+
+    _slab_forced_off = False
+
+    def _slab_reserve(self, object_id: bytes, size: int):
+        """A writable slot view for a small payload, or None (fall back)."""
+        if self._slab_forced_off:
+            return None
+        with self._slab_lock:
+            if self._slab_disabled:
+                self._slab_misses += 1
+                if self._slab_misses < 512:
+                    return None
+                # re-probe: pressure may have passed (spill/eviction)
+                self._slab_disabled = False
+            slot_size = self._slab_class(size)
+            free = self._slab_classes.get(slot_size)
+            if not free:
+                if not self._slab_refill_locked(slot_size):
+                    return None
+                free = self._slab_classes[slot_size]
+            off = free.pop()
+            view = self._mv[off : off + size]
+            self._slab_pending[object_id] = (off, view, slot_size)
+            return view
+
+    def shrink_slab(self) -> int:
+        """Give free (unused) reserved slots back to the allocator —
+        called under arena pressure before asking the raylet to spill.
+        Returns the number of slots released."""
+        with self._slab_lock:
+            slots = [
+                off for free in self._slab_classes.values() for off in free
+            ]
+            self._slab_classes.clear()
+            self._slab_disabled = True
+            self._slab_misses = 0
+            if not slots:
+                return 0
+            offs = (ctypes.c_uint64 * len(slots))(*slots)
+        self._lib.rt_store_release_slots(self._h, offs, len(slots))
+        return len(slots)
+
+    def reserve(self, object_id: bytes, size: int) -> memoryview:
+        """Reserve space for a put; write the payload into the returned
+        view, then commit() (or abort()).  Small payloads land in a
+        pre-faulted inline slab slot; large ones in a fresh allocation."""
+        object_id = _check_id(object_id)
+        self._put_fault_check(object_id)
+        if 0 < size <= self._slab_threshold():
+            view = self._slab_reserve(object_id, size)
+            if view is not None:
+                return view
+        return self._create_raw(object_id, size)
+
+    def commit(self, object_id: bytes, *, protect: bool = False) -> None:
+        """Make a reserved object visible: slab reservations publish the
+        sealed entry in one shard-lock acquisition; created ones seal with
+        the primary-copy flag applied atomically (no protect-vs-evict
+        window, one lock round trip instead of protect + seal)."""
+        object_id = _check_id(object_id)
+        with self._slab_lock:
+            pend = self._slab_pending.pop(object_id, None)
+        if pend is not None:
+            off, view, slot_size = pend
+            size = view.nbytes
+            rc = self._lib.rt_store_publish_slot(
+                self._h, object_id, off, size, 1 if protect else 0,
             )
-        self.seal(object_id)
+            if rc == RT_OK:
+                view.release()
+                return
+            if rc == RT_EXISTS:
+                # the slot went back to our slab ledger C-side; surface
+                # the duplicate like create() would have
+                view.release()
+                with self._slab_lock:
+                    self._slab_classes.setdefault(
+                        slot_size, []
+                    ).append(off)
+                raise ObjectExistsError(object_id.hex())
+            if rc == RT_NO_SPACE:
+                # shard sub-table full: fall back through the evicting
+                # create path.  The slot returns to the freelist only
+                # AFTER the payload is copied out of it (a concurrent
+                # reserve must not recycle it mid-read), and on a packed
+                # arena (StoreFullError from create) the pending entry is
+                # restored so the caller can spill and retry commit().
+                try:
+                    buf = self._create_raw(object_id, size)
+                except StoreFullError:
+                    with self._slab_lock:
+                        self._slab_pending[object_id] = pend
+                    raise
+                except BaseException:
+                    # duplicate/hard failure: commit is over either way,
+                    # so the slot goes home
+                    view.release()
+                    with self._slab_lock:
+                        self._slab_classes.setdefault(
+                            slot_size, []
+                        ).append(off)
+                    raise
+                try:
+                    buf[:] = self._mv[off : off + size]
+                    self._seal2(object_id, protect)
+                finally:
+                    view.release()
+                    with self._slab_lock:
+                        self._slab_classes.setdefault(
+                            slot_size, []
+                        ).append(off)
+                return
+            raise StoreError(f"publish failed: {_rc_name(rc)}")
+        self._seal2(object_id, protect)
+
+    def _seal2(self, object_id: bytes, protect: bool) -> None:
+        rc = self._lib.rt_store_seal2(
+            self._h, object_id, 1 if protect else 0
+        )
+        if rc != RT_OK:
+            raise StoreError(f"seal failed: {_rc_name(rc)}")
+        v = self._created_views.pop(bytes(object_id), None)
+        if v is not None:
+            v.release()
+
+    def put(self, object_id: bytes, data, *, protect: bool = False) -> None:
+        """One-shot single-pass put: reserve + one copy + commit (the
+        single-segment case of ``put_vectored``).  ``protect=True``
+        applies the primary-copy flag atomically with the seal/publish,
+        so the entry is never LRU-evictable in between."""
+        self.put_vectored(object_id, (data,), protect=protect)
+
+    def put_vectored(self, object_id: bytes, segments, *,
+                     protect: bool = False) -> int:
+        """Single-pass put of one or more buffer segments written back to
+        back through the reserve→write→commit flow, never concatenated
+        into an intermediate bytes.  ``put`` (raylet pulls, spill
+        restore, collective shm handoff) is the one-segment case.
+        Returns total bytes written."""
+        views = [
+            m if m.format == "B" and m.ndim == 1 else m.cast("B")
+            for m in map(memoryview, segments)
+        ]
+        total = sum(v.nbytes for v in views)
+        buf = self.reserve(object_id, total)
+        try:
+            off = 0
+            for v in views:
+                buf[off : off + v.nbytes] = v
+                off += v.nbytes
+        except BaseException:
+            self.abort(object_id)
+            raise
+        self.commit(object_id, protect=protect)
+        return total
 
     # -- read path -------------------------------------------------------
     def get(self, object_id: bytes) -> Optional[PinnedBuffer]:
@@ -449,6 +714,14 @@ class ShmStore:
         for v in self._created_views.values():
             v.release()
         self._created_views.clear()
+        with self._slab_lock:
+            # unpublished slab reservations + free slots: views must drop
+            # before the mmap closes; the block offsets themselves are
+            # reclaimed by rt_store_detach's client-ledger release
+            for _off, v, _cls in self._slab_pending.values():
+                v.release()
+            self._slab_pending.clear()
+            self._slab_classes.clear()
         try:
             self._mv.release()
             self._mm.close()

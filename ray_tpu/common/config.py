@@ -127,6 +127,15 @@ D("inline_object_max_bytes", int, 100 * 1024)  # small results ride the RPC repl
 # get() of a shm object this large deserializes zero-copy off the arena
 # (pinned, read-only views) instead of copying out (plasma mmap-read role)
 D("zerocopy_get_min_bytes", int, 1024 * 1024)
+# put-side inline fast path (data plane v2): serialized payloads up to
+# this size land in a per-process slab of pre-registered, pre-faulted
+# arena slots — one shard-lock publish instead of a create/seal round
+# trip.  0 disables the slab (every put rides the create path).
+D("put_inline_max_bytes", int, 16 * 1024)
+# slots reserved per slab refill batch (one allocator-lock acquisition +
+# one touch-ahead pass amortized across the whole batch); the C-side
+# per-client ledger caps total reserved slots at rt_store_max_slab_slots
+D("put_inline_slab_slots", int, 32)
 D("object_chunk_bytes", int, 16 * 1024 * 1024)  # node-to-node transfer chunk
 
 # --- pip runtime envs (reference: runtime_env/pip.py role)
@@ -174,6 +183,11 @@ D("sched_kick_scan_window", int, 64)
 # instead of buffering unboundedly via call_soon
 D("rpc_send_backlog_limit_bytes", int, 1 << 20)
 D("sched_max_pending_lease_s", float, 60.0)
+# in-flight lease requests per scheduling class: requests beyond this
+# just park at the GCS (it grants as capacity frees and every grant
+# re-pumps), while each parked request costs a call's coroutine/future
+# machinery — unbounded, a 1000-deep task window parked ~1000 of them
+D("sched_max_lease_requests_per_class", int, 16)
 D("worker_pool_prestart", int, 0)
 D("worker_idle_timeout_s", float, 300.0)
 D("max_tasks_in_flight_per_worker", int, 1)  # >1 pipelines (uniform tasks)

@@ -11,7 +11,12 @@ module is that plane:
   raylet's preemption watcher polls the last one, so a seeded plan
   delivers a spot-termination notice deterministically, with
   ``delay_s`` carrying the announced drain deadline; the full registry
-  is in docs/architecture.md).  Each site guards itself with
+  is in docs/architecture.md).  ``store.put`` fires once per reserve
+  attempt whichever sub-path serves it — the data-plane-v2 inline slab
+  and the vectored create path hit the same
+  ``ShmStore._put_fault_check`` the v1 ``create`` call guarded, so
+  seeded put traces survived the rebuild bit-identically (pinned in
+  test_zz_dataplane.py).  Each site guards itself with
   ``if faults.ACTIVE is not None:`` — with ``RT_FAULTS`` unset the hook
   is a single module-attribute None check: no allocation, no branch
   taken, pinned by an alloc assertion in test_taskplane_batching.py.

@@ -32,37 +32,65 @@ _HEADER = struct.Struct("<I")
 _BUFHDR = struct.Struct("<Q")
 _BYTES_OOB_THRESHOLD = 64 * 1024
 
+#: Copy-trace instrumentation for the single-pass put invariant (data
+#: plane v2): write_into() bumps these once per call.  A put is one
+#: serialize pass over the payload iff payload_bytes grows by exactly the
+#: payload size per put — the deterministic check bench/tests pin instead
+#: of trusting wall-clock (see tests/test_zz_dataplane.py).  Plain int
+#: adds; nothing here allocates.
+COPY_TRACE = {"writes": 0, "payload_bytes": 0, "meta_bytes": 0}
+
 
 class SerializedObject:
-    """A serialized object: one metadata pickle plus N out-of-band buffers."""
+    """A serialized object: one metadata pickle plus N out-of-band buffers.
 
-    __slots__ = ("meta", "buffers")
+    ``meta`` may be any bytes-like (the serializer hands over the pickle
+    scratch as a memoryview — no intermediate ``bytes`` materialization on
+    the put path); ``buffers`` are zero-copy views of the payload's large
+    contiguous regions.  ``write_into`` is the ONE pass that touches
+    payload bytes: headers, meta and every buffer are written straight
+    into the destination (an arena reservation, a wire scratch) as
+    vectored segment writes."""
 
-    def __init__(self, meta: bytes, buffers: List[memoryview]):
+    __slots__ = ("meta", "buffers", "_total")
+
+    def __init__(self, meta, buffers: List[memoryview]):
         self.meta = meta
         self.buffers = buffers
+        self._total = 0
 
     @property
     def total_bytes(self) -> int:
-        n = _HEADER.size + len(self.meta) + _HEADER.size
-        for b in self.buffers:
-            n += _BUFHDR.size + b.nbytes
+        n = self._total
+        if n == 0:
+            n = _HEADER.size + len(self.meta) + _HEADER.size
+            for b in self.buffers:
+                n += _BUFHDR.size + b.nbytes
+            self._total = n
         return n
 
     def write_into(self, dest: memoryview) -> int:
-        """Write wire format into `dest`; returns bytes written."""
+        """Write wire format into `dest`; returns bytes written.  The
+        single payload pass: each out-of-band buffer is memcpy'd exactly
+        once, directly into the destination."""
         off = 0
-        _HEADER.pack_into(dest, off, len(self.meta))
+        meta_len = len(self.meta)
+        _HEADER.pack_into(dest, off, meta_len)
         off += _HEADER.size
-        dest[off : off + len(self.meta)] = self.meta
-        off += len(self.meta)
+        dest[off : off + meta_len] = self.meta
+        off += meta_len
         _HEADER.pack_into(dest, off, len(self.buffers))
         off += _HEADER.size
+        payload = 0
         for b in self.buffers:
             _BUFHDR.pack_into(dest, off, b.nbytes)
             off += _BUFHDR.size
             dest[off : off + b.nbytes] = b.cast("B") if b.format != "B" else b
             off += b.nbytes
+            payload += b.nbytes
+        COPY_TRACE["writes"] += 1
+        COPY_TRACE["payload_bytes"] += payload
+        COPY_TRACE["meta_bytes"] += meta_len
         return off
 
     def to_bytes(self) -> bytes:
@@ -252,7 +280,11 @@ class SerializationContext:
             meta_io, self._custom_reducers, protocol=5, buffer_callback=cb
         )
         pickler.dump(obj)
-        return SerializedObject(meta_io.getvalue(), buffers)
+        # getbuffer, not getvalue: the meta pickle is handed over as a view
+        # of the scratch (which the view keeps alive) — the put path then
+        # writes it straight into the arena reservation instead of paying
+        # a bytes materialization first (RT115 bytes-copy-on-hot-path)
+        return SerializedObject(meta_io.getbuffer(), buffers)
 
     def deserialize(
         self, data: memoryview | bytes, owner: Any = None
@@ -288,8 +320,10 @@ class SerializationContext:
             b = mv[off : off + blen]
             buffers.append(b if owner is None else _OwnedBuffer(b, owner))
             off += blen
-        return pickle.loads(bytes(meta) if isinstance(meta, memoryview) else meta,
-                            buffers=buffers)
+        # pickle.loads accepts any buffer: parsing the meta view in place
+        # saves a bytes copy per get (objects created during the parse own
+        # their memory, so nothing retains the view past the call)
+        return pickle.loads(meta, buffers=buffers)
 
 
 # PEP 688 ``__buffer__`` is honored by CPython >= 3.12 only; on older

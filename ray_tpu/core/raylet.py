@@ -135,6 +135,12 @@ class Raylet:
         # seen, via the "nodes" pubsub channel and peer RPC payloads):
         # an inbound peer RPC below the watermark is rejected
         self._node_incs: Dict[str, int] = {}
+        # per-tick add_object_location coalescing (data plane v2): pulls,
+        # spill restores and evacuation sweeps started within one loop
+        # tick announce through one object_notify_batch rpc instead of a
+        # notify per object (see _announce)
+        self._announce_buf: list = []
+        self._announce_flush = None  # in-flight flush future, if any
 
     # ---- lifecycle -----------------------------------------------------
     async def start(self):
@@ -1442,7 +1448,16 @@ class Raylet:
                 raise
 
     async def _announce(self, oid: bytes, size: int) -> None:
-        await self.gcs.notify(
+        """Register an arena copy with the directory.  Announces buffered
+        within one loop tick ride a single object_notify_batch rpc (an
+        evacuation sweep or a burst of restores was paying one GCS notify
+        per object); awaiting the shared flush future keeps the v1
+        contract that the announce is on the wire before the caller
+        proceeds.  The first announcer of a tick becomes the flusher: it
+        yields once (so same-tick announcers land in the buffer behind
+        it), swaps the buffer out, and sends one batch; everyone else
+        just awaits the flusher's future."""
+        self._announce_buf.append((
             "add_object_location",
             {
                 "object_id": oid,
@@ -1450,7 +1465,41 @@ class Raylet:
                 "incarnation": self.incarnation,
                 "size": size,
             },
+        ))
+        fut = self._announce_flush
+        if fut is not None:
+            await fut
+            return
+        self._announce_flush = fut = (
+            asyncio.get_running_loop().create_future()
         )
+        try:
+            await asyncio.sleep(0)
+        except BaseException as e:
+            # cancelled before the swap: waiters' items are still
+            # buffered — fail them so nobody parks on a dead future
+            self._announce_flush = None
+            fut.set_exception(e)
+            fut.exception()
+            raise
+        # swap + clear BEFORE the notify awaits: an announcer arriving
+        # mid-send must become the next flusher, not park on a future
+        # whose batch does not contain its item
+        items, self._announce_buf = self._announce_buf, []
+        self._announce_flush = None
+        try:
+            if self.gcs is not None and items:
+                if len(items) == 1:
+                    await self.gcs.notify(items[0][0], items[0][1])
+                else:
+                    await self.gcs.notify(
+                        "object_notify_batch", {"items": items}
+                    )
+        except BaseException as e:
+            fut.set_exception(e)
+            fut.exception()  # mark retrieved: waiters may all be gone
+            raise
+        fut.set_result(None)
 
     async def rpc_fetch_object(self, conn: rpc.Connection, p):
         """A remote raylet asks for an object's bytes (small objects)."""

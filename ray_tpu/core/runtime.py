@@ -95,7 +95,7 @@ class Lease:
 
 @dataclass(slots=True)
 class PendingTask:
-    spec: dict
+    spec: Any  # wire spec dict; None for compact template-path tasks
     return_ids: Any  # tuple/list of return oid bytes
     retries_left: int
     sub_idx: int = 0  # per-actor submission order (client-side)
@@ -113,12 +113,81 @@ class PendingTask:
     rt: Any = None
     st: Any = None
     conn: Any = None
+    # Compact template path (data plane v2): the immutable skeleton lives
+    # on the TaskTemplate and ships to each worker once per connection;
+    # per-call state is just (task_id, args, job) and the wire carries a
+    # tuple — the driver never copies the spec dict per call.  ``spec``
+    # stays None unless the call needs the full-dict form (streaming,
+    # tracing, actor tasks, untemplated submits).
+    tmpl: Any = None
+    task_id: bytes = b""
+    args: Any = ()
+    job: Any = None
+    streaming: bool = False
+    # Slotted lineage record fields: the PendingTask itself IS the lineage
+    # record (reference analogue of task_manager.h lineage entries) — no
+    # per-task entry dict, no live-returns set; liveness is a bitmask over
+    # return_ids positions and the budget rides two int slots.
+    lineage_budget: int = 0
+    live_mask: int = 0
+    recon_inflight: bool = False
+
+    def name(self) -> str:
+        if self.tmpl is not None:
+            return self.tmpl.skeleton["name"]
+        s = self.spec
+        return s.get("name") or s.get("method", "") if s else ""
 
     def on_push_reply(self, fut):
         self.rt._on_push_reply(self.st, self.conn, self, fut)
 
     def on_task_reply(self, fut):
         self.rt._on_task_push_reply(self, fut)
+
+
+class _LineageSlots:
+    """Slotted lineage store (data plane v2): a preallocated array of
+    slots keyed by task-id low bits, with an overflow dict for slot
+    collisions.  Records are the PendingTask objects themselves — already
+    allocated for submission and reused here, so recording lineage for a
+    task costs zero container allocations (v1 paid a 9-key dict + a
+    live-returns set per call, the dominant term in the ~25 allocs/call
+    normal-task driver path)."""
+
+    __slots__ = ("_mask", "_slots", "_overflow")
+
+    def __init__(self, n_slots: int = 1024):
+        assert n_slots & (n_slots - 1) == 0
+        self._mask = n_slots - 1
+        self._slots: list = [None] * n_slots
+        self._overflow: Dict[bytes, Any] = {}
+
+    def insert(self, rec) -> None:
+        tid = rec.task_id
+        i = (tid[0] | (tid[1] << 8)) & self._mask
+        if self._slots[i] is None:
+            self._slots[i] = rec
+        else:
+            self._overflow[tid] = rec
+
+    def get(self, tid: bytes):
+        rec = self._slots[(tid[0] | (tid[1] << 8)) & self._mask]
+        if rec is not None and rec.task_id == tid:
+            return rec
+        return self._overflow.get(tid)
+
+    def remove(self, tid: bytes) -> None:
+        i = (tid[0] | (tid[1] << 8)) & self._mask
+        rec = self._slots[i]
+        if rec is not None and rec.task_id == tid:
+            self._slots[i] = None
+            return
+        self._overflow.pop(tid, None)
+
+    def __len__(self) -> int:  # tests/diagnostics
+        return sum(1 for r in self._slots if r is not None) + len(
+            self._overflow
+        )
 
 
 @dataclass
@@ -157,7 +226,7 @@ class TaskTemplate:
 
     __slots__ = (
         "rt", "skeleton", "class_key", "resources", "strategy",
-        "num_returns", "streaming", "max_retries", "fill_job",
+        "num_returns", "streaming", "max_retries", "fill_job", "tpl_id",
     )
 
     def __init__(self, rt, skeleton, class_key, resources, strategy,
@@ -171,6 +240,10 @@ class TaskTemplate:
         self.streaming = streaming
         self.max_retries = max_retries
         self.fill_job = fill_job
+        # wire identity for the compact push path: the skeleton ships to
+        # each worker connection once under this id; subsequent pushes
+        # carry only (tpl_id, task_id, args, job)
+        self.tpl_id = os.urandom(8)
 
 
 _sched_class_tags = iter(range(1, 1 << 62))
@@ -340,11 +413,12 @@ class Runtime:
         self._ref_flush_scheduled = False
 
         # ---- lineage (reference analogue: task_manager.h:208 lineage +
-        # object_recovery_manager.h:41): keep resubmittable task specs while
+        # object_recovery_manager.h:41): keep resubmittable tasks while
         # any of their return refs live, so a lost object re-executes its
-        # producing task ----
-        self._lineage: Dict[bytes, dict] = {}          # task_id -> entry
-        self._lineage_by_return: Dict[bytes, bytes] = {}  # oid -> task_id
+        # producing task.  Slotted store: records are the PendingTask
+        # objects themselves (see _LineageSlots) ----
+        self._lineage = _LineageSlots()
+        self._lineage_by_return: Dict[bytes, Any] = {}  # oid -> record
         # lineage re-executions started by this process — the drain
         # plane's "zero reconstructions" acceptance counter
         self.reconstructions = 0
@@ -369,21 +443,43 @@ class Runtime:
         self._nested_ref_sink = threading.local()
         self._class_runtime_envs: Dict[Any, dict] = {}
         # timeline: bounded ring of task lifecycle events for
-        # api.timeline() (ray: ray.timeline / chrome-trace export role)
+        # api.timeline() (ray: ray.timeline / chrome-trace export role).
+        # Stored as compact tuples (phase, name, task_id, ts, pid, extra)
+        # — the per-call event dict was measurable churn on the task
+        # submission path; timeline() rebuilds the dict view on read.
         self._timeline = deque(maxlen=cfg.timeline_max_events)
+        self._pid = os.getpid()
         self._closed = False
 
     def record_event(self, phase: str, name: str, task_id_hex: str,
                      **extra) -> None:
         self._timeline.append(
-            dict(phase=phase, name=name, task_id=task_id_hex,
-                 ts=time.time(), pid=os.getpid(), **extra)
+            (phase, name, task_id_hex, time.time(), self._pid,
+             extra or None)
+        )
+
+    def _record_exec(self, name: str, task_id_hex: str, worker: str,
+                     start: float, dur: float) -> None:
+        """kwargs-free twin of record_event for the per-reply exec span
+        (the **extra dict per call was pure hot-path churn)."""
+        self._timeline.append(
+            ("exec", name, task_id_hex, time.time(), self._pid,
+             (worker, start, dur))
         )
 
     def timeline(self) -> list:
         """Chrome-trace-style task lifecycle events recorded by this
         process (submit/start/end with worker-side execution spans)."""
-        return list(self._timeline)
+        out = []
+        for phase, name, tid, ts, pid, extra in list(self._timeline):
+            ev = dict(phase=phase, name=name, task_id=tid, ts=ts, pid=pid)
+            if extra is not None:
+                if type(extra) is tuple:  # exec-span compact extras
+                    ev["worker"], ev["start"], ev["dur"] = extra
+                else:
+                    ev.update(extra)
+            out.append(ev)
+        return out
 
     def _normalize_runtime_env(self, env: Optional[dict]) -> Optional[dict]:
         """Package + upload a runtime_env once; returns the descriptor."""
@@ -722,56 +818,44 @@ class Runtime:
 
     def _write_to_store(self, oid: bytes, s: ser.SerializedObject,
                         urgent_announce: bool = True) -> int:
+        """Vectored single-pass put (data plane v2): reserve the arena
+        allocation FIRST (exact size — the serialize pass already ran
+        without touching payload bytes: large buffers ride the pickle5
+        out-of-band protocol as views), then write header + metadata +
+        payload buffers straight into the reservation.  Each payload byte
+        is copied exactly once; no intermediate bytes is ever built
+        (pinned by serialization.COPY_TRACE).  Small payloads land in the
+        pre-faulted inline slab; commit() applies the primary-copy flag
+        atomically with the seal/publish."""
         size = s.total_bytes
         try:
-            buf = self.store.create(oid, size)
+            buf = self._spill_retry(
+                lambda: self.store.reserve(oid, size), size)
         except ObjectExistsError:
             self._shared.add(oid)
             return size
-        except StoreFullError:
-            # The arena is packed with protected primaries: ask the raylet
-            # to spill LRU primaries to disk and retry.  Escalating
-            # requests ride out fragmentation (freed regions merge only
-            # when adjacent) and concurrent writers racing us to the
-            # freed space; the bounded patience window rides out a busy
-            # raylet whose spill pass (fsync per object) is slow under
-            # load — failing a task because disk IO lagged is worse than
-            # waiting.  Only caller/executor threads wait; the io loop
-            # (which cannot block) keeps the single-attempt behavior.
-            buf = None
-            on_loop = threading.current_thread() is self._thread
-            deadline = time.monotonic() + (0 if on_loop else 60.0)
-            mult = 1  # exact size first: a near-arena-sized object must
-            #           not escalate past capacity (the raylet clamps, but
-            #           requesting precisely what fits spills the least)
-            while True:
-                requested = self._request_spill(size * mult,
-                                                object_bytes=size)
-                try:
-                    buf = self.store.create(oid, size)
-                    break
-                except StoreFullError:
-                    if requested is None:
-                        break  # no raylet to ask: patience is futile
-                    if time.monotonic() >= deadline:
-                        break
-                    mult = min(mult + 1, 6)
-                    time.sleep(0.25)
-            if buf is None:
-                raise
         try:
             s.write_into(buf)
         except BaseException:
             self.store.abort(oid)
             raise
-        # protect BEFORE seal: this is the primary copy, and a concurrent
-        # eviction pass must never reclaim it between seal (refcnt drops
-        # to 0) and the flag landing — spilling is the only sanctioned way
-        # out of the arena for a primary
-        if not self.store.protect(oid):
-            self.store.abort(oid)
-            raise StoreError(f"protect failed for {oid.hex()[:12]}")
-        self.store.seal(oid)
+        try:
+            # primary copy: the protect flag lands atomically with the
+            # seal/publish (seal2), so there is no window where a sealed
+            # primary is LRU prey — spilling stays the only sanctioned
+            # way out of the arena for a primary.  commit can ALSO hit a
+            # packed arena (a slab publish whose shard sub-table is full
+            # falls back to the evicting create path); the slab
+            # reservation survives that failure, so it rides the same
+            # spill-and-retry as reserve.
+            self._spill_retry(
+                lambda: self.store.commit(oid, protect=True), size)
+        except ObjectExistsError:
+            # a concurrent writer of the same oid won the publish race
+            # (e.g. two threads promoting one escaped result); their copy
+            # is the primary
+            self._shared.add(oid)
+            return size
         self._shared.add(oid)
         self._gcs_object_notify(
             "add_object_location",
@@ -783,6 +867,39 @@ class Runtime:
             urgent=urgent_announce,
         )
         return size
+
+    def _spill_retry(self, attempt, size: int):
+        """Run an arena write step, spilling and retrying on a packed
+        arena (StoreFullError): give back any idle inline-slab slots,
+        then ask the raylet to spill LRU primaries to disk and retry.
+        Escalating requests ride out fragmentation (freed regions merge
+        only when adjacent) and concurrent writers racing us to the freed
+        space; the bounded patience window rides out a busy raylet whose
+        spill pass (fsync per object) is slow under load — failing a task
+        because disk IO lagged is worse than waiting.  Only caller/
+        executor threads wait; the io loop (which cannot block) keeps the
+        single-attempt behavior."""
+        try:
+            return attempt()
+        except StoreFullError:
+            self.store.shrink_slab()
+            on_loop = threading.current_thread() is self._thread
+            deadline = time.monotonic() + (0 if on_loop else 60.0)
+            mult = 1  # exact size first: a near-arena-sized object must
+            #           not escalate past capacity (the raylet clamps, but
+            #           requesting precisely what fits spills the least)
+            while True:
+                requested = self._request_spill(size * mult,
+                                                object_bytes=size)
+                try:
+                    return attempt()
+                except StoreFullError:
+                    if requested is None:
+                        raise  # no raylet to ask: patience is futile
+                    if time.monotonic() >= deadline:
+                        raise
+                    mult = min(mult + 1, 6)
+                    time.sleep(0.25)
 
     def _request_spill(self, needed_bytes: int,
                        object_bytes: int = 0):
@@ -1575,16 +1692,24 @@ class Runtime:
         )
 
     def submit_task_from_template(self, tmpl: TaskTemplate, args, kwargs):
-        """Hot-path submit: copy the skeleton, fill ids + args, hand the
-        PendingTask to the io loop through the coalesced submit queue.
+        """Hot-path submit: fill ids + args against the cached template
+        and hand the PendingTask to the io loop through the coalesced
+        submit queue.  The spec dict is NOT copied per call — the compact
+        wire path ships (tpl_id, task_id, args, job) and the skeleton
+        travels to each worker connection once (streaming and tracing
+        calls keep the full-dict spec, which both need to annotate).
         Returns a bare ObjectRef for num_returns == 1, a list of refs
         otherwise, an ObjectRefGenerator when streaming."""
         task_id = os.urandom(16)
-        spec = dict(tmpl.skeleton)
-        spec["task_id"] = task_id
-        spec["args"] = self._pack_args(args, kwargs)
-        if tmpl.fill_job:
-            spec["job"] = self._job_hex()
+        packed = self._pack_args(args, kwargs)
+        job = self._job_hex() if tmpl.fill_job else None
+        spec = None
+        if tmpl.streaming or tracing.enabled():
+            spec = dict(tmpl.skeleton)
+            spec["task_id"] = task_id
+            spec["args"] = packed
+            if job is not None:
+                spec["job"] = job
         n = tmpl.num_returns
         if n == 1:
             return_ids = (task_return_binary(task_id, 0),)
@@ -1597,23 +1722,27 @@ class Runtime:
         # in-flight upstream result while holding the worker that upstream
         # task needs is a scheduling deadlock (reference:
         # LocalDependencyResolver, core_worker/transport/dependency_resolver.h).
-        dep_oids = () if not spec["args"] else [
+        dep_oids = () if not packed else [
             item[1] if item[0] == "ref" else item[2]
-            for item in spec["args"]
+            for item in packed
             if item[0] in ("ref", "kwref")
         ]
         pending = PendingTask(
             spec, return_ids, tmpl.max_retries, dep_oids=dep_oids,
             class_key=tmpl.class_key, resources=tmpl.resources,
-            strategy=tmpl.strategy,
+            strategy=tmpl.strategy, tmpl=tmpl, task_id=task_id,
+            args=packed, job=job, streaming=tmpl.streaming,
         )
-        self.record_event("submit", spec["name"], task_id.hex())
-        if tracing.enabled():
+        self._timeline.append(
+            ("submit", tmpl.skeleton["name"], task_id.hex(), time.time(),
+             self._pid, None)
+        )
+        if spec is not None and tracing.enabled():
             # W3C trace context rides the spec; the worker's execute
             # span parents under THIS submit span (reference:
             # _ray_trace_ctx in tracing_helper.py)
             with tracing.span(
-                f"submit {spec['name']}", task_id=task_id.hex()
+                f"submit {tmpl.skeleton['name']}", task_id=task_id.hex()
             ):
                 spec["trace_ctx"] = tracing.inject()
         # ref args stay pinned while the task is in flight, even if the
@@ -1702,7 +1831,7 @@ class Runtime:
             self._admit_submitted(task)
 
     def _admit_submitted(self, task: PendingTask):
-        if "actor_id" in task.spec:
+        if task.spec is not None and "actor_id" in task.spec:
             self._enqueue_actor_task(task)
         else:
             self._enqueue_after_deps(task)
@@ -1857,12 +1986,21 @@ class Runtime:
                 self._drain_then_pump(class_key, lease, resources, strategy)
         if st.queue:
             # scale leases: one in-flight request per ~cap queued tasks
-            # beyond current capacity
+            # beyond current capacity — but never more than the pending-
+            # request ceiling.  Unbounded want (= queue depth) let a deep
+            # window park hundreds of lease requests at the GCS on a
+            # saturated host, each costing a parked call's coroutine/
+            # future/timer machinery (~12 allocs) for a grant that could
+            # never arrive; grants re-pump, so a bounded pipeline loses
+            # no ramp (reference: lease request pipelining,
+            # direct_task_transport.cc).
             want = (len(st.queue) + cap - 1) // cap
             have = len(st.leases) + st.requests_inflight
-            if want > have:
+            ceiling = cfg.sched_max_lease_requests_per_class
+            if want > have and st.requests_inflight < ceiling:
                 st.cancel_sent = False
-                for _ in range(min(want - have, 8)):
+                for _ in range(min(want - have, 8,
+                                   ceiling - st.requests_inflight)):
                     st.requests_inflight += 1
                     self._loop.create_task(
                         self._acquire_lease(class_key, resources, strategy)
@@ -1995,11 +2133,10 @@ class Runtime:
             lease.inflight -= 1
             self._pump_class(class_key, resources, strategy)
             return
-        self._inflight_dispatch[task.return_ids[0]] = (
-            task.spec["task_id"], lease.conn,
-        )
         task.rt = self
         task.st = lease
+        task.conn = lease.conn
+        self._inflight_dispatch[task.return_ids[0]] = task
         try:
             # call_soon: no wait_for timer / pending-pop bookkeeping per
             # task (same no-timeout semantics the old timeout=-1 had).
@@ -2007,7 +2144,26 @@ class Runtime:
             # backlog budget, spawn a drain so large pipelined arg
             # payloads hit the high-water mark instead of buffering
             # unbounded (pipelining is already capped per lease).
-            fut = lease.conn.call_soon("push_task", task.spec)
+            if task.spec is not None:
+                fut = lease.conn.call_soon("push_task", task.spec)
+            else:
+                # compact template wire: the skeleton ships once per
+                # (connection, template); every later push is a 4-tuple.
+                # The sent-set dies with the connection, so a worker that
+                # never saw the skeleton (lost frame ⇒ lost conn) gets it
+                # again on the replacement lease.
+                tmpl = task.tmpl
+                sent = lease.conn.peer_info.get("_tpl_sent")
+                if sent is None:
+                    sent = lease.conn.peer_info["_tpl_sent"] = set()
+                if tmpl.tpl_id in sent:
+                    payload = (tmpl.tpl_id, task.task_id, task.args,
+                               task.job)
+                else:
+                    sent.add(tmpl.tpl_id)
+                    payload = (tmpl.tpl_id, task.task_id, task.args,
+                               task.job, tmpl.skeleton)
+                fut = lease.conn.call_soon("push_task", payload)
         except (rpc.ConnectionLost, OSError):
             self._task_push_failed(task, lease,
                                    rpc.ConnectionLost("push failed"))
@@ -2059,13 +2215,12 @@ class Runtime:
                         span = reply["exec_span"]
                     if span:
                         t0, t1 = span
-                        self.record_event(
-                            "exec", task.spec["name"],
-                            task.spec["task_id"].hex(),
-                            worker=lease.worker_id.hex()
+                        self._record_exec(
+                            task.name(), task.task_id.hex(),
+                            lease.worker_id.hex()
                             if hasattr(lease.worker_id, "hex")
                             else str(lease.worker_id),
-                            start=t0, dur=t1 - t0,
+                            t0, t1 - t0,
                         )
                     self._apply_task_reply(task, reply)
                 except Exception as e:  # noqa: BLE001
@@ -2076,7 +2231,7 @@ class Runtime:
                     # never-resolved ref
                     self._fail_task(
                         task, TaskError.from_exception(
-                            e, f"applying reply of {task.spec['name']}"
+                            e, f"applying reply of {task.name()}"
                         )
                     )
             elif isinstance(exc, (rpc.ConnectionLost, rpc.RpcError, OSError)):
@@ -2086,8 +2241,7 @@ class Runtime:
                 self._task_push_failed(task, lease, exc)
             else:
                 self._fail_task(task, TaskError(
-                    "TaskDispatchError", repr(exc), "",
-                    task.spec.get("name", ""),
+                    "TaskDispatchError", repr(exc), "", task.name(),
                 ))
         finally:
             self._dispatch_done(task, lease)
@@ -2107,7 +2261,7 @@ class Runtime:
         self._fail_task(
             task,
             WorkerCrashedError(
-                f"worker died while running {task.spec['name']}: "
+                f"worker died while running {task.name()}: "
                 f"{exc}{detail}"
             ),
         )
@@ -2116,6 +2270,10 @@ class Runtime:
         class_key = task.class_key
         st = self._classes[class_key]
         self._inflight_dispatch.pop(task.return_ids[0], None)
+        # the task may live on as a lineage record for as long as its
+        # return refs do — drop the dispatch-time plumbing so a retained
+        # record can't keep a dead Lease/Connection alive with it
+        task.st = task.conn = None
         lease.inflight -= 1
         if lease.broken:
             if lease in st.leases:
@@ -2176,9 +2334,9 @@ class Runtime:
         if reply["status"] == "error":
             self._fail_task(task, self._serialization.deserialize(reply["error"]))
             return
-        if task.spec.get("streaming"):
+        if task.streaming:
             self._unhold_for_task(task.dep_oids)
-            tid = task.spec["task_id"]
+            tid = task.task_id
             n = reply.get("streaming", 0)
             buf = self._streams.get(tid)
             consumed_upto = self._abandoned_streams.pop(tid, None)
@@ -2229,11 +2387,11 @@ class Runtime:
 
     def _fail_task(self, task: PendingTask, exc: Exception):
         self._unhold_for_task(task.dep_oids)
-        if task.spec.get("streaming"):
+        if task.streaming:
             # already-delivered items stay readable; the consumer's next()
             # raises.  Never write _RaiseOnGet into return oids here — item
             # 0 shares its oid with return id 0 and may hold a real value.
-            tid = task.spec["task_id"]
+            tid = task.task_id
             self._abandoned_streams.pop(tid, None)
             buf = self._streams.get(tid)
             if buf is not None:
@@ -2488,7 +2646,8 @@ class Runtime:
             if item[0] in ("ref", "kwref")
         ]
         task = PendingTask(
-            spec, return_ids, retries, sub_idx=sub_idx, dep_oids=dep_oids
+            spec, return_ids, retries, sub_idx=sub_idx, dep_oids=dep_oids,
+            task_id=task_id, streaming=streaming,
         )
         if dep_oids:
             self._hold_for_task(dep_oids)
@@ -2662,12 +2821,12 @@ class Runtime:
         """Fire the push and attach the reply callback — NO per-call
         coroutine/Task (the old awaiting-coroutine shape cost a Task
         object + frame per call on the submission hot path)."""
-        self._inflight_dispatch[task.return_ids[0]] = (
-            task.spec["task_id"], conn,
-        )
         task.rt = self
         task.st = st
         task.conn = conn
+        # the task itself is the dispatch registry entry (task_id + conn
+        # ride its slots) — no per-call tuple
+        self._inflight_dispatch[task.return_ids[0]] = task
         try:
             # RT110 audited + baselined: backlog policing lives in the
             # CALLERS — the pump awaits drain() past the budget after
@@ -2681,7 +2840,7 @@ class Runtime:
             # this) — a stale entry would make cancel() target a dead
             # conn instead of flagging the re-push for drop-on-arrival.
             cur = self._inflight_dispatch.get(task.return_ids[0])
-            if cur is not None and cur[1] is conn:
+            if cur is not None and cur.conn is conn:
                 self._inflight_dispatch.pop(task.return_ids[0], None)
             if st.conn is conn:
                 st.conn = None
@@ -2733,7 +2892,7 @@ class Runtime:
                 ))
         finally:
             cur = self._inflight_dispatch.get(task.return_ids[0])
-            if cur is not None and cur[1] is conn:
+            if cur is not None and cur.conn is conn:
                 self._inflight_dispatch.pop(task.return_ids[0], None)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
@@ -2770,9 +2929,10 @@ class Runtime:
                     self._fail_task(task, TaskCancelledError(oid.hex()))
                     return True
         entry = self._inflight_dispatch.get(oid)
-        if entry is not None:
-            task_id, conn = entry
-            self._spawn(conn.call("cancel_task", {"task_id": task_id}))
+        if entry is not None and entry.conn is not None:
+            self._spawn(
+                entry.conn.call("cancel_task", {"task_id": entry.task_id})
+            )
             return True
         if oid in self.result_futures:
             # submitted but not yet enqueued (waiting on local deps):
@@ -2960,84 +3120,83 @@ class Runtime:
 
     # ---- lineage + reconstruction --------------------------------------
     def _record_lineage(self, task: PendingTask):
-        if cfg.lineage_reconstruction_max <= 0:
+        budget = cfg.lineage_reconstruction_max
+        if budget <= 0:
             return
-        tid = task.spec["task_id"]
-        self._lineage[tid] = {
-            "spec": task.spec,
-            "class_key": task.class_key,
-            "resources": task.resources,
-            "strategy": task.strategy,
-            # dep_oids/return_ids are owned by (or immutable on) the
-            # task — no defensive copies on the submission hot path
-            "dep_oids": task.dep_oids,
-            "return_ids": task.return_ids,
-            "budget": cfg.lineage_reconstruction_max,
-            "live_returns": set(task.return_ids),
-            "inflight": False,
-        }
+        # the PendingTask IS the lineage record (slotted store): liveness
+        # is a bitmask over return_ids positions, the budget an int slot —
+        # zero container allocations per recorded task
+        task.lineage_budget = budget
+        task.live_mask = (1 << len(task.return_ids)) - 1
+        self._lineage.insert(task)
+        by_ret = self._lineage_by_return
         for oid in task.return_ids:
-            self._lineage_by_return[oid] = tid
+            by_ret[oid] = task
 
     def _release_lineage_return(self, oid: bytes):
-        tid = self._lineage_by_return.pop(oid, None)
-        if tid is None:
+        rec = self._lineage_by_return.pop(oid, None)
+        if rec is None:
             return
-        entry = self._lineage.get(tid)
-        if entry is None:
-            return
-        entry["live_returns"].discard(oid)
-        if not entry["live_returns"]:
-            self._lineage.pop(tid, None)
+        rids = rec.return_ids
+        if len(rids) == 1:
+            rec.live_mask = 0
+        else:
+            try:
+                rec.live_mask &= ~(1 << rids.index(oid))
+            except ValueError:
+                pass
+        if rec.live_mask == 0:
+            self._lineage.remove(rec.task_id)
 
     async def _try_reconstruct(self, oid: bytes) -> bool:
         """Re-execute the task that produced ``oid`` (lineage recovery).
 
         Returns True if a reconstruction is running (caller loops back to
         waiting on the result future).  Runs on the io loop."""
-        tid = self._lineage_by_return.get(oid)
-        if tid is None:
+        rec = self._lineage_by_return.get(oid)
+        if rec is None:
             return False
-        entry = self._lineage.get(tid)
-        if entry is None:
-            return False
-        if entry["inflight"] or oid in self.result_futures:
+        if rec.recon_inflight or oid in self.result_futures:
             return True  # already being reconstructed
-        if entry["budget"] <= 0:
+        if rec.lineage_budget <= 0:
             return False
-        entry["budget"] -= 1
-        entry["inflight"] = True
+        rec.lineage_budget -= 1
+        rec.recon_inflight = True
         self.reconstructions += 1
         try:
             logger.info(
                 "reconstructing object %s via task %s (budget left %d)",
-                oid.hex()[:12], tid.hex()[:12], entry["budget"],
+                oid.hex()[:12], rec.task_id.hex()[:12], rec.lineage_budget,
             )
             # Recover dependencies first: resolving them triggers their own
             # reconstruction recursively through this same path, then
             # re-promote each to the shared store for the executing worker.
-            for dep in entry["dep_oids"]:
+            for dep in rec.dep_oids:
                 value = await self._resolve_one(dep, None)
                 if not self.store.contains(dep):
                     self._shared.discard(dep)
                     self._write_to_store(
                         dep, self._serialization.serialize(value)
                     )
+            # fresh dispatchable task sharing the record's immutable state
+            # (the record itself stays in the slot tracking budget/liveness)
             task = PendingTask(
-                entry["spec"], entry["return_ids"],
+                rec.spec, rec.return_ids,
                 retries_left=0,
-                class_key=entry["class_key"],
-                resources=entry["resources"],
-                strategy=entry["strategy"],
+                class_key=rec.class_key,
+                resources=rec.resources,
+                strategy=rec.strategy,
+                tmpl=rec.tmpl, task_id=rec.task_id, args=rec.args,
+                job=rec.job, streaming=rec.streaming,
             )
-            for roid in entry["return_ids"]:
+            for roid in rec.return_ids:
                 if roid not in self.result_futures:
                     self.memory_store.pop(roid, None)
                     self.result_futures[roid] = _PENDING_RESULT
             self._enqueue_task(task)
             return True
         finally:
-            entry["inflight"] = False
+            rec.recon_inflight = False
 
     def cluster_resources(self) -> dict:
         return self._run(self.gcs.call("cluster_resources", {}))

@@ -77,6 +77,12 @@ class WorkerServer:
         self._exec_counts = [0, 0]    # [inline runs, pool runs] (status RPC)
         # in-flight streaming generator tasks: task_id -> credit state
         self._out_streams: Dict[bytes, dict] = {}
+        # compact-push task templates (data plane v2): tpl_id -> spec
+        # skeleton.  The driver ships each skeleton once per connection;
+        # later pushes carry only (tpl_id, task_id, args, job).  Process-
+        # lifetime cache, bounded by the driver's distinct RemoteFunction
+        # option-sets (the same bound as the fn cache).
+        self._tpl_cache: Dict[bytes, dict] = {}
 
     _REPLY_CACHE_PER_CALLER = 256
     _INLINE_AFTER = 10        # samples before a method may promote
@@ -171,7 +177,34 @@ class WorkerServer:
         raise rpc.RpcError(f"worker: unknown method {method!r}")
 
     # ---- normal tasks --------------------------------------------------
+    def _expand_task_wire(self, t: tuple) -> dict:
+        """Rebuild the full spec dict from a compact template push:
+        ``(tpl_id, task_id, args, job[, skeleton])`` — the skeleton rides
+        along on the first push over a connection and is cached here, so
+        the driver never copies the spec per call."""
+        if len(t) == 5:
+            skel = t[4]
+            self._tpl_cache[t[0]] = skel
+        else:
+            skel = self._tpl_cache.get(t[0])
+            if skel is None:
+                # driver believed the skeleton was already here (e.g. a
+                # restarted worker reached through a recycled connection);
+                # an RpcError reply breaks the lease, and the retry lands
+                # with a fresh sent-set that re-ships the skeleton
+                raise rpc.RpcError(
+                    f"unknown task template {t[0].hex()}"
+                )
+        spec = dict(skel)
+        spec["task_id"] = t[1]
+        spec["args"] = t[2]
+        if t[3]:
+            spec["job"] = t[3]
+        return spec
+
     async def handle_push_task(self, spec, conn=None) -> dict:
+        if type(spec) is tuple:
+            spec = self._expand_task_wire(spec)
         if spec.get("job"):
             # log-streaming attribution + nested submissions inherit it
             self.rt._current_job_hex = spec["job"]
@@ -420,7 +453,11 @@ class WorkerServer:
                 payload = ("inline", s.to_bytes())
             else:
                 oid = task_return_binary(spec["task_id"], idx)
-                self.rt._write_to_store(oid, s)
+                # windowed announce (BENCH.md multi-client term (c)): the
+                # GCS directory parks location lookups behind a waiter, so
+                # a cross-node consumer racing the flush window resolves
+                # the moment the batched announce lands
+                self.rt._write_to_store(oid, s, urgent_announce=False)
                 self.rt._register_edges(oid, nested)
                 payload = ("stored", s.total_bytes)
         await conn.notify("stream_item", {
@@ -442,9 +479,14 @@ class WorkerServer:
             from ray_tpu.common.ids import task_return_binary
 
             oid = task_return_binary(spec["task_id"], 0)
-            # urgent announce: the "stored" reply races the caller's get —
-            # the location must flush this tick, not a window later
-            self.rt._write_to_store(oid, s)
+            # windowed announce (BENCH.md multi-client term (c)): a same-
+            # node caller resolves the "stored" reply straight off the
+            # shared arena (no directory read), and a cross-node pull
+            # parks on the GCS location waiter until the batched announce
+            # lands ≤ one flush window later — per-result notify rpcs
+            # were one of the three multi-client put costs itemized in
+            # the roofline
+            self.rt._write_to_store(oid, s, urgent_announce=False)
             self.rt._register_edges(oid, nested)
             return {"status": "ok", "returns": [("stored", s.total_bytes)]}
         values = list(result)
@@ -464,7 +506,7 @@ class WorkerServer:
                 returns.append(("inline", s.to_bytes()))
             else:
                 oid = task_return_binary(tid, i)
-                self.rt._write_to_store(oid, s)
+                self.rt._write_to_store(oid, s, urgent_announce=False)
                 self.rt._register_edges(oid, nested)
                 returns.append(("stored", s.total_bytes))
         return {"status": "ok", "returns": returns}

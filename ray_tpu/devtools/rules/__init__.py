@@ -16,6 +16,7 @@ Rule ids are stable and grouped by family:
 - RT112 unbounded-retry-loop        (retry)
 - RT113 half-checkpoint-pair        (checkpoint)
 - RT114 wall-clock-liveness         (clock)
+- RT115 bytes-copy-on-hot-path      (bytescopy)
 
 The RT2xx series (actor-deadlock, objectref-leak, unserializable-
 capture, rank-divergent-collective) is the whole-program rtflow tier —
@@ -33,6 +34,7 @@ from ray_tpu.devtools.rules.backlog import (
     UnboundedServeDispatch,
     UnpolicedCallSoon,
 )
+from ray_tpu.devtools.rules.bytescopy import BytesCopyOnHotPath
 from ray_tpu.devtools.rules.checkpoint import HalfCheckpointPair
 from ray_tpu.devtools.rules.clock import WallClockLiveness
 from ray_tpu.devtools.rules.concurrency import UnlockedLazyInit
@@ -59,4 +61,5 @@ ALL_RULES = [
     UnboundedRetryLoop,
     HalfCheckpointPair,
     WallClockLiveness,
+    BytesCopyOnHotPath,
 ]

@@ -1,0 +1,265 @@
+"""RT115: intermediate bytes materialization on a put/send hot path.
+
+The data plane's put path is single-pass by construction: serialization
+collects zero-copy views (pickle5 out-of-band buffers, ``getbuffer()``
+scratch) and ``write_into`` memcpys each exactly once into the arena
+reservation.  A ``bytes(<memoryview>)`` or ``b"".join(...)`` inside that
+path silently reintroduces the second pass the data-plane-v2 rebuild
+removed — every payload byte is touched twice and the put roofline halves
+(BENCH.md put-bandwidth roofline).  The fix is vectored segment writes:
+hand the views to ``SerializedObject.write_into`` / ``ShmStore.
+put_vectored`` instead of concatenating.
+
+Scope, tuned for precision over recall:
+
+- Only functions *reachable from a put/send seed* are candidates —
+  reachability is a module-local call graph (callee names resolved
+  against functions defined in the same file) rooted at: ``put``,
+  ``put_vectored``, ``reserve``, ``commit``, ``_write_to_store``,
+  ``write_into``, ``serialize``, ``serialize_small``, and — in
+  collective modules (path contains ``collective``) — any function
+  whose name contains ``send``, ``allreduce``, ``allgather``,
+  ``reducescatter``, or ``broadcast``.
+- Flagged shapes inside a hot function:
+  * ``b"".join(...)`` (and ``bytes().join(...)``) — the classic
+    concatenating materializer;
+  * ``bytes(X)`` where ``X`` is memoryview-tainted: a direct
+    ``memoryview(...)`` / ``.cast(...)`` / ``.getbuffer()`` /
+    ``.toreadonly()`` / ``.raw()`` call, a local name assigned from one
+    (reassignment from another source clears the taint), or an
+    attribute named ``view`` (the PinnedBuffer payload convention).
+- ``bytes(object_id)`` / ``bytes(n)`` and read-path copies in functions
+  not reachable from a seed are legal; a deliberate hot-path copy-out
+  (e.g. releasing a pin early) carries a justified
+  ``rtlint: disable=RT115``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ray_tpu.devtools.lint import Rule
+
+#: function names that root the put-path reachability walk
+_SEED_NAMES = frozenset((
+    "put", "put_vectored", "reserve", "commit", "_write_to_store",
+    "write_into", "serialize", "serialize_small",
+))
+
+#: extra seed-name substrings armed only in collective modules
+_COLLECTIVE_SEED_MARKERS = (
+    "send", "allreduce", "allgather", "reducescatter", "broadcast",
+)
+
+#: attribute/callee names whose call result is a memoryview
+_VIEW_PRODUCERS = frozenset((
+    "memoryview", "cast", "getbuffer", "toreadonly", "raw",
+))
+
+#: attribute names conventionally holding a memoryview payload
+_VIEW_ATTRS = frozenset(("view",))
+
+
+def _callee_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _graph_callee_name(func: ast.AST) -> str:
+    """Callee name for the reachability graph.  Attribute calls only
+    count on a ``self`` receiver — ``d.get(...)`` / ``fut.cancel(...)``
+    on arbitrary objects would alias into same-named methods of the
+    module and wire the whole file together."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "self"
+    ):
+        return func.attr
+    return ""
+
+
+def _is_view_producer_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and _callee_name(node.func) in _VIEW_PRODUCERS
+    )
+
+
+def _is_empty_bytes(node: ast.AST) -> bool:
+    """``b""`` literal or ``bytes()`` call."""
+    if isinstance(node, ast.Constant) and node.value == b"":
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "bytes"
+        and not node.args
+    )
+
+
+class _FnInfo:
+    __slots__ = ("name", "node", "callees")
+
+    def __init__(self, name: str, node: ast.AST):
+        self.name = name
+        self.node = node
+        self.callees: Set[str] = set()
+
+
+def _collect_functions(tree: ast.AST) -> List[_FnInfo]:
+    """Every function/method in the module with the set of names it
+    calls (simple callee-name resolution; precision is fine for the
+    intra-module reachability this rule needs)."""
+    out: List[_FnInfo] = []
+
+    class V(ast.NodeVisitor):
+        def _fn(self, node):
+            info = _FnInfo(node.name, node)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = _graph_callee_name(sub.func)
+                    if name:
+                        info.callees.add(name)
+            out.append(info)
+            # nested defs are collected too (walk continues via generic)
+            self.generic_visit(node)
+
+        visit_FunctionDef = _fn
+        visit_AsyncFunctionDef = _fn
+
+    V().visit(tree)
+    return out
+
+
+def _reachable_functions(fns: List[_FnInfo], path: str) -> List[_FnInfo]:
+    by_name: Dict[str, List[_FnInfo]] = {}
+    for f in fns:
+        by_name.setdefault(f.name, []).append(f)
+    seeds = set(_SEED_NAMES)
+    if "collective" in path:
+        for f in fns:
+            low = f.name.lower()
+            if any(m in low for m in _COLLECTIVE_SEED_MARKERS):
+                seeds.add(f.name)
+    work = [f for f in fns if f.name in seeds]
+    hot: Set[int] = set()
+    hot_names: Set[str] = set()
+    while work:
+        f = work.pop()
+        if id(f) in hot:
+            continue
+        hot.add(id(f))
+        hot_names.add(f.name)
+        for callee in f.callees:
+            if callee in by_name and callee not in hot_names:
+                work.extend(by_name[callee])
+    return [f for f in fns if id(f) in hot]
+
+
+class BytesCopyOnHotPath(Rule):
+    id = "RT115"
+    name = "bytes-copy-on-hot-path"
+    description = (
+        "bytes(<memoryview>) / b\"\".join materialization inside a "
+        "function reachable from the put/_write_to_store/collective-send "
+        "path — reintroduces the second payload pass the vectored data "
+        "plane removed"
+    )
+    hint = (
+        "write segments directly into the reserved buffer "
+        "(SerializedObject.write_into / ShmStore.put_vectored) instead "
+        "of concatenating into an intermediate bytes"
+    )
+
+    def check(self, ctx) -> None:
+        fns = _collect_functions(ctx.tree)
+        for f in _reachable_functions(fns, ctx.path):
+            self._scan_function(ctx, f.node)
+
+    def _scan_function(self, ctx, fn_node) -> None:
+        tainted: Set[str] = set()
+        # parameters annotated as memoryview carry taint in
+        args = getattr(fn_node, "args", None)
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs):
+                ann = a.annotation
+                if isinstance(ann, ast.Name) and ann.id == "memoryview":
+                    tainted.add(a.arg)
+
+        def is_tainted(expr: ast.AST) -> bool:
+            if _is_view_producer_call(expr):
+                return True
+            if isinstance(expr, ast.Name) and expr.id in tainted:
+                return True
+            if (
+                isinstance(expr, ast.Attribute)
+                and expr.attr in _VIEW_ATTRS
+            ):
+                return True
+            return False
+
+        rule = self
+
+        def check_call(sub: ast.Call) -> None:
+            func = sub.func
+            # b"".join(...) / bytes().join(...)
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "join"
+                and _is_empty_bytes(func.value)
+            ):
+                ctx.add(
+                    rule, sub,
+                    message="b\"\".join materializes an intermediate "
+                            "bytes on the put/send hot path (second "
+                            "pass over every payload byte)",
+                    hint=rule.hint,
+                )
+            # bytes(<memoryview-tainted>)
+            elif (
+                isinstance(func, ast.Name)
+                and func.id == "bytes"
+                and len(sub.args) == 1
+                and is_tainted(sub.args[0])
+            ):
+                ctx.add(
+                    rule, sub,
+                    message="bytes(<memoryview>) copies the payload "
+                            "on the put/send hot path — the vectored "
+                            "plane writes views straight into the "
+                            "destination",
+                    hint=rule.hint,
+                )
+
+        def visit(node: ast.AST) -> None:
+            # statement-ordered traversal of THIS function only: taint
+            # assignments apply in program order, and nested defs are
+            # scanned separately iff themselves reachable from a seed
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)
+                ):
+                    continue
+                if isinstance(child, ast.Assign):
+                    names = [
+                        t.id for t in child.targets
+                        if isinstance(t, ast.Name)
+                    ]
+                    if names:
+                        if is_tainted(child.value):
+                            tainted.update(names)
+                        else:
+                            tainted.difference_update(names)
+                if isinstance(child, ast.Call):
+                    check_call(child)
+                visit(child)
+
+        visit(fn_node)

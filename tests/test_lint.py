@@ -877,6 +877,94 @@ class TestWallClockLiveness:
 
 
 # ---------------------------------------------------------------------------
+# RT115 bytes-copy-on-hot-path
+# ---------------------------------------------------------------------------
+
+
+class TestBytesCopyOnHotPath:
+    def test_flags_bytes_of_memoryview_in_put(self):
+        src = """
+        def put(self, object_id, data):
+            view = memoryview(data)
+            payload = bytes(view)
+            self.store.write(object_id, payload)
+        """
+        assert rule_ids(src, rules=["RT115"]) == ["RT115"]
+
+    def test_flags_join_reachable_from_write_to_store(self):
+        # the materializer lives in a helper the put path calls
+        src = """
+        def _write_to_store(self, oid, s):
+            blob = self._assemble(s)
+            self.store.put(oid, blob)
+
+        def _assemble(self, s):
+            return b"".join(s.buffers)
+        """
+        assert rule_ids(src, rules=["RT115"]) == ["RT115"]
+
+    def test_flags_direct_bytes_of_cast(self):
+        src = """
+        def put_vectored(self, oid, segments):
+            for seg in segments:
+                self._send(bytes(seg.cast("B")))
+        """
+        assert rule_ids(src, rules=["RT115"]) == ["RT115"]
+
+    def test_flags_collective_send_path(self):
+        # collective modules arm send-shaped seeds
+        src = """
+        def _send_chunk(self, peer, view):
+            chunk = memoryview(view)
+            return peer.call("recv", bytes(chunk))
+        """
+        assert rule_ids(
+            src, path="pkg/util/collective/rpc_backend.py",
+            rules=["RT115"],
+        ) == ["RT115"]
+
+    def test_compliant_twin_vectored_write_is_silent(self):
+        # the SAME put written single-pass: views written in place
+        src = """
+        def put(self, object_id, data):
+            view = memoryview(data)
+            buf = self.reserve(object_id, view.nbytes)
+            buf[: view.nbytes] = view
+            self.commit(object_id)
+        """
+        assert rule_ids(src, rules=["RT115"]) == []
+
+    def test_silent_off_hot_path(self):
+        # a read-path copy-out is not reachable from any put/send seed
+        src = """
+        def read_small(self, oid):
+            pin = self.store.get(oid)
+            return bytes(pin.view)
+        """
+        assert rule_ids(src, rules=["RT115"]) == []
+
+    def test_reassignment_clears_view_taint(self):
+        src = """
+        def put(self, object_id, data):
+            view = memoryview(data)
+            self.write(view)
+            view = data.tolist()
+            return bytes(view)
+        """
+        assert rule_ids(src, rules=["RT115"]) == []
+
+    def test_untainted_bytes_call_is_silent(self):
+        # bytes(object_id) / bytes(n) normalization is legal on the put path
+        src = """
+        def put(self, object_id, size):
+            key = bytes(object_id)
+            pad = bytes(size)
+            self.store.write(key, pad)
+        """
+        assert rule_ids(src, rules=["RT115"]) == []
+
+
+# ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, parse errors
 # ---------------------------------------------------------------------------
 

@@ -72,6 +72,26 @@ def test_taskplane_alloc_churn_ceiling(cluster):
     )
 
 
+def test_tasks_alloc_churn_ceiling(cluster):
+    """Normal-task twin of the churn ceiling (data plane v2): gen0
+    container allocs per windowed `.remote()` NORMAL task must stay
+    <= 9.  The measurement IS bench.py's
+    `bench_taskplane_alloc_churn_tasks`.  History: ~25/call through
+    r10 — the per-call spec dict copy, the 9-key lineage entry dict +
+    live-returns set, and (dominant on a saturated host) lease requests
+    parked at the GCS in proportion to queue depth; the slotted-lineage
+    + compact-template + bounded-lease-pipeline rebuild cleared it to
+    ~4/call."""
+    bench = _load_bench()
+    per_call = bench.bench_taskplane_alloc_churn_tasks(ray_tpu)
+    print(f"\ntaskplane_alloc_churn_tasks: {per_call:.2f} allocs/call")
+    assert per_call <= 9, (
+        f"normal-task alloc churn {per_call:.1f}/call blew the 9/call "
+        "ceiling — per-call container churn crept back into the "
+        "submit/lineage/dispatch/reply path (v2 steady state is ~4)"
+    )
+
+
 def test_windowed_actor_call_throughput_floor(cluster):
     """Generous wall-clock floor for the batched actor path: ~10-30x
     under the unloaded steady state, so only a structural collapse
