@@ -988,6 +988,166 @@ def bench_preemption_recovery():
         cluster.shutdown()
 
 
+def bench_pipeline_gpt2(ray_tpu, steps: int = 6, trials: int = 3):
+    """MPMD pipeline GPT-2 vs the single-gang baseline at equal chips,
+    interleaved A/B (pipeline step block / local step block per trial,
+    so host drift hits both arms equally).
+
+    CPU context: one host, so the row measures ORCHESTRATION overhead —
+    the per-micro-op actor-call + shm-handoff cost over the same math —
+    not parallel speedup (that needs stages on distinct chips).  Both
+    arms run the identical per-stage programs (train.pipeline's
+    LocalPipelineRunner IS the pipeline partition run in one process),
+    and the bitwise loss cross-check keeps the row honest.
+    """
+    from ray_tpu.models import gpt2 as gpt2_mod
+    from ray_tpu.train.pipeline import (
+        LocalPipelineRunner,
+        PipelineConfig,
+        PipelineTrainer,
+        synthetic_batches,
+    )
+
+    cfg = gpt2_mod.GPTConfig.tiny(num_layers=4, max_seq_len=64)
+    pc = PipelineConfig(
+        model_config=cfg, n_stages=2, n_micro=4, micro_batch=4,
+        seq_len=64, optimizer={"name": "adam", "lr": 1e-3},
+        name="bench-pipe",
+    )
+    tr = PipelineTrainer(pc, bundle={"CPU": 1})
+    try:
+        tr.start()
+        local = LocalPipelineRunner(pc)
+        warm = synthetic_batches(pc, 1, seed=99)
+        tr.train(warm)      # compile both arms outside the timed window
+        local.train(warm)
+        tok_step = pc.tokens_per_step()
+        pipe_s, local_s = [], []
+        all_equal = True
+        for t in range(trials):
+            batches = synthetic_batches(pc, steps, seed=100 + t)
+            t0 = time.perf_counter()
+            lp = tr.train(batches)
+            pipe_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            ll = local.train(batches)
+            local_s.append(time.perf_counter() - t0)
+            all_equal = all_equal and (lp == ll)
+        pipe_tps = tok_step * steps / (sum(pipe_s) / trials)
+        local_tps = tok_step * steps / (sum(local_s) / trials)
+        return {
+            "pipeline_tokens_per_s": pipe_tps,
+            "single_gang_tokens_per_s": local_tps,
+            "ratio": pipe_tps / local_tps,
+            "loss_bitwise_equal": all_equal,
+            "n_stages": pc.n_stages,
+            "n_micro": pc.n_micro,
+        }
+    finally:
+        tr.shutdown()
+
+
+def bench_pipeline_preemption(steps: int = 8, seed: int = 2026):
+    """Tokens lost to a seeded mid-run preemption of a pipeline stage
+    host: run the SAME seeded schedule clean and with
+    ``ChaosController.preempt_node`` against the middle stage's node,
+    and charge the wall-clock overhead at the clean run's token rate.
+    Also reports duplicate micro-op executions (re-executed work after
+    the migration; the 1F1B bubble is the acceptance bound) and pins
+    zero reconstructions + bitwise loss equality across the two runs.
+    """
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.common.faults import ChaosController
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.models import gpt2 as gpt2_mod
+    from ray_tpu.train.pipeline import (
+        PipelineConfig,
+        PipelineTrainer,
+        bubble_micro_ops,
+        synthetic_batches,
+    )
+
+    cfg = gpt2_mod.GPTConfig.tiny(num_layers=3, max_seq_len=32)
+    pc = PipelineConfig(
+        model_config=cfg, n_stages=3, n_micro=4, micro_batch=2,
+        seq_len=32, optimizer={"name": "adam", "lr": 1e-3},
+        name="bench-preempt",
+    )
+    h = {"num_cpus": 0, "resources": {"h": 0.5}}
+    v = {"num_cpus": 0, "resources": {"pre": 0.4}}
+    opts = [[dict(h)], [dict(v)], [dict(h)]]  # middle stage on the victim
+
+    def one_run(preempt: bool):
+        cluster = Cluster(
+            initialize_head=True, connect=True,
+            head_node_args={"num_cpus": 4, "resources": {"h": 4.0}},
+        )
+        try:
+            victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            tr = PipelineTrainer(pc, stage_actor_options=opts)
+            tr.start()
+            batches = synthetic_batches(pc, steps, seed=7)
+            tr.train(batches[:2])  # warm/compile outside the timed window
+            # migration target up-front in BOTH arms, so the timed
+            # window charges only the preemption itself, not node
+            # provisioning
+            cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            import threading
+
+            losses: list = []
+            errs: list = []
+
+            def loop():
+                try:
+                    for x, y in batches[2:]:
+                        losses.append(tr.run_step(x, y))
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            t0 = time.perf_counter()
+            th = threading.Thread(target=loop, daemon=True)
+            th.start()
+            if preempt:
+                chaos = ChaosController(cluster, seed=seed)
+                chaos.preempt_node(node=victim, deadline_s=20.0)
+            th.join(timeout=600)
+            elapsed = time.perf_counter() - t0
+            try:
+                if th.is_alive() or errs:
+                    raise RuntimeError(f"pipeline run failed: {errs!r}")
+                cnt = tr.counters()
+                executed = sum(
+                    c["executed"] for lanes in cnt for c in lanes
+                )
+                recon = get_runtime().reconstructions
+                return losses, elapsed, executed, recon
+            finally:
+                # daemon thread: a wedged run cannot keep the bench
+                # process alive, and the gang always tears down
+                tr.shutdown()
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+    clean_losses, t_clean, exec_clean, _ = one_run(False)
+    chaos_losses, t_chaos, exec_chaos, recon = one_run(True)
+    timed_steps = steps - 2
+    clean_tps = pc.tokens_per_step() * timed_steps / t_clean
+    overhead_s = max(0.0, t_chaos - t_clean)
+    return {
+        "tokens_lost": overhead_s * clean_tps,
+        "overhead_s": overhead_s,
+        "clean_tokens_per_s": clean_tps,
+        "dup_micro_ops": exec_chaos - exec_clean,
+        "bubble_micro_ops": bubble_micro_ops(pc.n_stages),
+        "reconstructions": recon,
+        "loss_bitwise_equal": clean_losses == chaos_losses,
+    }
+
+
 def bench_serve_rps(ray_tpu, service_ms=100.0, max_ongoing=4,
                     slo_ms=750.0, max_queue_depth=12,
                     steady_s=4.0, overload_s=5.0):
@@ -1413,6 +1573,27 @@ def main():
                              error=fr["collective_err"])
                 except Exception as e:  # noqa: BLE001
                     emit("fault_recovery_task_ms", 0.0, "ms", error=repr(e))
+            # MPMD pipeline: orchestration overhead vs the single-gang
+            # baseline at equal chips, interleaved A/B, bitwise-loss
+            # cross-checked (full context in BENCH.md "MPMD pipeline")
+            if remaining() > 90:
+                try:
+                    pg = bench_pipeline_gpt2(ray_tpu)
+                    emit(
+                        "pipeline_gpt2_tokens_per_s",
+                        pg["pipeline_tokens_per_s"], "tokens/s",
+                        single_gang=round(
+                            pg["single_gang_tokens_per_s"], 1),
+                        ratio=round(pg["ratio"], 3),
+                        loss_bitwise_equal=pg["loss_bitwise_equal"],
+                        n_stages=pg["n_stages"],
+                        note="1 CPU host: measures actor-call + shm "
+                             "handoff overhead over identical math, "
+                             "not parallel speedup",
+                    )
+                except Exception as e:  # noqa: BLE001
+                    emit("pipeline_gpt2_tokens_per_s", 0.0, "tokens/s",
+                         error=repr(e))
             # failure detection: phi-accrual vs fixed timeout under an
             # induced 2x load stall + a true partition — deterministic
             # seeded simulation through the production detector code
@@ -1471,6 +1652,26 @@ def main():
         except Exception as e:  # noqa: BLE001
             emit("preemption_recovery_object_blackout_ms", 0.0, "ms",
                  error=repr(e))
+
+    # tokens lost to a seeded mid-run stage-host preemption: the MPMD
+    # pipeline's survival number (clean vs preempted run of the same
+    # seeded schedule; own clusters, after the family runtime is down)
+    if remaining() > 150:
+        try:
+            pp = bench_pipeline_preemption()
+            emit(
+                "tokens_lost_to_preemption", pp["tokens_lost"], "tokens",
+                overhead_s=round(pp["overhead_s"], 2),
+                clean_tokens_per_s=round(pp["clean_tokens_per_s"], 1),
+                dup_micro_ops=pp["dup_micro_ops"],
+                bubble_micro_ops=pp["bubble_micro_ops"],
+                reconstructions=pp["reconstructions"],
+                loss_bitwise_equal=pp["loss_bitwise_equal"],
+                note="seeded preempt_node vs clean run, same schedule; "
+                     "overhead charged at the clean token rate",
+            )
+        except Exception as e:  # noqa: BLE001
+            emit("tokens_lost_to_preemption", 0.0, "tokens", error=repr(e))
 
     # scheduler scale excerpt: 1k virtual nodes, lease-churn latency
     # (full tier: tests/test_scheduler_scale.py).  After the cluster

@@ -298,6 +298,7 @@ class ShmStore:
         self._slab_max = -1                 # -1 until sized from config
         self._slab_disabled = False         # arena pressure: fall back
         self._slab_misses = 0               # skips since disable (re-probe)
+        self._slab_hits = 0                 # reservations served by slab
 
     # -- write path ------------------------------------------------------
     def _put_fault_check(self, object_id: bytes) -> None:
@@ -474,6 +475,7 @@ class ShmStore:
             off = free.pop()
             view = self._mv[off : off + size]
             self._slab_pending[object_id] = (off, view, slot_size)
+            self._slab_hits += 1
             return view
 
     def shrink_slab(self) -> int:
@@ -670,6 +672,9 @@ class ShmStore:
             "used": used.value,
             "objects": objs.value,
             "evictions": evs.value,
+            # process-local: inline-slab reservations served since open
+            # (the data-plane pin for "small puts ride the slab")
+            "slab_hits": self._slab_hits,
         }
 
     def reap(self) -> int:

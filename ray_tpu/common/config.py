@@ -261,5 +261,21 @@ D("drain_lease_wait_frac", float, 0.5)
 # raylet preemption-watcher poll cadence (node.preempt chaos site +
 # the GCE metadata stub); 0 disables the watcher
 D("preempt_poll_interval_s", float, 0.25)
+# actor-migration state blobs at most this large ride inline over the
+# worker conn into GCS KV (the original path, bit-for-bit); larger
+# blobs (pipeline-stage params + optimizer state) are stored in the
+# shm object plane and only the object id crosses the control plane
+D("actor_ckpt_inline_max_bytes", int, 256 * 1024)
+# restore-side fetch budget for an object-plane checkpoint blob; on
+# expiry the actor restarts fresh (the same degradation as a failed
+# checkpoint capture) instead of wedging create_actor forever
+D("actor_ckpt_fetch_timeout_s", float, 60.0)
+# capture-fence quiescence budget: how long a drain checkpoint waits
+# for already-admitted actor calls to finish before capturing anyway.
+# A re-entrant call pattern (m1 awaiting self.m2 — rtflow RT201
+# territory) can never quiesce once the fence parks the inner call; on
+# expiry the capture proceeds (logged) rather than burning the whole
+# drain deadline into the hard-death fallback
+D("actor_ckpt_quiesce_timeout_s", float, 20.0)
 
 cfg = _Config()

@@ -103,6 +103,17 @@ def _restore_numpy(a):
     return a
 
 
+def _restore_ext_ndarray(dtype, shape, buf):
+    """Rebuild an extension-dtype ndarray from its out-of-band buffer.
+
+    Zero-copy like numpy's builtin-dtype pickle-5 restore: the array is
+    a read-only view over the buffer (whose .base chain keeps an arena
+    pin alive on the shm zero-copy path)."""
+    import numpy as np
+
+    return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
 def _restore_arrow_table(buf):
     import pyarrow as pa
 
@@ -179,6 +190,29 @@ class _Pickler(cloudpickle.CloudPickler):
             import numpy as np
 
             return (_restore_numpy, (np.asarray(obj),))
+        np_mod = sys.modules.get("numpy")
+        if np_mod is not None and isinstance(obj, np_mod.ndarray):
+            d = obj.dtype
+            # Extension-dtype arrays (ml_dtypes bfloat16/fp8 — every jax
+            # bf16 activation converted for the wire): numpy's own
+            # protocol-5 reduce covers only builtin dtypes, so these
+            # would serialize via tobytes() INTO the meta pickle — a
+            # full extra payload copy the put path never sees.  Route
+            # large contiguous ones out-of-band ourselves.
+            if (
+                d.isbuiltin != 1          # 2 = user-registered (ml_dtypes)
+                and not d.hasobject
+                and obj.flags.c_contiguous
+                and obj.nbytes >= _BYTES_OOB_THRESHOLD
+            ):
+                # extension dtypes refuse the buffer protocol ("cannot
+                # include dtype 'E' in a buffer") — export the raw
+                # bytes through a zero-copy uint8 view instead
+                return (
+                    _restore_ext_ndarray,
+                    (d, obj.shape,
+                     pickle.PickleBuffer(obj.view(np_mod.uint8))),
+                )
         pa = sys.modules.get("pyarrow")
         if pa is not None and isinstance(obj, pa.Table):
             # Arrow IPC, not arrow's own pickle: pickling a SLICED table

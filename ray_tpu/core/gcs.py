@@ -2257,6 +2257,24 @@ class GcsServer:
     def _ckpt_key(actor_id: ActorID) -> str:
         return f"__rt_actor_ckpt:{actor_id.hex()}"
 
+    async def _drop_actor_ckpt(self, actor_id: ActorID) -> None:
+        """Retire an actor's parked drain checkpoint: pop the KV record
+        and, when the blob rode the object plane, free the blob object
+        cluster-wide (its copies would otherwise pin arena space as
+        protected primaries forever)."""
+        import pickle
+
+        raw = self.kv.pop(self._ckpt_key(actor_id), None)
+        if raw is None:
+            return
+        self._mark_dirty()
+        try:
+            ref = pickle.loads(raw).get("blob_ref")
+        except Exception:
+            return
+        if ref is not None:
+            await self._free_object(ref)
+
     async def rpc_drain_node(self, conn, p):
         """Start a graceful drain: stop scheduling onto the node, then
         migrate its state within ``deadline_s``.  The node stays alive
@@ -2284,6 +2302,7 @@ class GcsServer:
             "objects_moved": 0,
             "actors_total": 0,
             "actors_moved": 0,
+            "ckpt_blob_objects": 0,
         }
         node.draining = True  # parks the node in the scheduler index
         self.record_cluster_event(
@@ -2563,10 +2582,19 @@ class GcsServer:
             reason = f"node draining ({st['reason']})"
             if ck.get("supported"):
                 # stateful migration: intentional relocation, NOT a
-                # failure — does not consume the restart budget
+                # failure — does not consume the restart budget.  Large
+                # blobs arrive as an object-plane ref (blob_ref): only
+                # the id is parked in KV; the restore pulls the payload
+                # over the data plane and _drop_actor_ckpt frees it.
                 self.kv[self._ckpt_key(actor.actor_id)] = pickle.dumps(
-                    {"blob": ck.get("blob"), "groups": groups}, protocol=5
+                    {"blob": ck.get("blob"),
+                     "blob_ref": ck.get("blob_ref"),
+                     "groups": groups}, protocol=5
                 )
+                if ck.get("blob_ref") is not None:
+                    st["ckpt_blob_objects"] = (
+                        st.get("ckpt_blob_objects", 0) + 1
+                    )
                 self._mark_dirty()
             elif groups:
                 # hook-less collective member: no user state to carry,
@@ -2584,8 +2612,16 @@ class GcsServer:
                 if not can_restart:
                     # no budget: leave it serving — it dies with the node
                     # exactly as it would today, and killing it early
-                    # would only shorten its remaining service time
-                    self.kv.pop(self._ckpt_key(actor.actor_id), None)
+                    # would only shorten its remaining service time.
+                    # If the worker DID capture (its reply was lost), its
+                    # admission fence is up — lift it, or "serving" would
+                    # really be "parking every call until node death"
+                    if wconn is not None and not wconn.closed:
+                        try:
+                            await wconn.notify("checkpoint_abort", {})
+                        except Exception:
+                            pass
+                    await self._drop_actor_ckpt(actor.actor_id)
                     continue
                 actor.restarts_used += 1
             actor.state = ACTOR_RESTARTING
@@ -3130,7 +3166,7 @@ class GcsServer:
             return
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
-        self.kv.pop(self._ckpt_key(actor.actor_id), None)
+        await self._drop_actor_ckpt(actor.actor_id)
         token = b"actor:" + actor.actor_id.binary()
         for oid in self._spec_ref_oids(actor.creation_spec):
             s = self.object_holders.get(oid)
@@ -3258,6 +3294,7 @@ class GcsServer:
                 try:
                     ck = pickle.loads(ck_raw)
                     create_payload["checkpoint"] = ck.get("blob")
+                    create_payload["checkpoint_ref"] = ck.get("blob_ref")
                     create_payload["collective_groups"] = ck.get(
                         "groups") or []
                 except Exception:
@@ -3265,7 +3302,7 @@ class GcsServer:
             # No fixed deadline on __init__ replay — liveness comes from the
             # worker: its death breaks the duplex conn and fails this call.
             await worker_conn.call("create_actor", create_payload, timeout=-1)
-            self.kv.pop(self._ckpt_key(actor.actor_id), None)
+            await self._drop_actor_ckpt(actor.actor_id)
             actor.state = ACTOR_ALIVE
             actor.worker_addr = grant["worker_addr"]
             actor.node_id = NodeID.from_hex(grant["node_id"])

@@ -674,6 +674,8 @@ class Runtime:
             return await self._worker_server.handle_create_actor(payload)
         if method == "checkpoint_actor" and self._worker_server is not None:
             return await self._worker_server.handle_checkpoint_actor(payload)
+        if method == "checkpoint_abort" and self._worker_server is not None:
+            return await self._worker_server.handle_checkpoint_abort()
         if method == "dump_stacks" and self._worker_server is not None:
             return await self._worker_server._handle(conn, "dump_stacks",
                                                      payload)

@@ -49,6 +49,19 @@ class WorkerServer:
         self._actor_is_async = False
         self._actor_sem: Optional[asyncio.Semaphore] = None
         self._actor_thread_pool = None  # set for threaded sync actors
+        # drain-migration capture fence: once this actor's state has been
+        # captured (handle_checkpoint_actor), no further call may execute
+        # here — post-capture effects would be acked and then lost.
+        # _ckpt_unseal releases fence-parked calls if a FAILED capture
+        # lifts the seal; _actor_exec_inflight counts admitted executions
+        # across every path (executor, thread pools, loop-resident async
+        # methods) so the capture can wait for quiescence.
+        self._ckpt_sealed = False
+        self._ckpt_unseal = asyncio.Event()
+        self._actor_exec_inflight = 0
+        # last object-plane checkpoint blob this process stored; freed if
+        # a later capture finds it unconsumed (reply lost → never parked)
+        self._ckpt_blob_oid: Optional[bytes] = None
         self._concurrency_groups: Dict[str, dict] = {}  # name -> sem/pool
         self._method_groups: Dict[str, str] = {}  # method -> group name
         self._running_task_threads: Dict[bytes, int] = {}  # task_id -> thread id
@@ -111,6 +124,8 @@ class WorkerServer:
             return await self.handle_create_actor(p)
         if method == "checkpoint_actor":
             return await self.handle_checkpoint_actor(p)
+        if method == "checkpoint_abort":
+            return await self.handle_checkpoint_abort()
         if method == "bind_env":
             os.environ.update(p["env"])
             _apply_jax_platform(p["env"])
@@ -599,14 +614,41 @@ class WorkerServer:
         # collective groups the predecessor process was a member of —
         # the replacement-reform path, with survivors nudged via pubsub
         blob = p.get("checkpoint")
+        blob_ref = p.get("checkpoint_ref")
         restore = getattr(self.actor_instance, "__rt_restore__", None)
-        if blob is not None and callable(restore):
-            state = self.rt.deserialize(blob)
-            await loop.run_in_executor(self._exec, restore, state)
-            logger.info(
-                "actor %s state restored from drain checkpoint "
-                "(%d bytes)", self.actor_id, len(blob),
-            )
+        if callable(restore) and (blob is not None or blob_ref is not None):
+            state, have = None, False
+            if blob is not None:
+                state = self.rt.deserialize(blob)
+                have = True
+                src = f"{len(blob)} bytes inline"
+            else:
+                # object-plane blob: pull over the data plane (the
+                # draining source node is still alive — the drain holds
+                # the kill until migration completes).  A lost blob
+                # (drain fell back to hard death before a copy escaped)
+                # degrades to a fresh start, like a failed capture.
+                deadline = (
+                    time.monotonic() + cfg.actor_ckpt_fetch_timeout_s
+                )
+                try:
+                    (state,) = await self.rt._get_async(
+                        [blob_ref], deadline
+                    )
+                    have = True
+                    src = f"object {blob_ref.hex()[:12]}"
+                except Exception:
+                    logger.exception(
+                        "actor %s checkpoint blob %s unavailable; "
+                        "restoring fresh", self.actor_id,
+                        blob_ref.hex()[:12],
+                    )
+            if have:
+                await loop.run_in_executor(self._exec, restore, state)
+                logger.info(
+                    "actor %s state restored from drain checkpoint "
+                    "(%s)", self.actor_id, src,
+                )
         for g in p.get("collective_groups") or ():
             try:
                 await self._rejoin_collective_group(g)
@@ -646,7 +688,15 @@ class WorkerServer:
         """Drain-time state capture (GCS → worker): runs the opt-in
         ``__rt_checkpoint__`` hook and reports this process's collective
         group memberships.  A half-implemented hook pair (rtlint RT113)
-        degrades to unsupported — the actor restarts fresh."""
+        degrades to unsupported — the actor restarts fresh.
+
+        Blobs at most ``actor_ckpt_inline_max_bytes`` ride inline over
+        this conn into GCS KV, bit-for-bit the original path.  Larger
+        blobs (a pipeline stage's params + optimizer state) are stored
+        in the shm object plane — written via the vectored single-pass
+        put and announced urgently so the restoring worker's pull finds
+        the location — and only the 16-byte object id crosses the
+        control plane; the GCS frees the object after the restore."""
         groups = []
         if "ray_tpu.util.collective.collective" in sys.modules:
             from ray_tpu.util.collective import collective as col_mod
@@ -658,9 +708,122 @@ class WorkerServer:
         if not callable(ck) or not callable(restore):
             return {"supported": False, "blob": None, "groups": groups}
         loop = asyncio.get_running_loop()
-        state = await loop.run_in_executor(self._exec, ck)
-        blob = self.rt.serialize(state).to_bytes()
-        return {"supported": True, "blob": blob, "groups": groups}
+        # Capture fence: seal admission BEFORE the hook runs, then wait
+        # for every already-admitted execution to finish.  Admitted calls
+        # complete and their effects land in the capture (their replies
+        # stay valid); calls arriving after the seal park unreplied and
+        # die with this worker, becoming retries against the RESTORED
+        # actor.  Without the fence, a call slipping in between capture
+        # and the kill executes+acks here but its effects are absent from
+        # the migrated state — an acked-but-lost mutation.  The
+        # quiescence wait (not FIFO ordering) is what makes this hold for
+        # async actors, threaded sync actors, and concurrency-group
+        # methods too, whose executions do not serialize through
+        # self._exec.  Unbounded on purpose — the outer drain deadline is
+        # the bound, and every successful-capture path ends in this
+        # worker's death, so sealing cannot strand callers.
+        self._ckpt_sealed = True
+        # ONE persistent event, cleared on seal — never replaced: calls
+        # parked during an earlier capture must wake on ANY later unseal
+        # (a swapped-in fresh event would strand them forever)
+        self._ckpt_unseal.clear()
+        try:
+            # bounded: a re-entrant call chain (m1 awaiting self.m2 —
+            # the inner call is parked on the fence m1 is counted
+            # against) can never quiesce; proceeding with a possibly
+            # torn capture after the budget beats burning the whole
+            # drain deadline into the hard-death fallback
+            quiesce_end = (
+                time.monotonic() + cfg.actor_ckpt_quiesce_timeout_s
+            )
+            while self._actor_exec_inflight:
+                if time.monotonic() >= quiesce_end:
+                    logger.warning(
+                        "actor %s capture proceeding with %d calls "
+                        "still in flight after %.0fs quiescence wait "
+                        "(re-entrant call pattern?); their effects may "
+                        "miss the migrated state", self.actor_id,
+                        self._actor_exec_inflight,
+                        cfg.actor_ckpt_quiesce_timeout_s,
+                    )
+                    break
+                await asyncio.sleep(0.02)
+            state = await loop.run_in_executor(self._exec, ck)
+            s = self.rt.serialize(state)
+            if self._ckpt_blob_oid is not None:
+                # a previous capture's object-plane blob was never
+                # consumed (its reply was lost, or that drain fell over
+                # before the restore): this process is still alive, so
+                # that migration never happened — free the orphan
+                # instead of leaking a protected primary in the node
+                # arena, whatever size THIS capture turns out to be
+                # (double-free of a consumed blob is a benign tombstone
+                # hit)
+                try:
+                    await self.rt.gcs.call(
+                        "free_objects",
+                        {"object_ids": [self._ckpt_blob_oid]},
+                        timeout=10.0,
+                    )
+                except Exception:
+                    pass
+                self._ckpt_blob_oid = None
+            if s.total_bytes > cfg.actor_ckpt_inline_max_bytes:
+                from ray_tpu.common.ids import ObjectID
+
+                oid = ObjectID.random().binary()
+                # executor, not the loop: the arena write may need the
+                # spill-and-retry path, which must not block the io loop
+                await loop.run_in_executor(
+                    self._exec,
+                    lambda: self.rt._write_to_store(oid, s,
+                                                    urgent_announce=True),
+                )
+                self._ckpt_blob_oid = oid
+                logger.info(
+                    "actor %s checkpoint blob (%d bytes) stored in the "
+                    "object plane as %s", self.actor_id, s.total_bytes,
+                    oid.hex()[:12],
+                )
+                return {"supported": True, "blob": None, "blob_ref": oid,
+                        "blob_bytes": s.total_bytes, "groups": groups}
+            return {"supported": True, "blob": s.to_bytes(),
+                    "groups": groups}
+        except BaseException:
+            # a failed capture degrades to a fresh migration (or, with no
+            # restart budget, to serving until the kill) — lift the fence
+            # AND release the calls parked on it, so "keeps serving" does
+            # not become "hangs until node death"
+            self._ckpt_sealed = False
+            self._ckpt_unseal.set()
+            raise
+
+    async def handle_checkpoint_abort(self) -> bool:
+        """GCS → worker: the migration this capture was for is NOT
+        happening (checkpoint rpc failed GCS-side and the actor is being
+        left to serve) — lift the capture fence, release parked calls,
+        and free the now-orphaned object-plane blob (nothing will ever
+        consume it, and as a protected primary it would pin arena space
+        for the node's remaining life).  Idempotent; a no-op on a
+        never-sealed worker."""
+        if self._ckpt_sealed:
+            logger.info(
+                "actor %s capture fence aborted by GCS; resuming service",
+                self.actor_id,
+            )
+        self._ckpt_sealed = False
+        self._ckpt_unseal.set()
+        oid, self._ckpt_blob_oid = self._ckpt_blob_oid, None
+        if oid is not None:
+            try:
+                await self.rt.gcs.call(
+                    "free_objects", {"object_ids": [oid]}, timeout=10.0
+                )
+            except Exception:
+                # unreachable GCS: the next capture's self-cleanup (or
+                # the node's death) still bounds the orphan
+                self._ckpt_blob_oid = oid
+        return True
 
     async def handle_push_actor_task(self, spec, conn=None) -> dict:
         """Per-caller submission ordering, enforced by sequence number.
@@ -762,6 +925,16 @@ class WorkerServer:
         if fut is not None:
             return await asyncio.shield(fut)
 
+        while self._ckpt_sealed:
+            # drain-migration capture fence (see handle_checkpoint_actor):
+            # this actor's state is being captured for migration — park
+            # so the call dies UNREPLIED with this worker and is retried
+            # against the restored actor.  Cached replies above still
+            # serve (their effects are in the capture).  A failed or
+            # aborted capture sets the (persistent) unseal event,
+            # releasing the parked calls to execute normally.
+            await self._ckpt_unseal.wait()
+
         # Method / instance / concurrency-group resolution ALL happen
         # after seq admission and before the inflight future exists: an
         # error return earlier would leave the failed call's seq slot
@@ -808,6 +981,10 @@ class WorkerServer:
 
         reply_fut: asyncio.Future = asyncio.get_running_loop().create_future()
         cs["inflight"][tid] = reply_fut
+        # counted for the capture fence's quiescence wait; no await sits
+        # between the fence check above and this increment, so a sealing
+        # checkpoint either sees the call here or it parks on the fence
+        self._actor_exec_inflight += 1
         try:
             if spec.get("streaming"):
                 try:
@@ -868,6 +1045,8 @@ class WorkerServer:
             reply = self._error_reply(
                 e if isinstance(e, Exception) else RuntimeError(repr(e)), spec
             )
+        finally:
+            self._actor_exec_inflight -= 1
         cs["inflight"].pop(tid, None)
         self._cache_reply(cs, tid, reply)
         if not reply_fut.done():

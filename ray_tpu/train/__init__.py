@@ -20,3 +20,6 @@ from ray_tpu.train.session import (  # noqa: F401
     report,
 )
 from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401
+
+# MPMD pipeline-parallel training lives in ray_tpu.train.pipeline
+# (imported lazily by callers: the subpackage pulls in jax/optax).

@@ -1,0 +1,28 @@
+"""ray_tpu.train.pipeline: MPMD pipeline-parallel training.
+
+Stages of a jax model (cut by the reusable ``models.pp`` partitioner)
+run as long-lived actor gangs; a 1F1B micro-batch schedule hands
+activations/grads between them as shm objects over the batched task
+plane; stage actors carry params + optimizer state through the drain
+plane's ``__rt_checkpoint__``/``__rt_restore__`` hooks, so a preempted
+stage host costs one pipeline bubble, not a run restart (JaxPP shape,
+arxiv 2412.14374; survival story per arxiv 2510.20171).
+"""
+
+from ray_tpu.train.pipeline.driver import (  # noqa: F401
+    LocalPipelineRunner,
+    PipelineConfig,
+    PipelineTrainer,
+    init_pp_params,
+    synthetic_batches,
+)
+from ray_tpu.train.pipeline.partition import (  # noqa: F401
+    StagePrograms,
+    make_optimizer,
+)
+from ray_tpu.train.pipeline.schedule import (  # noqa: F401
+    bubble_micro_ops,
+    stage_ops,
+    submission_order,
+)
+from ray_tpu.train.pipeline.stage import PipelineStageActor  # noqa: F401

@@ -1,0 +1,185 @@
+"""Per-stage MPMD programs built from a models.pp ModelPartition.
+
+The in-program schedule (parallel/pipeline.py) compiles the whole
+fwd+bwd+update into one XLA program; here each stage gets its OWN small
+set of jitted programs so a stage can live in its own actor process
+(JaxPP's MPMD shape, arxiv 2412.14374):
+
+- first stage:  fwd(blocks, tail, tokens) -> h
+                bwd(blocks, tail, tokens, g_out) -> (g_blocks, g_tail)
+- mid stage:    fwd(blocks, h) -> h
+                bwd(blocks, h_in, g_out) -> (g_blocks, g_h_in)
+- last stage:   fwd_loss(blocks, tail, h_in, targets)
+                    -> (loss, (g_blocks, g_tail, g_h_in))
+  (forward + loss + backward-begin fused: 1F1B's last stage always runs
+  B immediately after F for a microbatch, so one program saves a
+  host round-trip and the activation stash entirely.)
+
+Backward uses activation recomputation: the stash keeps only each
+microbatch's stage INPUT; ``jax.vjp`` re-runs the stage forward inside
+the backward program.  That bounds stash memory at
+O(in_flight_micros · activation) — the 1F1B steady state — instead of
+O(layers · activation).
+
+The tied embedding/head tail is replicated on the first and last
+stages.  Each accumulates its own tail-grad contribution; at step end
+the two exchange RAW accumulated sums and both apply
+``add(first_side, last_side)`` in that canonical operand order — with
+identical optimizer math on identical inputs the two tail copies stay
+bitwise in lockstep, with no parameter traffic (grads only).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.pp import ModelPartition, get_partition  # noqa: F401
+
+Params = Any
+
+
+def make_optimizer(spec: dict):
+    """Build an optax transform from a plain-dict spec.
+
+    Declarative on purpose: every process (driver, each stage actor,
+    the local reference runner) reconstructs the SAME transform from
+    the same spec, so per-stage optimizer states — including the two
+    tail copies — evolve bitwise identically.
+    """
+    import optax
+
+    kind = spec.get("name", "sgd")
+    lr = spec.get("lr", 0.1)
+    extra = {k: v for k, v in spec.items() if k not in ("name", "lr")}
+    if kind == "sgd":
+        return optax.sgd(lr, **extra)
+    if kind == "adam":
+        return optax.adam(lr, **extra)
+    if kind == "adamw":
+        return optax.adamw(lr, **extra)
+    raise ValueError(f"unknown optimizer {kind!r} (sgd/adam/adamw)")
+
+
+def to_numpy(tree):
+    """Materialize a jax pytree as numpy for cross-process handoff
+    (bit-exact: np.asarray of a CPU jax array copies the raw buffer;
+    bf16 leaves come back as ml_dtypes.bfloat16 ndarrays)."""
+    return jax.tree.map(np.asarray, tree)
+
+
+class StagePrograms:
+    """The jitted programs for ONE pipeline stage.
+
+    Role is derived from (stage_idx, n_stages); ``scale`` is the
+    grad-normalization constant 1/(n_micro·dp) applied once at
+    ``apply`` time (per-micro losses are means, so the summed grads
+    divide by the total microbatch count across lanes).
+    """
+
+    def __init__(self, part: ModelPartition, n_stages: int, stage_idx: int,
+                 optimizer_spec: dict, scale: float):
+        if n_stages < 2:
+            raise ValueError("MPMD pipeline needs n_stages >= 2 "
+                             "(single-stage training is the plain path)")
+        if not (0 <= stage_idx < n_stages):
+            raise ValueError(f"stage_idx {stage_idx} out of range")
+        self.part = part
+        self.n_stages = n_stages
+        self.stage_idx = stage_idx
+        self.is_first = stage_idx == 0
+        self.is_last = stage_idx == n_stages - 1
+        self.optimizer = make_optimizer(optimizer_spec)
+        part_self = part
+
+        # A stage program runs in its own process with no mesh in scope:
+        # trace with sharding constraints disabled, exactly like the
+        # in-program schedule's shard_map body (tailed_pipeline_train_step)
+        from ray_tpu.parallel import sharding as sharding_mod
+
+        def sf(blocks, h):
+            with sharding_mod.no_constraints():
+                return part_self.stage_fn(blocks, h)
+
+        def pre(tail, tokens):
+            with sharding_mod.no_constraints():
+                return part_self.prelude(tail, tokens)
+
+        if self.is_first:
+            def _fwd(blocks, tail, tokens):
+                return sf(blocks, pre(tail, tokens))
+
+            def _bwd(blocks, tail, tokens, g_out):
+                _, vjp = jax.vjp(
+                    lambda b, t: sf(b, pre(t, tokens)), blocks, tail
+                )
+                return vjp(g_out)  # (g_blocks, g_tail)
+
+            self.fwd: Callable = jax.jit(_fwd)
+            self.bwd: Callable = jax.jit(_bwd)
+        elif not self.is_last:
+            def _bwd(blocks, h_in, g_out):
+                _, vjp = jax.vjp(sf, blocks, h_in)
+                return vjp(g_out)  # (g_blocks, g_h_in)
+
+            self.fwd = jax.jit(sf)
+            self.bwd = jax.jit(_bwd)
+        if self.is_last:
+            def _fwd_loss(blocks, tail, h_in, targets):
+                def f(b, t, h):
+                    with sharding_mod.no_constraints():
+                        return part_self.micro_loss(t, sf(b, h), targets)
+
+                return jax.value_and_grad(f, argnums=(0, 1, 2))(
+                    blocks, tail, h_in
+                )  # (loss, (g_blocks, g_tail, g_h_in))
+
+            self.fwd_loss = jax.jit(_fwd_loss)
+
+        self.tree_add = jax.jit(
+            lambda a, b: jax.tree.map(jnp.add, a, b)
+        )
+        s = jnp.float32(scale)
+        self.tree_scale = jax.jit(
+            lambda t: jax.tree.map(
+                lambda x: (x * s).astype(x.dtype), t
+            )
+        )
+        opt = self.optimizer
+
+        def _apply(params, opt_state, grads):
+            import optax
+
+            updates, new_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        self.apply = jax.jit(_apply)
+
+    # -- state init ------------------------------------------------------
+    def init_opt(self, params):
+        return self.optimizer.init(params)
+
+
+def flatten_grads(tree) -> np.ndarray:
+    """Deterministic leaf-order concat into one f32 vector — the wire
+    shape for the per-stage dp allreduce (one collective op per stage
+    per step instead of one per leaf)."""
+    leaves = jax.tree.leaves(tree)
+    return np.concatenate(
+        [np.asarray(x, dtype=np.float32).reshape(-1) for x in leaves]
+    )
+
+
+def unflatten_grads(tree, flat: np.ndarray):
+    """Inverse of flatten_grads against the same tree structure."""
+    leaves, treedef = jax.tree.flatten(tree)
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        seg = flat[off:off + n].reshape(leaf.shape)
+        out.append(seg.astype(np.asarray(leaf).dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)

@@ -1,0 +1,345 @@
+"""The long-lived pipeline stage actor.
+
+One process per (stage, dp-lane).  Holds the stage's parameter slice,
+optimizer state, the 1F1B activation stash, and per-step grad
+accumulators; executes forward/backward micro-ops in the queue order
+the driver enqueued (sync actors run per-caller calls in admission
+order, so the actor queue IS the 1F1B schedule for this stage).
+
+Preemption survival contract (the reason this is an actor and not a
+task): every micro-op is EXACTLY-ONCE under migration —
+
+- a per-step ledger caches each completed op's reply keyed by
+  (kind, step, micro); a call retried after a migration (lost reply,
+  or a call in flight when the node died) returns the cached value
+  without re-applying its state effects;
+- ``__rt_checkpoint__`` captures params + optimizer state + the grad
+  accumulators + the stash + the ledger, so the drain plane
+  (PR 9) migrates the stage MID-STEP with its in-flight microbatches
+  intact — the restored actor continues the step, it does not restart
+  it;
+- dp>1 stages are ranks of a util.collective group registered at
+  configure time; the drain plane's proactive reform re-forms the
+  group around the migrated member BEFORE the old node dies.
+
+Everything crossing the process boundary is numpy (bit-exact buffers);
+jit re-ingests on entry.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.train.pipeline.partition import (
+    StagePrograms,
+    flatten_grads,
+    get_partition,
+    to_numpy,
+    unflatten_grads,
+)
+
+
+@ray_tpu.remote
+class PipelineStageActor:
+    """One pipeline stage lane (rank ``lane`` of the stage's dp group)."""
+
+    def __init__(self):
+        self._spec: Optional[dict] = None
+        self._progs: Optional[StagePrograms] = None
+        self._blocks = None
+        self._tail = None
+        self._opt_blocks = None
+        self._opt_tail = None
+        self._acc_blocks = None
+        self._acc_tail = None
+        self._stash: Dict[int, Any] = {}
+        self._ledger: Dict[tuple, Any] = {}
+        self._losses: Dict[int, Dict[int, Any]] = {}
+        self._executed = 0
+        self._deduped = 0
+
+    # -- topology discovery (WorkerGroup rank assignment) ----------------
+    def node_info(self) -> dict:
+        from ray_tpu.train.worker_group import actor_node_info
+
+        return actor_node_info()
+
+    def set_env(self, env: Dict[str, str]) -> bool:
+        os.environ.update(env)
+        return True
+
+    # -- lifecycle -------------------------------------------------------
+    def configure(self, spec: dict, blocks, tail=None) -> dict:
+        """Install the stage: build programs, adopt the param slice,
+        init optimizer state, and (dp > 1) join the stage's collective
+        group under rank ``lane``.
+
+        spec keys: model, model_config, n_stages, stage_idx, n_micro,
+        dp, lane, optimizer, scale, group_name, collective_backend.
+        """
+        self._build(spec)
+        self._blocks = blocks
+        self._opt_blocks = to_numpy(self._progs.init_opt(blocks))
+        if self._progs.is_first or self._progs.is_last:
+            if tail is None:
+                raise ValueError(
+                    "first/last pipeline stages need the tail params"
+                )
+            self._tail = tail
+            self._opt_tail = to_numpy(self._progs.init_opt(tail))
+        if spec["dp"] > 1:
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(
+                spec["dp"], spec["lane"],
+                backend=spec.get("collective_backend", "rpc"),
+                group_name=spec["group_name"],
+            )
+        return {"pid": os.getpid(), "host": socket.gethostname()}
+
+    def _build(self, spec: dict) -> None:
+        part = get_partition(spec["model"], spec["model_config"])
+        self._progs = StagePrograms(
+            part, spec["n_stages"], spec["stage_idx"], spec["optimizer"],
+            spec["scale"],
+        )
+        self._spec = spec
+
+    # -- exactly-once ledger ---------------------------------------------
+    def _cached(self, key):
+        if key in self._ledger:
+            self._deduped += 1
+            return True, self._ledger[key]
+        return False, None
+
+    # -- micro-ops ---------------------------------------------------------
+    def forward(self, step: int, micro: int, payload, targets=None):
+        """First stage: payload = tokens (mb, S) int32, returns h.
+        Mid stage: payload = h from the previous stage, returns h.
+        Last stage: payload = h, targets = (mb, S); fused
+        forward+loss+backward-begin — returns the grad flowing DOWN to
+        the previous stage (the per-micro loss is kept here; the driver
+        reads the step mean once via step_loss)."""
+        key = ("F", step, micro)
+        hit, val = self._cached(key)
+        if hit:
+            return val
+        p = self._progs
+        self._executed += 1
+        if p.is_last:
+            loss, (gb, gt, gh) = p.fwd_loss(
+                self._blocks, self._tail, payload, targets
+            )
+            self._accumulate(gb, gt)
+            self._losses.setdefault(step, {})[micro] = np.float32(loss)
+            out = to_numpy(gh)
+        else:
+            if p.is_first:
+                h = p.fwd(self._blocks, self._tail, payload)
+            else:
+                h = p.fwd(self._blocks, payload)
+            self._stash[micro] = payload
+            out = to_numpy(h)
+        self._ledger[key] = out
+        return out
+
+    def backward(self, step: int, micro: int, g_out):
+        """Recompute-from-stash backward for first/mid stages; returns
+        the grad for the stage below (True on the first stage — token
+        grads stop here)."""
+        key = ("B", step, micro)
+        hit, val = self._cached(key)
+        if hit:
+            return val
+        p = self._progs
+        if p.is_last:
+            raise RuntimeError(
+                "last-stage backward is fused into forward; the driver "
+                "must not submit B ops to the last stage"
+            )
+        self._executed += 1
+        h_in = self._stash.pop(micro)
+        if p.is_first:
+            gb, gt = p.bwd(self._blocks, self._tail, h_in, g_out)
+            self._accumulate(gb, gt)
+            out = True
+        else:
+            gb, gh = p.bwd(self._blocks, h_in, g_out)
+            self._accumulate(gb, None)
+            out = to_numpy(gh)
+        self._ledger[key] = out
+        return out
+
+    def _accumulate(self, g_blocks, g_tail):
+        p = self._progs
+        self._acc_blocks = (
+            to_numpy(g_blocks) if self._acc_blocks is None
+            else to_numpy(p.tree_add(self._acc_blocks, g_blocks))
+        )
+        if g_tail is not None:
+            self._acc_tail = (
+                to_numpy(g_tail) if self._acc_tail is None
+                else to_numpy(p.tree_add(self._acc_tail, g_tail))
+            )
+
+    # -- step end ---------------------------------------------------------
+    def tail_grads(self, step: int):
+        """This side's RAW accumulated tail-grad sum (first and last
+        stages exchange these; see partition module docstring)."""
+        key = ("TG", step)
+        hit, val = self._cached(key)
+        if hit:
+            return val
+        out = to_numpy(self._acc_tail)
+        self._ledger[key] = out
+        return out
+
+    def apply_gradients(self, step: int, other_tail_grads=None) -> bool:
+        """Allreduce (dp > 1) + scale + optimizer update; clears the
+        step's accumulators and expires ledger entries of PAST steps
+        (the current step's stay — a lost apply reply must dedupe)."""
+        key = ("A", step)
+        hit, val = self._cached(key)
+        if hit:
+            return val
+        p = self._progs
+        self._executed += 1
+        g_blocks = self._acc_blocks
+        g_tail = None
+        if p.is_first or p.is_last:
+            # canonical operand order (first_side, last_side): both tail
+            # copies compute the identical sum bitwise
+            own, other = self._acc_tail, other_tail_grads
+            first_side = own if p.is_first else other
+            last_side = other if p.is_first else own
+            g_tail = to_numpy(p.tree_add(first_side, last_side))
+        if self._spec["dp"] > 1:
+            g_blocks, g_tail = self._allreduce(g_blocks, g_tail)
+        g_blocks = p.tree_scale(g_blocks)
+        self._blocks, self._opt_blocks = map(to_numpy, p.apply(
+            self._blocks, self._opt_blocks, g_blocks
+        ))
+        if g_tail is not None:
+            g_tail = p.tree_scale(g_tail)
+            self._tail, self._opt_tail = map(to_numpy, p.apply(
+                self._tail, self._opt_tail, g_tail
+            ))
+        self._acc_blocks = None
+        self._acc_tail = None
+        self._stash.clear()
+        self._ledger = {
+            k: v for k, v in self._ledger.items() if k[1] >= step
+        }
+        self._losses = {s: v for s, v in self._losses.items() if s >= step}
+        self._ledger[key] = True
+        return True
+
+    def _allreduce(self, g_blocks, g_tail):
+        """Grad allreduce over the stage group, riding out a migration
+        window: between a peer's old worker dying and the proactive
+        reform completing, the group is transiently poisoned (or
+        mid-reform, i.e. locally uninitialized).  The op mutates no
+        actor state, so retrying against the re-formed group is exact —
+        both sides re-enter with their checkpoint-intact accumulators.
+        A peer that is REALLY gone keeps the group poisoned past the
+        budget and the error surfaces as before."""
+        import time as _time
+
+        from ray_tpu.common.config import cfg
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective.types import CollectiveError
+
+        group = self._spec["group_name"]
+        deadline = _time.monotonic() + float(
+            self._spec.get("allreduce_retry_timeout_s")
+            or cfg.collective_rendezvous_timeout_s
+        )
+        # ONE op per apply: blocks (and tail, when this stage holds one)
+        # concatenated into a single f32 vector — one ring pass, and no
+        # partially-reduced multi-op state to reason about under retry
+        flat_b = flatten_grads(g_blocks)
+        if g_tail is not None:
+            flat = np.concatenate([flat_b, flatten_grads(g_tail)])
+        else:
+            flat = flat_b
+        while True:
+            try:
+                summed = col.allreduce(flat, group_name=group)
+                break
+            except CollectiveError:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.5)
+        out_blocks = unflatten_grads(g_blocks, summed[:flat_b.size])
+        out_tail = (
+            unflatten_grads(g_tail, summed[flat_b.size:])
+            if g_tail is not None else None
+        )
+        return out_blocks, out_tail
+
+    def step_loss(self, step: int) -> float:
+        """Mean per-micro loss of this lane for ``step`` (last stage)."""
+        per = self._losses.get(step)
+        if per is None:
+            raise RuntimeError(f"no losses recorded for step {step}")
+        vals = np.array(
+            [per[m] for m in sorted(per)], dtype=np.float32
+        )
+        return float(np.float32(vals.sum() / np.float32(len(vals))))
+
+    # -- introspection ----------------------------------------------------
+    def get_params(self):
+        return {"blocks": self._blocks, "tail": self._tail}
+
+    def group_rank(self):
+        from ray_tpu.util import collective as col
+
+        return col.get_rank(self._spec["group_name"])
+
+    def counters(self) -> dict:
+        from ray_tpu.common import serialization as ser
+        from ray_tpu.core.runtime import get_runtime
+
+        return {
+            "pid": os.getpid(),
+            "executed": self._executed,
+            "deduped": self._deduped,
+            "copy_trace": dict(ser.COPY_TRACE),
+            "slab_hits": get_runtime().store.stats().get("slab_hits", 0),
+        }
+
+    # -- migration hooks (PR 9 drain plane) -------------------------------
+    def __rt_checkpoint__(self):
+        return {
+            "spec": self._spec,
+            "blocks": self._blocks,
+            "tail": self._tail,
+            "opt_blocks": self._opt_blocks,
+            "opt_tail": self._opt_tail,
+            "acc_blocks": self._acc_blocks,
+            "acc_tail": self._acc_tail,
+            "stash": dict(self._stash),
+            "ledger": dict(self._ledger),
+            "losses": {s: dict(v) for s, v in self._losses.items()},
+            "executed": self._executed,
+            "deduped": self._deduped,
+        }
+
+    def __rt_restore__(self, state):
+        self._build(state["spec"])
+        self._blocks = state["blocks"]
+        self._tail = state["tail"]
+        self._opt_blocks = state["opt_blocks"]
+        self._opt_tail = state["opt_tail"]
+        self._acc_blocks = state["acc_blocks"]
+        self._acc_tail = state["acc_tail"]
+        self._stash = state["stash"]
+        self._ledger = state["ledger"]
+        self._losses = state["losses"]
+        self._executed = state["executed"]
+        self._deduped = state["deduped"]

@@ -26,6 +26,24 @@ from ray_tpu.train.session import (
 from ray_tpu.util import PlacementGroupSchedulingStrategy, placement_group
 
 
+def actor_node_info() -> dict:
+    """Topology facts WorkerGroup needs from any gang actor class —
+    shared by TrainWorkerActor and the pipeline stage actors."""
+    from ray_tpu.core.runtime import get_runtime
+
+    ctx = ray_tpu.get_runtime_context()
+    # the raylet address host is this node's reachable IP (loopback in
+    # single-host tests, the real interface on a pod)
+    ip = get_runtime().raylet_address.rsplit(":", 1)[0]
+    return {
+        "node_id": ctx.node_id,
+        "hostname": socket.gethostname(),
+        "ip": ip,
+        "pid": os.getpid(),
+        "tpu_chips": os.environ.get("TPU_VISIBLE_CHIPS", ""),
+    }
+
+
 @ray_tpu.remote
 class TrainWorkerActor:
     """One training worker process (ray: RayTrainWorker analogue)."""
@@ -36,19 +54,7 @@ class TrainWorkerActor:
 
     # -- topology discovery ---------------------------------------------
     def node_info(self) -> dict:
-        from ray_tpu.core.runtime import get_runtime
-
-        ctx = ray_tpu.get_runtime_context()
-        # the raylet address host is this node's reachable IP (loopback in
-        # single-host tests, the real interface on a pod)
-        ip = get_runtime().raylet_address.rsplit(":", 1)[0]
-        return {
-            "node_id": ctx.node_id,
-            "hostname": socket.gethostname(),
-            "ip": ip,
-            "pid": os.getpid(),
-            "tpu_chips": os.environ.get("TPU_VISIBLE_CHIPS", ""),
-        }
+        return actor_node_info()
 
     def set_env(self, env: Dict[str, str]) -> bool:
         os.environ.update(env)
@@ -122,15 +128,26 @@ class WorkerMeta:
 
 
 class WorkerGroup:
-    """N TrainWorkerActor handles gang-placed via one placement group."""
+    """N gang actors placed atomically via one placement group.
+
+    ``actor_cls`` defaults to TrainWorkerActor (the data-parallel train
+    path); the MPMD pipeline passes its stage actor class — any
+    ``@ray_tpu.remote`` class exposing ``node_info()`` rides the same
+    reservation + rank-assignment machinery.  ``actor_options`` merges
+    into each actor's ``.options()`` (max_restarts, max_task_retries,
+    on_drain, ...).
+    """
 
     def __init__(
         self,
         num_workers: int,
         bundle: Dict[str, float],
         placement_strategy: str = "PACK",
+        actor_cls=None,
+        actor_options: Optional[Dict[str, Any]] = None,
     ):
         self.num_workers = num_workers
+        self._actor_cls = actor_cls if actor_cls is not None else TrainWorkerActor
         self._pg = placement_group(
             [dict(bundle) for _ in range(num_workers)],
             strategy=placement_strategy,
@@ -147,17 +164,20 @@ class WorkerGroup:
         # chip visibility (TPU_VISIBLE_CHIPS) from lease resources, so the
         # worker process must own its chips through its own demand.
         extra = {k: v for k, v in bundle.items() if k != "CPU"}
-        actors = [
-            TrainWorkerActor.options(
-                num_cpus=bundle.get("CPU", 0),
-                resources=extra or None,
-                scheduling_strategy=PlacementGroupSchedulingStrategy(
+        actors = []
+        for i in range(num_workers):
+            opts = {
+                "num_cpus": bundle.get("CPU", 0),
+                "resources": extra or None,
+                "scheduling_strategy": PlacementGroupSchedulingStrategy(
                     placement_group=self._pg,
                     placement_group_bundle_index=i,
                 ),
-            ).remote()
-            for i in range(num_workers)
-        ]
+            }
+            # merge, not collide: an explicit actor_options key (e.g.
+            # num_cpus) overrides the bundle-derived default
+            opts.update(actor_options or {})
+            actors.append(self._actor_cls.options(**opts).remote())
         # No wall-clock bound: actor startup length is unbounded under load
         # and liveness is tracked by the core (a dead worker surfaces as
         # ActorDiedError on this get).

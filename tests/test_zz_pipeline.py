@@ -1,0 +1,667 @@
+"""MPMD pipeline-parallel training (train.pipeline).
+
+What the subsystem must hold:
+
+- the 1F1B schedule math (per-stage op order, dependency-safe global
+  submission order, the bubble bound),
+- the models.pp partitioner refactor: per-stage composition of
+  prelude/stage_fn/loss_tail equals the monolithic model,
+- END-TO-END BIT-EXACTNESS: a ≥3-stage GPT-2 pipeline over stage actor
+  gangs trains to bitwise loss/param parity with the single-gang
+  reference (same partition, one process) at equal global batch — the
+  distributed handoff may not perturb one bit,
+- dp>1 stages allreduce grads through their util.collective group and
+  stay bitwise equal to the lane-summed reference,
+- copy discipline on the handoff plane: sub-16 KiB activations ride
+  the inline slab, large ones are worker-stored by ONE vectored write
+  with payload bytes copied exactly once (serialization.COPY_TRACE),
+- actor checkpoint blobs above the size threshold ride the shm/object
+  plane (not inline GCS KV) and are freed after restore; small blobs
+  keep the inline path,
+- THE ACCEPTANCE SCENARIO: a seeded ChaosController.preempt_node
+  against a middle-stage host mid-run completes with zero
+  driver-visible failures, stage state (params + optimizer) intact
+  after migration, the stage's collective group proactively re-formed,
+  micro-batches lost ≤ one pipeline bubble, zero lineage
+  re-executions — and the loss trajectory BITWISE EQUAL to the
+  undisturbed reference, all reproducible from the chaos seed.
+
+Named ``test_zz_*`` so the file sorts past the tier-1 870 s truncation
+window (it spins multi-process clusters and compiles jax programs; see
+ROADMAP).  The randomized multi-preemption soak is ``slow``-marked
+(registered in tests/conftest.py).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.common import faults
+from ray_tpu.common.faults import ChaosController
+from ray_tpu.core.runtime import get_runtime
+from ray_tpu.models import gpt2
+from ray_tpu.train.pipeline import (
+    LocalPipelineRunner,
+    PipelineConfig,
+    PipelineTrainer,
+    bubble_micro_ops,
+    stage_ops,
+    submission_order,
+    synthetic_batches,
+)
+from ray_tpu.train.pipeline.schedule import op_dep
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+    os.environ.pop("RT_FAULTS", None)
+
+
+def _drain_status(node_id_hex: str) -> dict:
+    rt = get_runtime()
+    return rt._run(
+        rt.gcs.call("get_drain_status", {"node_id": node_id_hex})
+    )
+
+
+def _list_actor(actor_id_hex: str) -> dict:
+    rt = get_runtime()
+    rows = rt._run(rt.gcs.call("list_actors", {}))
+    for r in rows:
+        if r["actor_id"] == actor_id_hex:
+            return r
+    raise AssertionError(f"actor {actor_id_hex} not in list_actors")
+
+
+def _tree_equal(a, b) -> bool:
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule math (pure; no cluster)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedule:
+    def test_stage_ops_1f1b_shape(self):
+        # last stage: all fused forwards, no B ops
+        assert stage_ops(2, 3, 4) == [("F", m) for m in range(4)]
+        # middle: 1 warmup F, steady F/B, drain B
+        assert stage_ops(1, 3, 4) == [
+            ("F", 0), ("F", 1), ("B", 0), ("F", 2), ("B", 1),
+            ("F", 3), ("B", 2), ("B", 3),
+        ]
+        # first: 2 warmup Fs
+        assert stage_ops(0, 3, 4)[:3] == [("F", 0), ("F", 1), ("F", 2)]
+        for s, S, M in [(0, 2, 1), (0, 4, 2), (2, 4, 8), (0, 3, 16)]:
+            ops = stage_ops(s, S, M)
+            assert [m for k, m in ops if k == "F"] == list(range(M))
+            assert [m for k, m in ops if k == "B"] == list(range(M))
+
+    def test_submission_order_respects_deps_and_stage_order(self):
+        for S, M in [(2, 1), (2, 4), (3, 4), (4, 8), (5, 3)]:
+            order = submission_order(S, M)
+            seen = set()
+            per_stage = {s: [] for s in range(S)}
+            for s, kind, m in order:
+                dep = op_dep(s, kind, m, S)
+                assert dep is None or dep in seen, (S, M, s, kind, m)
+                seen.add((s, kind, m))
+                per_stage[s].append((kind, m))
+            for s in range(S):
+                assert per_stage[s] == stage_ops(s, S, M), (S, M, s)
+
+    def test_bubble(self):
+        assert bubble_micro_ops(3) == 4
+        assert bubble_micro_ops(5) == 8
+
+
+# ---------------------------------------------------------------------------
+# The shared partitioner (models/pp.py refactor; no cluster)
+# ---------------------------------------------------------------------------
+
+
+class TestPartitioner:
+    def test_stagewise_composition_matches_monolithic(self):
+        """prelude → stage_fn per slice → loss_tail over the partition's
+        own cut equals the monolithic gpt2.loss_fn on the same batch."""
+        import jax
+
+        from ray_tpu.models.pp import gpt2_partition
+        from ray_tpu.parallel import sharding as sm
+
+        cfg = gpt2.GPTConfig.tiny(num_layers=4, max_seq_len=32)
+        part = gpt2_partition(cfg)
+        params = gpt2.init(jax.random.key(1), cfg)
+        rng = np.random.default_rng(3)
+        toks = rng.integers(0, cfg.vocab_size, (2, 33), dtype=np.int32)
+        x, y = toks[:, :-1], toks[:, 1:]
+        with sm.no_constraints():
+            mono = float(gpt2.loss_fn(
+                params, {"inputs": x, "targets": y}, cfg
+            ))
+            pp = part.to_pp(params, 4)
+            h = part.prelude(pp["tail"], x)
+            for s in range(4):
+                h = part.stage_fn(
+                    jax.tree.map(lambda a, _s=s: a[_s], pp["stages"]), h
+                )
+            staged = float(part.micro_loss(pp["tail"], h, y))
+        assert np.isclose(staged, mono, rtol=1e-5), (staged, mono)
+
+    def test_cut_roundtrip_bitwise(self):
+        import jax
+
+        from ray_tpu.models.pp import gpt2_from_pp, gpt2_to_pp
+
+        cfg = gpt2.GPTConfig.tiny(num_layers=4)
+        params = gpt2.init(jax.random.key(0), cfg)
+        back = gpt2_from_pp(gpt2_to_pp(params, 2))
+        assert _tree_equal(params, back)
+
+    def test_unknown_family_rejected(self):
+        from ray_tpu.models.pp import get_partition
+
+        with pytest.raises(ValueError, match="unknown pipeline model"):
+            get_partition("resnet", None)
+
+
+# ---------------------------------------------------------------------------
+# Bit-exact parity: 3-stage pipeline over actor gangs vs single gang
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineParity:
+    def test_three_stage_gpt2_bitwise_vs_single_gang(self):
+        """The acceptance parity half: a 3-stage GPT-2 pipeline (stage
+        gangs via the WorkerGroup placement-group path) trains to
+        BITWISE loss and parameter parity with the single-gang
+        reference at equal global batch, and the first-step loss
+        matches the monolithic model numerically."""
+        cfg = gpt2.GPTConfig.tiny(num_layers=3, max_seq_len=32)
+        pc = PipelineConfig(
+            model_config=cfg, n_stages=3, n_micro=4, micro_batch=2,
+            seq_len=32, optimizer={"name": "adam", "lr": 1e-3},
+            name="parity3",
+        )
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        try:
+            tr = PipelineTrainer(pc, bundle={"CPU": 1})
+            tr.start()
+            batches = synthetic_batches(pc, 3)
+            losses = tr.train(batches)
+            ref = LocalPipelineRunner(pc)
+            assert losses == ref.train(batches), (
+                "pipeline loss trajectory diverged from the single-gang "
+                "reference"
+            )
+            assert _tree_equal(tr.gather_params(), ref.gather_params()), (
+                "post-training params diverged"
+            )
+            # sanity vs the monolithic model (same math, different
+            # reduction tree: numerical, not bitwise)
+            import jax
+
+            from ray_tpu.parallel import sharding as sm
+
+            params = gpt2.init(jax.random.key(pc.seed), cfg)
+            x, y = batches[0]
+            with sm.no_constraints():
+                mono = float(gpt2.loss_fn(
+                    params,
+                    {"inputs": x.reshape(-1, 32),
+                     "targets": y.reshape(-1, 32)},
+                    cfg,
+                ))
+            assert np.isclose(losses[0], mono, rtol=1e-4), (losses[0], mono)
+            tr.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+    def test_dp2_stage_groups_bitwise(self):
+        """dp=2 lanes per stage: block/tail grads allreduce through the
+        per-stage collective group, and the run stays bitwise equal to
+        the lane-summed local reference (2-rank ring sums are exact)."""
+        cfg = gpt2.GPTConfig.tiny(num_layers=2, max_seq_len=32)
+        pc = PipelineConfig(
+            model_config=cfg, n_stages=2, n_micro=3, micro_batch=4,
+            dp=2, seq_len=32, optimizer={"name": "sgd", "lr": 0.1},
+            name="dp2",
+        )
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        try:
+            tr = PipelineTrainer(pc, bundle={"CPU": 1})
+            tr.start()
+            batches = synthetic_batches(pc, 2)
+            losses = tr.train(batches)
+            ref = LocalPipelineRunner(pc)
+            assert losses == ref.train(batches)
+            ranks = ray_tpu.get(
+                [tr.actors[0][r].group_rank.remote() for r in range(2)],
+                timeout=60,
+            )
+            assert ranks == [0, 1]
+            tr.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Copy discipline on the handoff plane (COPY_TRACE / inline slab)
+# ---------------------------------------------------------------------------
+
+
+class TestHandoffCopyDiscipline:
+    def test_small_activations_ride_inline_slab(self):
+        """Sub-16 KiB activations: the actor reply is inline; passing
+        the ref to the next stage promotes it through the driver's put
+        path, which must land in the pre-registered inline slab (store
+        slab_hits), not the evicting create path."""
+        cfg = gpt2.GPTConfig.tiny(num_layers=2, max_seq_len=32)
+        pc = PipelineConfig(
+            model_config=cfg, n_stages=2, n_micro=4, micro_batch=2,
+            seq_len=32, name="slabrun",
+        )
+        # bf16 activation: 2 rows x 32 seq x 64 embed x 2 B = 8 KiB
+        act_bytes = 2 * 32 * 64 * 2
+        assert act_bytes < 16 * 1024
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        try:
+            tr = PipelineTrainer(pc, bundle={"CPU": 1})
+            tr.start()
+            batches = synthetic_batches(pc, 2)
+            tr.run_step(*batches[0])  # warm: compiles + first promotions
+            store = get_runtime().store
+            hits0 = store.stats()["slab_hits"]
+            tr.run_step(*batches[1])
+            hits1 = store.stats()["slab_hits"]
+            assert hits1 - hits0 >= pc.n_micro, (
+                f"expected ≥{pc.n_micro} slab publishes for the "
+                f"{act_bytes}-byte activation handoffs, saw "
+                f"{hits1 - hits0}"
+            )
+            tr.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+    def test_large_activations_single_copy_vectored(self):
+        """Above-inline activations are worker-stored: the producing
+        stage's COPY_TRACE must show exactly one vectored write per
+        stored object and each payload byte copied exactly once — and
+        the driver copies NOTHING (refs only pass through)."""
+        cfg = gpt2.GPTConfig.tiny(
+            num_layers=2, max_seq_len=64, embed_dim=256,
+        )
+        pc = PipelineConfig(
+            model_config=cfg, n_stages=2, n_micro=4, micro_batch=4,
+            seq_len=64, name="bigact",
+        )
+        act_bytes = 4 * 64 * 256 * 2  # bf16: 128 KiB > inline cap
+        from ray_tpu.common.config import cfg as rtcfg
+
+        assert act_bytes > rtcfg.inline_object_max_bytes
+        # first-stage tail grads: one stored object per step (zeros for
+        # the unused lnf leaves still serialize as payload bytes)
+        tail_bytes = (
+            cfg.vocab_size * cfg.embed_dim * 4      # wte
+            + cfg.max_seq_len * cfg.embed_dim * 4   # wpe
+            + 2 * cfg.embed_dim * 4                 # lnf scale+bias
+        )
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        try:
+            tr = PipelineTrainer(pc, bundle={"CPU": 1})
+            tr.start()
+            batches = synthetic_batches(pc, 2)
+            tr.run_step(*batches[0])
+            from ray_tpu.common import serialization as ser
+
+            c0 = ray_tpu.get(
+                tr.actors[0][0].counters.remote(), timeout=120
+            )["copy_trace"]
+            d0 = dict(ser.COPY_TRACE)
+            tr.run_step(*batches[1])
+            c1 = ray_tpu.get(
+                tr.actors[0][0].counters.remote(), timeout=120
+            )["copy_trace"]
+            d1 = dict(ser.COPY_TRACE)
+            writes = c1["writes"] - c0["writes"]
+            payload = c1["payload_bytes"] - c0["payload_bytes"]
+            # COPY_TRACE counts every write_into — the 5 stored objects
+            # (M activations + tail grads) PLUS the payload-free inline
+            # wire replies (B×4 → True, apply → True, the previous
+            # counters() reply).  The single-copy invariant is the
+            # PAYLOAD ledger: each stored byte crosses write_into
+            # exactly once, nothing else contributes payload.
+            expected_payload = pc.n_micro * act_bytes + tail_bytes
+            assert writes >= pc.n_micro + 1, writes
+            assert payload == expected_payload, (
+                f"stage-0 worker copied {payload} payload bytes for "
+                f"{expected_payload} bytes of stored results — a byte "
+                f"was copied more than once (or the bf16 out-of-band "
+                f"path regressed to an in-meta copy)"
+            )
+            # the driver never touches activation payloads (token args
+            # ride the rpc frame path, not the store's write_into):
+            # zero payload bytes cross the driver's serializer
+            assert d1["payload_bytes"] == d0["payload_bytes"], (
+                "an activation payload leaked through the driver"
+            )
+            tr.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint blobs: object plane above the threshold, inline below
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class BigStateActor:
+    def __init__(self):
+        self.arr = None
+
+    def fill(self, n):
+        self.arr = np.arange(n, dtype=np.int64)
+        return True
+
+    def total(self):
+        return int(self.arr.sum())
+
+    def pid(self):
+        return os.getpid()
+
+    def __rt_checkpoint__(self):
+        return {"arr": self.arr}
+
+    def __rt_restore__(self, state):
+        self.arr = state["arr"]
+
+
+@ray_tpu.remote
+class SmallStateActor:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def __rt_checkpoint__(self):
+        return {"n": self.n}
+
+    def __rt_restore__(self, state):
+        self.n = state["n"]
+
+
+class TestCheckpointBlobPlane:
+    def test_big_blob_rides_object_plane_small_stays_inline(self):
+        """One drain, two checkpointable actors: the 4 MB state blob
+        must route through the shm/object plane (exactly one blob
+        object in drain status), the tiny one stays on the inline KV
+        path — and both migrate with state intact, with the blob object
+        freed (KV record gone) after the restore."""
+        os.environ["RT_ACTOR_CKPT_INLINE_MAX_BYTES"] = "20000"
+        cluster = Cluster(initialize_head=True, connect=True,
+                          head_node_args={"num_cpus": 2})
+        try:
+            victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            big = BigStateActor.options(
+                num_cpus=0, resources={"pre": 0.3}, max_restarts=0
+            ).remote()
+            small = SmallStateActor.options(
+                num_cpus=0, resources={"pre": 0.3}, max_restarts=0
+            ).remote()
+            ray_tpu.get(big.fill.remote(500_000), timeout=120)
+            expect = ray_tpu.get(big.total.remote(), timeout=60)
+            pid0 = ray_tpu.get(big.pid.remote(), timeout=60)
+            for _ in range(3):
+                ray_tpu.get(small.inc.remote(), timeout=60)
+
+            cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            chaos = ChaosController(cluster, seed=17)
+            _, state = chaos.preempt_node(node=victim, deadline_s=20.0)
+            assert state == "drained", state
+
+            st = _drain_status(victim.node_id)
+            assert st["ckpt_blob_objects"] == 1, st
+            assert st["actors_moved"] == 2, st
+            assert ray_tpu.get(big.total.remote(), timeout=120) == expect
+            assert ray_tpu.get(big.pid.remote(), timeout=60) != pid0
+            assert ray_tpu.get(small.value.remote(), timeout=120) == 3
+            for a in (big, small):
+                row = _list_actor(a._actor_id.hex())
+                assert row["restarts_used"] == 0 and row["state"] == "ALIVE"
+            # blob retired after restore: KV record gone (a leaked blob
+            # would pin protected arena space forever)
+            rt = get_runtime()
+            kv = rt._run(rt.gcs.call(
+                "kv_get",
+                {"key": f"__rt_actor_ckpt:{big._actor_id.hex()}"},
+            ))
+            assert kv is None
+        finally:
+            os.environ.pop("RT_ACTOR_CKPT_INLINE_MAX_BYTES", None)
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: seeded mid-run stage-host preemption
+# ---------------------------------------------------------------------------
+
+
+def _preemption_run(steps: int, seed: int, preempt_after_step: int = 2,
+                    deadline_s: float = 20.0):
+    """3-stage GPT-2 pipeline, dp=2 (every stage is a 2-rank collective
+    group), middle stage's lane 1 on a preemptible node.  Runs the full
+    schedule with a seeded preemption mid-run; returns everything the
+    assertions need."""
+    cfg = gpt2.GPTConfig.tiny(num_layers=3, max_seq_len=32)
+    pc = PipelineConfig(
+        model_config=cfg, n_stages=3, n_micro=4, micro_batch=4, dp=2,
+        seq_len=32, optimizer={"name": "adam", "lr": 1e-3},
+        name=f"accept{seed}",
+    )
+    cluster = Cluster(
+        initialize_head=True, connect=True,
+        head_node_args={"num_cpus": 4, "resources": {"h": 8.0}},
+    )
+    try:
+        victim = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+        cluster.wait_for_nodes(timeout=60)
+        h = {"num_cpus": 0, "resources": {"h": 0.5}}
+        v = {"num_cpus": 0, "resources": {"pre": 0.4}}
+        opts = [[dict(h), dict(h)], [dict(h), dict(v)],
+                [dict(h), dict(h)]]
+        tr = PipelineTrainer(pc, stage_actor_options=opts)
+        tr.start()
+        batches = synthetic_batches(pc, steps)
+        losses: list = []
+        errs: list = []
+        reached = threading.Event()
+
+        def loop():
+            try:
+                for i, (x, y) in enumerate(batches):
+                    losses.append(tr.run_step(x, y))
+                    if i == preempt_after_step - 1:
+                        reached.set()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+                reached.set()
+
+        th = threading.Thread(target=loop, daemon=True)
+        th.start()
+        assert reached.wait(timeout=300), "never reached the preempt step"
+        assert not errs, errs
+
+        cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+        cluster.wait_for_nodes(timeout=60)
+        chaos = ChaosController(cluster, seed=seed)
+        _, state = chaos.preempt_node(node=victim, deadline_s=deadline_s)
+        th.join(timeout=600)
+        assert not th.is_alive(), "training wedged after the preemption"
+        assert not errs, f"driver-visible failure: {errs!r}"
+
+        counters = tr.counters()
+        executed = sum(
+            c["executed"] for lanes in counters for c in lanes
+        )
+        ranks = ray_tpu.get(
+            [tr.actors[1][r].group_rank.remote() for r in range(2)],
+            timeout=120,
+        )
+        moved_row = _list_actor(tr.actors[1][1]._actor_id.hex())
+        result = {
+            "pc": pc,
+            "losses": losses,
+            "drain_state": state,
+            "executed": executed,
+            "ideal": tr.ideal_micro_ops(steps),
+            "ranks": ranks,
+            "moved_row": moved_row,
+            "reconstructions": get_runtime().reconstructions,
+            "drain_status": _drain_status(victim.node_id),
+            "chaos_log": [e["event"] for e in chaos.log],
+        }
+        tr.shutdown()
+        return result
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+class TestPreemptionAcceptance:
+    def test_seeded_mid_run_preemption_costs_at_most_one_bubble(self):
+        r = _preemption_run(steps=8, seed=2026)
+        pc = r["pc"]
+        # the drain completed inside the announced deadline
+        assert r["drain_state"] == "drained", (
+            r["drain_state"], r["drain_status"],
+        )
+        # zero driver-visible failures is asserted inside the run;
+        # the full loss trajectory is BITWISE what the undisturbed
+        # single-gang reference computes — params + optimizer state
+        # survived the migration to the bit and no microbatch was
+        # dropped or double-applied
+        ref = LocalPipelineRunner(pc)
+        assert r["losses"] == ref.train(synthetic_batches(pc, 8)), (
+            "loss trajectory diverged after the preemption"
+        )
+        # work lost ≤ one pipeline bubble: re-executed micro-ops are
+        # the calls killed mid-flight (ledger-deduped replies cost 0)
+        dups = r["executed"] - r["ideal"]
+        assert 0 <= dups <= bubble_micro_ops(pc.n_stages), (
+            f"{dups} duplicate micro-ops > one bubble "
+            f"({bubble_micro_ops(pc.n_stages)})"
+        )
+        # zero lineage re-executions: activations on the dead node were
+        # evacuated, never recomputed
+        assert r["reconstructions"] == 0
+        # the migrated lane kept its rank in the proactively re-formed
+        # group, consumed no restart budget, and the drain moved it
+        assert r["ranks"] == [0, 1]
+        assert r["moved_row"]["restarts_used"] == 0
+        assert r["moved_row"]["state"] == "ALIVE"
+        assert r["drain_status"]["actors_moved"] >= 1
+        # the chaos schedule replays from its log
+        assert r["chaos_log"] == ["node_preempt", "node_kill"]
+
+    def test_preemption_is_seed_reproducible(self):
+        """Same seed, fresh cluster: the run completes with the same
+        drain verdict and the same bitwise loss trajectory (the chaos
+        clock is the only wall-clock in the scenario; state handoff is
+        exact, so the trajectory cannot wobble)."""
+        a = _preemption_run(steps=6, seed=777)
+        b = _preemption_run(steps=6, seed=777)
+        assert a["drain_state"] == b["drain_state"] == "drained"
+        assert a["losses"] == b["losses"]
+        assert a["chaos_log"] == b["chaos_log"]
+
+
+@pytest.mark.slow
+class TestPreemptionSoak:
+    def test_two_sequential_stage_host_preemptions(self):
+        """Longer run, two different middle-stage hosts preempted one
+        after the other (the second lane lands on the first spare and
+        is then preempted itself) — the pipeline must survive both and
+        stay bitwise-correct."""
+        cfg = gpt2.GPTConfig.tiny(num_layers=3, max_seq_len=32)
+        pc = PipelineConfig(
+            model_config=cfg, n_stages=3, n_micro=4, micro_batch=4,
+            dp=2, seq_len=32, optimizer={"name": "adam", "lr": 1e-3},
+            name="soak",
+        )
+        cluster = Cluster(
+            initialize_head=True, connect=True,
+            head_node_args={"num_cpus": 4, "resources": {"h": 8.0}},
+        )
+        try:
+            victim1 = cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            h = {"num_cpus": 0, "resources": {"h": 0.5}}
+            v = {"num_cpus": 0, "resources": {"pre": 0.4}}
+            opts = [[dict(h), dict(h)], [dict(h), dict(v)],
+                    [dict(h), dict(h)]]
+            tr = PipelineTrainer(pc, stage_actor_options=opts)
+            tr.start()
+            steps = 12
+            batches = synthetic_batches(pc, steps)
+            losses: list = []
+            errs: list = []
+            progress = threading.Event()
+
+            def loop():
+                try:
+                    for i, (x, y) in enumerate(batches):
+                        losses.append(tr.run_step(x, y))
+                        if i == 1:
+                            progress.set()
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+                    progress.set()
+
+            th = threading.Thread(target=loop, daemon=True)
+            th.start()
+            assert progress.wait(timeout=300) and not errs, errs
+            victim2 = cluster.add_node(num_cpus=1,
+                                       resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            chaos = ChaosController(cluster, seed=31337)
+            _, s1 = chaos.preempt_node(node=victim1, deadline_s=30.0)
+            assert s1 == "drained", s1
+            # the migrated lane now lives on victim2: preempt that too
+            cluster.add_node(num_cpus=1, resources={"pre": 1.0})
+            cluster.wait_for_nodes(timeout=60)
+            _, s2 = chaos.preempt_node(node=victim2, deadline_s=30.0)
+            assert s2 == "drained", s2
+            th.join(timeout=900)
+            assert not th.is_alive() and not errs, errs
+            ref = LocalPipelineRunner(pc)
+            assert losses == ref.train(batches)
+            assert get_runtime().reconstructions == 0
+            assert [e["event"] for e in chaos.log] == [
+                "node_preempt", "node_kill",
+            ] * 2
+            tr.shutdown()
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
