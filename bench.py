@@ -745,6 +745,147 @@ def bench_fault_recovery(ray_tpu):
             "collective_err": collective_err}
 
 
+def bench_collective_matrix():
+    """Collectives v2 matrix: message size x algorithm x wire dtype
+    over a TWO-NODE cluster (ranks 0/1 on the head, 2/3 on the second
+    node — ring hops 1→2 and 3→0 cross the wire), plus an overlap row.
+
+    Large rows report bus bandwidth ``2·(n-1)/n · tensor_bytes / wall``
+    (the standard allreduce normalization, comparable across wire
+    dtypes because the NUMERATOR stays the logical fp32 bytes — a
+    quantized path that moves fewer wire bytes in the same time shows
+    up as higher busbw).  Small rows report per-op latency.  The
+    overlap rows time launch+compute+wait vs blocking-op-then-compute
+    at equal compute, so their difference is the EXPOSED comm time.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    @ray_tpu.remote
+    class _Rank:
+        def init(self, world, rank, group):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, group_name=group)
+            return True
+
+        def timed_allreduce(self, n_elems, reps, group, wire, alg):
+            from ray_tpu.util import collective as col
+
+            x = ((np.arange(n_elems) % 1024).astype(np.float32)) / 7.0
+            col.allreduce(x, group_name=group, wire_dtype=wire,
+                          algorithm=alg)  # warm conns + codec
+            col.barrier(group_name=group)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                col.allreduce(x, group_name=group, wire_dtype=wire,
+                              algorithm=alg)
+            return (time.perf_counter() - t0) / reps
+
+        def overlap_run(self, n_elems, compute_s, group, wire, mode):
+            from ray_tpu.util import collective as col
+
+            x = (np.arange(n_elems, dtype=np.float32)) / 3.0
+
+            def spin(budget):
+                z = np.ones(8192, np.float64)
+                end = time.perf_counter() + budget
+                while time.perf_counter() < end:
+                    z = np.sqrt(z + 1.0)
+
+            col.barrier(group_name=group)
+            t0 = time.perf_counter()
+            if mode == "overlap":
+                w = col.allreduce_launch(x, group_name=group,
+                                         wire_dtype=wire)
+                spin(compute_s)
+                w.wait(timeout=120)
+            else:
+                col.allreduce(x, group_name=group, wire_dtype=wire)
+                spin(compute_s)
+            return time.perf_counter() - t0
+
+    rows = {}
+    cluster = Cluster(initialize_head=True, connect=True,
+                      head_node_args={"num_cpus": 4})
+    try:
+        second = cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes(timeout=60)
+        placement = [
+            cluster.head_node.node_id, cluster.head_node.node_id,
+            second.node_id, second.node_id,
+        ]
+        members = [
+            _Rank.options(
+                num_cpus=0,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=nid, soft=False
+                ),
+            ).remote()
+            for nid in placement
+        ]
+        n = len(members)
+        group = "bench-cb4"
+        ray_tpu.get(
+            [m.init.remote(n, i, group) for i, m in enumerate(members)],
+            timeout=120,
+        )
+
+        def run(n_elems, reps, wire, alg):
+            ts = ray_tpu.get(
+                [
+                    m.timed_allreduce.remote(n_elems, reps, group, wire, alg)
+                    for m in members
+                ],
+                timeout=600,
+            )
+            return max(ts)  # the group is as slow as its slowest rank
+
+        # large: bandwidth regime (16 MB tensor), ring only
+        big = 1 << 22  # f32 elems = 16 MiB
+        logical = 2 * (n - 1) / n * big * 4
+        for wire in ("fp32", "int8", "bf16"):
+            t = run(big, 3, wire, "ring")
+            rows[f"collective_16mb_ring_{wire}_gbps"] = logical / t / 1e9
+        # small: latency regime (64 KB tensor), ring vs rd, fp32 + int8
+        small = 16384
+        for alg in ("ring", "rd"):
+            for wire in ("fp32", "int8"):
+                t = run(small, 10, wire, alg)
+                rows[f"collective_64kb_{alg}_{wire}_ms"] = t * 1e3
+        # overlap: equal caller compute (~the fp32 comm time) riding
+        # launch/wait vs the blocking op; difference = exposed comm
+        t_comm = logical / (rows["collective_16mb_ring_fp32_gbps"] * 1e9)
+        compute_s = t_comm
+        for mode in ("blocking", "overlap"):
+            ts = ray_tpu.get(
+                [
+                    m.overlap_run.remote(big, compute_s, group, "fp32", mode)
+                    for m in members
+                ],
+                timeout=600,
+            )
+            rows[f"collective_overlap_{mode}_total_ms"] = max(ts) * 1e3
+        rows["collective_overlap_compute_ms"] = compute_s * 1e3
+        rows["collective_overlap_exposed_comm_ms"] = (
+            rows["collective_overlap_overlap_total_ms"] - compute_s * 1e3
+        )
+        for m in members:
+            ray_tpu.kill(m)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+    return rows
+
+
 def bench_failure_detection(seed: int = 2026):
     """Adaptive (phi-accrual) failure detection vs the fixed-timeout
     baseline — the health plane's quotable numbers.
@@ -1652,6 +1793,17 @@ def main():
         except Exception as e:  # noqa: BLE001
             emit("preemption_recovery_object_blackout_ms", 0.0, "ms",
                  error=repr(e))
+
+    # collectives v2 matrix: size x algorithm x wire dtype across a
+    # real two-node wire plane + the overlap (exposed-comm) rows.
+    # Own cluster; runs after the family's runtime shut down.
+    if remaining() > 120:
+        try:
+            cm = bench_collective_matrix()
+            for name, v in sorted(cm.items()):
+                emit(name, v, "GB/s" if name.endswith("gbps") else "ms")
+        except Exception as e:  # noqa: BLE001
+            emit("collective_matrix", 0.0, "rows", error=repr(e))
 
     # tokens lost to a seeded mid-run stage-host preemption: the MPMD
     # pipeline's survival number (clean vs preempted run of the same

@@ -147,7 +147,19 @@ D("conda_exe", str, "")
 D("container_runtime", str, "")
 
 # --- runtime collectives (util/collective; reference: ray.util.collective)
+# per-hop transfer chunk size — the named knob the selection layer and
+# the bench matrix sweep; GroupOptions.chunk_bytes overrides per group
 D("collective_chunk_bytes", int, 4 * 1024 * 1024)  # ring transfer chunk
+# messages at or under this ride the latency-optimal algorithms when
+# selection is on (auto): recursive doubling for allreduce (pow2
+# worlds), binomial tree for broadcast
+D("collective_small_max_bytes", int, 64 * 1024)
+# elements per quantization block for the int8 wire codec (per-block
+# f32 absmax scale + int8 payload; quantize.py)
+D("collective_quant_block", int, 2048)
+# how stale the cached SUSPECT-node set may get before the algorithm
+# selection layer re-reads node_health (0 disables the health input)
+D("collective_suspect_refresh_s", float, 1.0)
 # co-hosted ranks hand chunks through the shm arena past this size
 # (below it, the pickle5 oob-buffer wire path is cheaper than an
 # arena create/seal/delete round trip)

@@ -112,29 +112,44 @@ def _tree_mean(trees: List[Any]):
     return jax.tree.map(lambda *xs: sum(xs) / len(xs), *trees)
 
 
-def _bcast_weights(inst, group_name: str, root: int):
+def _bcast_weights(inst, group_name: str, root: int, wire_dtype=None):
     """Runs INSIDE each LearnerWorker (via ``_apply``): one collective
     broadcast replaces the driver's N per-actor weight puts — the
     driver ships weights to rank ``root`` once (or not at all, for the
-    init sync) and the group fans them out over the RPC+shm plane."""
+    init sync) and the group fans them out over the RPC+shm plane.
+
+    With ``wire_dtype`` ("bf16"/"int8") the float32 leaves ride the
+    block-quantized tensor path (~2x/4x fewer wire bytes); every
+    replica INCLUDING the root adopts the decode of the one encoding,
+    so replicas stay bit-identical to each other — the invariant the
+    fp32 default guarantees exactly."""
     from ray_tpu.util import collective as col
 
     rank = col.get_rank(group_name)
-    w = col.broadcast_object(
+    w = col.broadcast_tree(
         inst.learner.get_weights() if rank == root else None,
         src_rank=root,
         group_name=group_name,
+        wire_dtype=wire_dtype,
     )
-    if rank != root:
+    if rank != root or wire_dtype is not None:
         inst.learner.set_weights(w)
     return True
 
 
 class LearnerGroup:
-    """N-way data-parallel sgd steps with averaged gradients."""
+    """N-way data-parallel sgd steps with averaged gradients.
 
-    def __init__(self, factory: Callable[[], Learner], num_learners: int = 0):
+    ``weight_wire_dtype`` ("bf16"/"int8", default None = exact fp32)
+    block-quantizes the weight-sync broadcasts (init sync and
+    ``set_weights``) — replicas remain bit-identical to EACH OTHER
+    either way; the quantized path trades a bounded per-block error
+    vs the source weights for 2x/4x fewer broadcast bytes."""
+
+    def __init__(self, factory: Callable[[], Learner], num_learners: int = 0,
+                 weight_wire_dtype: Optional[str] = None):
         self.num_learners = num_learners
+        self.weight_wire_dtype = weight_wire_dtype
         if num_learners <= 1:
             self.local: Optional[Learner] = factory()
             self.workers: List[Any] = []
@@ -160,7 +175,8 @@ class LearnerGroup:
     def _broadcast_from_rank0(self):
         ray_tpu.get(
             [
-                w._apply(_bcast_weights, self._col_group, 0)
+                w._apply(_bcast_weights, self._col_group, 0,
+                         self.weight_wire_dtype)
                 for w in self.workers
             ],
             timeout=None,

@@ -36,3 +36,7 @@ from ray_tpu.serve.schema import (  # noqa: F401
     deploy_config,
     deploy_config_file,
 )
+from ray_tpu.serve.weights import (  # noqa: F401
+    push_deployment_weights,
+    push_weights,
+)

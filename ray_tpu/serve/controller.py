@@ -171,6 +171,20 @@ class ServeControllerActor:
         with self._lock:
             return self._app_roots.get(app_name)
 
+    def get_replica_actors(self, name: str, app_name: str = "default"):
+        """Live replica actor handles for one deployment (draining
+        victims excluded) — the target set for a collective weight
+        push (serve.weights.push_deployment_weights)."""
+        with self._lock:
+            states = self._apps.get(app_name, {})
+            st = states.get(name)
+            if st is None:
+                raise KeyError(
+                    f"no deployment {name!r} in app {app_name!r} "
+                    f"(known: {sorted(states)})"
+                )
+            return list(st.replicas)
+
     def delete_application(self, app_name: str) -> bool:
         with self._lock:
             self._app_roots.pop(app_name, None)

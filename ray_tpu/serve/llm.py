@@ -274,6 +274,15 @@ class LlamaDeployment:
             max_prompt_len=max_prompt_len,
         )
 
+    def update_weights(self, params) -> bool:
+        """Swap the decode params in place — the serve weight-push path
+        (`serve.weights.push_weights` fans new weights to every replica
+        via one collective broadcast, optionally block-quantized).
+        In-flight decodes pick the new params up at their next step;
+        the KV cache is content not weights, so it stays valid."""
+        self.engine.params = params
+        return True
+
     async def generate(self, prompt: List[int], max_new_tokens: int = 16):
         """Streaming generation (use handle.options(stream=True))."""
         async for tok in self.engine.stream(prompt, max_new_tokens):

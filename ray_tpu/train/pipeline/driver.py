@@ -50,6 +50,12 @@ class PipelineConfig:
     seed: int = 0
     name: str = "pipeline"
     collective_backend: str = "rpc"
+    # Collectives v2 data path for the dp grad allreduce: e.g.
+    # {"wire_dtype": "int8"} block-quantizes the concatenated grad
+    # vector (~4x fewer wire bytes per apply), {"algorithm": "auto"}
+    # enables size-based ring/rd selection.  None (default) keeps the
+    # fp32 ring bit-for-bit — the dp parity pin depends on it.
+    collective_options: Optional[Dict[str, Any]] = None
     # in-flight micro-ops ride retries across a stage migration
     max_task_retries: int = 8
     get_timeout_s: float = 600.0
@@ -85,6 +91,7 @@ class PipelineConfig:
             "scale": self.scale,
             "group_name": f"{self.name}:stage{stage_idx}",
             "collective_backend": self.collective_backend,
+            "collective_options": self.collective_options,
         }
 
 

@@ -80,7 +80,10 @@ class PipelineStageActor:
         group under rank ``lane``.
 
         spec keys: model, model_config, n_stages, stage_idx, n_micro,
-        dp, lane, optimizer, scale, group_name, collective_backend.
+        dp, lane, optimizer, scale, group_name, collective_backend,
+        collective_options (optional dict: wire_dtype / algorithm /
+        chunk_bytes for the dp grad allreduce — default None keeps the
+        bit-exact fp32 ring).
         """
         self._build(spec)
         self._blocks = blocks
@@ -95,10 +98,14 @@ class PipelineStageActor:
         if spec["dp"] > 1:
             from ray_tpu.util import collective as col
 
+            # group options (not per-op args) so the wire format rides
+            # the rendezvous records: a drain-migration reform restores
+            # the exact same data path without re-plumbing anything
             col.init_collective_group(
                 spec["dp"], spec["lane"],
                 backend=spec.get("collective_backend", "rpc"),
                 group_name=spec["group_name"],
+                options=spec.get("collective_options"),
             )
         return {"pid": os.getpid(), "host": socket.gethostname()}
 

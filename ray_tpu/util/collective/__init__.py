@@ -38,20 +38,27 @@ from ray_tpu.util.collective.backend import (  # noqa: F401
     register_backend,
 )
 from ray_tpu.util.collective.collective import (  # noqa: F401
+    CollectiveWork,
     allgather,
     allgather_async,
+    allgather_launch,
     allreduce,
     allreduce_async,
+    allreduce_launch,
     barrier,
     barrier_async,
     broadcast,
     broadcast_async,
+    broadcast_launch,
     broadcast_object,
     broadcast_object_async,
+    broadcast_tree,
+    broadcast_tree_async,
     create_collective_group,
     destroy_collective_group,
     get_backend,
     get_collective_group_size,
+    get_group_options,
     get_rank,
     init_collective_group,
     is_group_initialized,
@@ -69,6 +76,7 @@ from ray_tpu.util.collective.types import (  # noqa: F401
     CollectiveError,
     CollectiveGroupError,
     CollectiveTimeoutError,
+    GroupOptions,
     ReduceOp,
     RendezvousTimeoutError,
 )

@@ -42,16 +42,21 @@ class RuntimeBackend:
         self.manager = manager
 
     # -- collective ops --------------------------------------------------
-    async def allreduce(self, arr, op):
+    # wire_dtype / algorithm are the Collectives v2 per-op overrides
+    # (quantized payload codec, selection-table override); a backend
+    # that cannot honor a non-None value must raise CollectiveError,
+    # never silently ignore it
+    async def allreduce(self, arr, op, *, wire_dtype=None, algorithm=None):
         raise NotImplementedError
 
     async def allgather(self, arr):
         raise NotImplementedError
 
-    async def reducescatter(self, arr, op):
+    async def reducescatter(self, arr, op, *, wire_dtype=None):
         raise NotImplementedError
 
-    async def broadcast(self, arr, root: int):
+    async def broadcast(self, arr, root: int, *, wire_dtype=None,
+                        algorithm=None):
         raise NotImplementedError
 
     async def broadcast_object(self, obj, root: int):

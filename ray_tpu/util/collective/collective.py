@@ -38,6 +38,7 @@ from ray_tpu.util.collective.types import (
     CollectiveError,
     CollectiveGroupError,
     CollectiveTimeoutError,
+    GroupOptions,
     GroupSpec,
     ReduceOp,
 )
@@ -108,6 +109,16 @@ class CollectiveManager:
         self._reforming: set = set()
         self._pending_reform: Dict[str, dict] = {}
         self._inbox: Dict[tuple, _Mailbox] = {}
+        # (group, inc, tag) → Event: set on any chunk arrival for that
+        # tag, for first_src() waiters (btree broadcast consumers that
+        # do not yet know which rank the root routed to them)
+        self._tag_events: Dict[tuple, asyncio.Event] = {}
+        # health-plane input to algorithm selection: node ids currently
+        # SUSPECT, cached with a TTL so ops never add more than one
+        # node_health rpc per refresh window
+        self._suspect_cache: frozenset = frozenset()
+        self._suspect_at: float = float("-inf")
+        self._suspect_refreshing: bool = False
         # conn → {(group, peer_rank)}: every connection known to carry
         # a group's traffic, for death detection (inbound recorded at
         # delivery, outbound at peer-channel acquisition)
@@ -147,6 +158,11 @@ class CollectiveManager:
                 box = self._inbox[key] = _Mailbox()
             box.chunks.append(payload)
             box.event.set()
+            ev = self._tag_events.get(
+                (payload["group"], payload.get("inc", ""), payload["tag"])
+            )
+            if ev is not None:
+                ev.set()
             self._track_conn(conn, payload["group"], payload["src"])
             return True
         if op == "fail":
@@ -273,6 +289,9 @@ class CollectiveManager:
         for key, box in self._inbox.items():
             if key[0] == group and box.failed is None:
                 self._drop_box(box, err)
+        for key, ev in self._tag_events.items():
+            if key[0] == group:
+                ev.set()  # wake first_src waiters: they re-check failed
 
     def fail_group(self, group: str, err: Exception, propagate: bool):
         """Poison the group locally; optionally fan the failure out to
@@ -339,6 +358,78 @@ class CollectiveManager:
             if not box.chunks and box.failed is None:
                 self._inbox.pop(key, None)
         return got
+
+    async def first_src(self, group: str, tag: str,
+                        timeout: Optional[float] = None) -> int:
+        """The source rank of the first chunk to arrive on (group, tag)
+        — how a broadcast consumer learns which rank the root's
+        algorithm (ring predecessor or btree parent) routed to it,
+        without pre-agreeing on the topology.  Does NOT consume the
+        chunk; call recv_chunks with the returned src."""
+        if timeout is None:
+            timeout = cfg.collective_op_timeout_s
+        gh = self.groups.get(group)
+        inc = gh.spec.incarnation if gh is not None else ""
+        tkey = (group, inc, tag)
+        deadline = time.monotonic() + timeout
+        try:
+            while True:
+                if gh is not None and gh.failed is not None:
+                    raise gh.failed
+                for key, box in self._inbox.items():
+                    if key[0] == group and key[1] == inc and key[3] == tag:
+                        if box.failed is not None:
+                            raise box.failed
+                        if box.chunks:
+                            return key[2]
+                ev = self._tag_events.get(tkey)
+                if ev is None:
+                    ev = self._tag_events[tkey] = asyncio.Event()
+                ev.clear()
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise CollectiveTimeoutError(
+                        f"collective op on group {group!r} timed out "
+                        f"after {timeout:.0f}s waiting for the first "
+                        f"broadcast chunk (tag {tag}).  The root or an "
+                        f"upstream rank is likely dead or wedged."
+                    )
+                try:
+                    await asyncio.wait_for(ev.wait(), left)
+                except asyncio.TimeoutError:
+                    continue  # deadline check above raises
+        finally:
+            self._tag_events.pop(tkey, None)
+
+    async def suspect_nodes(self) -> frozenset:
+        """Node ids the health plane currently marks SUSPECT — the
+        topology input to algorithm selection (btree leaf placement,
+        broadcast algorithm choice at the root).  NEVER blocks the
+        data path: returns the cached set immediately and, when stale
+        past collective_suspect_refresh_s, kicks a background refresh
+        — a slow or partitioned GCS must not add its latency to a
+        broadcast.  Advisory only: a stale (or initially empty) view
+        costs performance, never correctness."""
+        ttl = cfg.collective_suspect_refresh_s
+        if ttl <= 0:
+            return frozenset()
+        now = time.monotonic()
+        if now >= self._suspect_at + ttl and not self._suspect_refreshing:
+            self._suspect_refreshing = True
+            self.rt._spawn(self._refresh_suspects())
+        return self._suspect_cache
+
+    async def _refresh_suspects(self):
+        try:
+            rows = await self.rt.gcs.call("node_health", {}, timeout=2.0)
+            self._suspect_cache = frozenset(
+                nid for nid, row in rows.items() if row.get("suspect")
+            )
+        except Exception:
+            pass  # keep the stale view; the next TTL expiry retries
+        finally:
+            self._suspect_at = time.monotonic()
+            self._suspect_refreshing = False
 
     def _timeout_error(self, group, src, tag, timeout, got, want):
         gh = self.groups.get(group)
@@ -435,7 +526,9 @@ class CollectiveManager:
         self.rt._spawn(go())
 
     async def init_group(self, group_name: str, world_size: int, rank: int,
-                         backend_name: str) -> GroupHandle:
+                         backend_name: str,
+                         options: Optional[GroupOptions] = None
+                         ) -> GroupHandle:
         if not (0 <= rank < world_size):
             raise CollectiveError(
                 f"rank {rank} out of range for world_size {world_size}"
@@ -453,18 +546,21 @@ class CollectiveManager:
                 f"ray_tpu.util.collective.get_backend({backend_name!r}) "
                 f"or pick 'rpc'/'jax' for runtime groups"
             )
+        options = (options or GroupOptions()).validate()
         actor_id = self.rt.actor_id.hex() if self.rt.actor_id else None
         me = await rendezvous.declare(
-            self.rt, group_name, world_size, rank, actor_id
+            self.rt, group_name, world_size, rank, actor_id,
+            options=options,
         )
         try:
-            members, incarnation = await rendezvous.await_members(
-                self.rt, group_name, world_size, rank, me
+            members, incarnation, options = await rendezvous.await_members(
+                self.rt, group_name, world_size, rank, me,
+                options=options,
             )
             spec = GroupSpec(
                 name=group_name, world_size=world_size, rank=rank,
                 backend=backend_name, members=members,
-                incarnation=incarnation,
+                incarnation=incarnation, options=options,
             )
             return await self._install_group(spec)
         except BaseException:
@@ -538,6 +634,8 @@ class CollectiveManager:
                 self._inbox.pop(key),
                 CollectiveGroupError(f"group {group_name!r} is re-forming"),
             )
+        for key in [k for k in self._tag_events if k[0] == group_name]:
+            self._tag_events.pop(key).set()
         for pairs in self._conn_groups.values():
             pairs.difference_update({p for p in pairs if p[0] == group_name})
         if gh is not None:
@@ -547,6 +645,10 @@ class CollectiveManager:
                 pass
         if backend_name is None:
             backend_name = old_spec.backend if old_spec is not None else "rpc"
+        # carry the FULL group config through the reform: algorithm
+        # override, wire dtype, chunk size — a migration or shrink must
+        # never silently change the group's wire format
+        options = old_spec.options if old_spec is not None else None
         if old_spec is not None:
             gen = old_spec.reform_gen + 1
             if rank is None:
@@ -560,21 +662,26 @@ class CollectiveManager:
                     )
         else:
             # replacement member: no local history (rank= validated
-            # above) — learns the generation from the stale record it
-            # is about to overwrite
-            gen = await rendezvous.peek_gen(self.rt, group_name, rank) + 1
+            # above) — learns the generation AND the group's data-path
+            # config from the stale record it is about to overwrite
+            gen, options = await rendezvous.peek_record(
+                self.rt, group_name, rank
+            )
+            gen += 1
+        options = (options or GroupOptions()).validate()
         actor_id = self.rt.actor_id.hex() if self.rt.actor_id else None
         me = await rendezvous.declare(
-            self.rt, group_name, world_size, rank, actor_id, gen=gen
+            self.rt, group_name, world_size, rank, actor_id, gen=gen,
+            options=options,
         )
-        members, incarnation = await rendezvous.await_members(
+        members, incarnation, options = await rendezvous.await_members(
             self.rt, group_name, world_size, rank, me,
-            timeout=timeout, gen=gen,
+            timeout=timeout, gen=gen, options=options,
         )
         spec = GroupSpec(
             name=group_name, world_size=world_size, rank=rank,
             backend=backend_name, members=members,
-            incarnation=incarnation, reform_gen=gen,
+            incarnation=incarnation, reform_gen=gen, options=options,
         )
         new_gh = await self._install_group(spec)
         if rank == 0 and old_spec is not None:
@@ -590,6 +697,8 @@ class CollectiveManager:
             self._drop_box(
                 box, CollectiveGroupError(f"group {group_name!r} destroyed")
             )
+        for key in [k for k in self._tag_events if k[0] == group_name]:
+            self._tag_events.pop(key).set()
         # forget the group's connection tracking: a later close of a
         # conn that once carried this group's traffic must not poison a
         # re-initialized same-name group
@@ -651,17 +760,39 @@ def _run_blocking(coro):
     return rt._run(coro, timeout=None)
 
 
+def _coerce_options(options) -> Optional[GroupOptions]:
+    if options is None or isinstance(options, GroupOptions):
+        return options
+    if isinstance(options, dict):
+        return GroupOptions.from_dict(options)
+    raise CollectiveError(
+        f"options must be a GroupOptions or dict, got {type(options)}"
+    )
+
+
 def init_collective_group(world_size: int, rank: int, *,
                           backend: str = "rpc",
-                          group_name: str = DEFAULT_GROUP_NAME) -> None:
-    """Join a collective group (call from inside each member actor)."""
+                          group_name: str = DEFAULT_GROUP_NAME,
+                          options=None) -> None:
+    """Join a collective group (call from inside each member actor).
+
+    ``options`` (GroupOptions or dict) sets the group's data path:
+    ``algorithm`` ("auto" for the size/topology selection table, or an
+    explicit name), ``wire_dtype`` ("bf16"/"int8" block-quantized
+    payloads), ``chunk_bytes``, ``quant_block``.  Rank 0's copy is
+    authoritative group-wide and persists through
+    ``reform_collective_group``."""
     mgr = _manager()
-    _run_blocking(mgr.init_group(group_name, world_size, rank, backend))
+    _run_blocking(mgr.init_group(
+        group_name, world_size, rank, backend,
+        options=_coerce_options(options),
+    ))
 
 
-def _init_in_actor(inst, group_name, world_size, rank, backend):
+def _init_in_actor(inst, group_name, world_size, rank, backend, options):
     init_collective_group(
-        world_size, rank, backend=backend, group_name=group_name
+        world_size, rank, backend=backend, group_name=group_name,
+        options=options,
     )
     return True
 
@@ -675,7 +806,8 @@ def create_collective_group(actors, *, world_size: Optional[int] = None,
                             ranks: Optional[List[int]] = None,
                             backend: str = "rpc",
                             group_name: str = DEFAULT_GROUP_NAME,
-                            timeout: Optional[float] = None) -> None:
+                            timeout: Optional[float] = None,
+                            options=None) -> None:
     """Driver-side declarative form: make ``actors`` a collective group
     (actor i gets ``ranks[i]``, default i).  Blocks until every member
     finished rendezvous — afterwards ops may be issued on any member.
@@ -707,8 +839,9 @@ def create_collective_group(actors, *, world_size: Optional[int] = None,
             f"ranks {ranks} must be distinct and within "
             f"0..{world_size - 1}"
         )
+    opts = _coerce_options(options)
     refs = [
-        a._apply(_init_in_actor, group_name, world_size, rk, backend)
+        a._apply(_init_in_actor, group_name, world_size, rk, backend, opts)
         for a, rk in zip(actors, ranks)
     ]
     ray_tpu.get(
@@ -834,6 +967,7 @@ def local_group_memberships() -> List[dict]:
             "world_size": gh.spec.world_size,
             "rank": gh.spec.rank,
             "backend": gh.spec.backend,
+            "options": gh.spec.options.to_dict(),
         }
         for name, gh in mgr.groups.items()
     ]
@@ -841,6 +975,13 @@ def local_group_memberships() -> List[dict]:
 
 def get_rank(group_name: str = DEFAULT_GROUP_NAME) -> int:
     return _manager().get_group(group_name).spec.rank
+
+
+def get_group_options(group_name: str = DEFAULT_GROUP_NAME) -> GroupOptions:
+    """The group's live data-path config (algorithm override, wire
+    dtype, chunk size) — what the selection layer consults, and what a
+    reform must carry unchanged."""
+    return _manager().get_group(group_name).spec.options
 
 
 def get_collective_group_size(group_name: str = DEFAULT_GROUP_NAME) -> int:
@@ -888,9 +1029,14 @@ async def _collective_op(group_name, fn):
 
 
 async def allreduce_async(tensor, group_name: str = DEFAULT_GROUP_NAME,
-                          op: ReduceOp = ReduceOp.SUM):
+                          op: ReduceOp = ReduceOp.SUM, *,
+                          wire_dtype: Optional[str] = None,
+                          algorithm: Optional[str] = None):
     return await _collective_op(
-        group_name, lambda gh: gh.backend.allreduce(tensor, op)
+        group_name,
+        lambda gh: gh.backend.allreduce(
+            tensor, op, wire_dtype=wire_dtype, algorithm=algorithm
+        ),
     )
 
 
@@ -901,16 +1047,25 @@ async def allgather_async(tensor, group_name: str = DEFAULT_GROUP_NAME):
 
 
 async def reducescatter_async(tensor, group_name: str = DEFAULT_GROUP_NAME,
-                              op: ReduceOp = ReduceOp.SUM):
+                              op: ReduceOp = ReduceOp.SUM, *,
+                              wire_dtype: Optional[str] = None):
     return await _collective_op(
-        group_name, lambda gh: gh.backend.reducescatter(tensor, op)
+        group_name,
+        lambda gh: gh.backend.reducescatter(
+            tensor, op, wire_dtype=wire_dtype
+        ),
     )
 
 
 async def broadcast_async(tensor, src_rank: int = 0,
-                          group_name: str = DEFAULT_GROUP_NAME):
+                          group_name: str = DEFAULT_GROUP_NAME, *,
+                          wire_dtype: Optional[str] = None,
+                          algorithm: Optional[str] = None):
     return await _collective_op(
-        group_name, lambda gh: gh.backend.broadcast(tensor, src_rank)
+        group_name,
+        lambda gh: gh.backend.broadcast(
+            tensor, src_rank, wire_dtype=wire_dtype, algorithm=algorithm
+        ),
     )
 
 
@@ -968,9 +1123,18 @@ async def recv_async(tensor, src_rank: int,
 # ---- blocking ops (sync actor methods; NOT for async def — RT109) ------
 
 def allreduce(tensor, group_name: str = DEFAULT_GROUP_NAME,
-              op: ReduceOp = ReduceOp.SUM):
-    """Ring allreduce; returns the reduced array (same shape/dtype)."""
-    return _run_blocking(allreduce_async(tensor, group_name, op))
+              op: ReduceOp = ReduceOp.SUM, *,
+              wire_dtype: Optional[str] = None,
+              algorithm: Optional[str] = None):
+    """Allreduce; returns the reduced array (same shape/dtype).
+
+    ``wire_dtype="int8"|"bf16"`` ships block-quantized payloads for
+    this op (overriding the group default; "fp32" forces raw bytes);
+    ``algorithm`` overrides the selection table ("ring", "rd", "auto").
+    Every rank must pass the SAME per-op overrides."""
+    return _run_blocking(allreduce_async(
+        tensor, group_name, op, wire_dtype=wire_dtype, algorithm=algorithm
+    ))
 
 
 def allgather(tensor, group_name: str = DEFAULT_GROUP_NAME):
@@ -979,17 +1143,27 @@ def allgather(tensor, group_name: str = DEFAULT_GROUP_NAME):
 
 
 def reducescatter(tensor, group_name: str = DEFAULT_GROUP_NAME,
-                  op: ReduceOp = ReduceOp.SUM):
+                  op: ReduceOp = ReduceOp.SUM, *,
+                  wire_dtype: Optional[str] = None):
     """Reduce then scatter: returns THIS rank's segment of the reduced
     flat tensor (numpy array_split segmentation)."""
-    return _run_blocking(reducescatter_async(tensor, group_name, op))
+    return _run_blocking(reducescatter_async(
+        tensor, group_name, op, wire_dtype=wire_dtype
+    ))
 
 
 def broadcast(tensor, src_rank: int = 0,
-              group_name: str = DEFAULT_GROUP_NAME):
+              group_name: str = DEFAULT_GROUP_NAME, *,
+              wire_dtype: Optional[str] = None,
+              algorithm: Optional[str] = None):
     """Root's tensor replicated to all; non-root tensors are filled
-    in place (shapes/dtypes must match) and returned."""
-    return _run_blocking(broadcast_async(tensor, src_rank, group_name))
+    in place (shapes/dtypes must match) and returned.  With a
+    ``wire_dtype`` codec every rank (root included) returns the decode
+    of the root's one encoding — all ranks bit-identical."""
+    return _run_blocking(broadcast_async(
+        tensor, src_rank, group_name,
+        wire_dtype=wire_dtype, algorithm=algorithm,
+    ))
 
 
 def broadcast_object(obj=None, src_rank: int = 0,
@@ -1013,3 +1187,186 @@ def recv(tensor, src_rank: int, group_name: str = DEFAULT_GROUP_NAME):
     """Receive into ``tensor`` (shape/dtype must match the send);
     returns the filled array."""
     return _run_blocking(recv_async(tensor, src_rank, group_name))
+
+
+# ---- pytree broadcast (weight-sync consumers: learner group, serve) ----
+
+class _QLeaf:
+    """Placeholder for a float32 leaf extracted into the concatenated
+    quantized tensor (position + original shape)."""
+
+    __slots__ = ("idx", "shape")
+
+    def __init__(self, idx: int, shape: tuple):
+        self.idx = idx
+        self.shape = tuple(shape)
+
+    def __reduce__(self):
+        return (_QLeaf, (self.idx, self.shape))
+
+
+def _strip_f32(node, leaves: list):
+    import numpy as np
+
+    if isinstance(node, dict):
+        return {k: _strip_f32(v, leaves) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_strip_f32(v, leaves) for v in node]
+    if isinstance(node, tuple):
+        return tuple(_strip_f32(v, leaves) for v in node)
+    if isinstance(node, np.ndarray) and node.dtype == np.float32:
+        leaves.append(np.ascontiguousarray(node))
+        return _QLeaf(len(leaves) - 1, node.shape)
+    return node
+
+
+def _fill_f32(node, arrs: list):
+    if isinstance(node, dict):
+        return {k: _fill_f32(v, arrs) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_fill_f32(v, arrs) for v in node]
+    if isinstance(node, _QLeaf):
+        return arrs[node.idx].reshape(node.shape)
+    if isinstance(node, tuple):
+        return tuple(_fill_f32(v, arrs) for v in node)
+    return node
+
+
+async def broadcast_tree_async(tree=None, src_rank: int = 0,
+                               group_name: str = DEFAULT_GROUP_NAME, *,
+                               wire_dtype: Optional[str] = None):
+    """Broadcast a pytree (nested dict/list/tuple) of numpy arrays from
+    ``src_rank`` — the weight-sync primitive.
+
+    Without a codec this is plain ``broadcast_object``.  With
+    ``wire_dtype`` the float32 leaves ride ONE concatenated quantized
+    tensor broadcast (structure + non-f32 leaves stay exact in the
+    pickled skeleton), and EVERY rank — the root included — returns the
+    decode of the root's single encoding, so all replicas end
+    bit-identical (the root trades its exact copy for fleet-wide
+    equality, which is what replicated serving/learning needs)."""
+    import numpy as np
+
+    if wire_dtype is None or wire_dtype == "fp32":
+        return await broadcast_object_async(tree, src_rank, group_name)
+    rank = _manager().get_group(group_name).spec.rank
+    if rank == src_rank:
+        leaves: list = []
+        skel = _strip_f32(tree, leaves)
+        sizes = [int(a.size) for a in leaves]
+        flat = (
+            np.concatenate([a.reshape(-1) for a in leaves])
+            if leaves else np.empty(0, np.float32)
+        )
+        await broadcast_object_async(
+            {"skel": skel, "sizes": sizes, "n": int(flat.size)},
+            src_rank, group_name,
+        )
+    else:
+        meta = await broadcast_object_async(None, src_rank, group_name)
+        skel, sizes = meta["skel"], meta["sizes"]
+        flat = np.zeros(meta["n"], dtype=np.float32)
+    out = await broadcast_async(
+        flat, src_rank, group_name, wire_dtype=wire_dtype
+    )
+    arrs, off = [], 0
+    for sz in sizes:
+        arrs.append(out[off:off + sz])
+        off += sz
+    return _fill_f32(skel, arrs)
+
+
+def broadcast_tree(tree=None, src_rank: int = 0,
+                   group_name: str = DEFAULT_GROUP_NAME, *,
+                   wire_dtype: Optional[str] = None):
+    """Blocking twin of :func:`broadcast_tree_async`."""
+    return _run_blocking(broadcast_tree_async(
+        tree, src_rank, group_name, wire_dtype=wire_dtype
+    ))
+
+
+# ---- async progress engine (launch / wait: compute-comm overlap) -------
+
+class CollectiveWork:
+    """Handle to a collective in flight on the runtime's io loop.
+
+    The T3-style overlap surface (arxiv 2401.16677) without
+    caller-side threading: ``launch`` returns immediately, the chunked
+    collective steps progress on the runtime loop (socket traffic and
+    shm handoffs interleave with whatever the caller thread does —
+    jax compute, typically), and ``wait()`` joins and returns the op's
+    result.  The input tensor is OWNED by the collective until
+    ``wait()`` returns: mutating it mid-flight races the chunk reads.
+
+    Failure surfaces at ``wait()`` exactly as it would from the
+    blocking op (same poisoning semantics — the coroutine underneath
+    IS the ``*_async`` twin)."""
+
+    __slots__ = ("_fut", "op", "group_name")
+
+    def __init__(self, fut, op: str, group_name: str):
+        self._fut = fut
+        self.op = op
+        self.group_name = group_name
+
+    def done(self) -> bool:
+        """True once the op finished (successfully or not)."""
+        return self._fut.done()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the op completes; returns its result (the
+        reduced/filled array) or raises its failure."""
+        return self._fut.result(timeout)
+
+    def exception(self, timeout: Optional[float] = None):
+        """The op's exception (None on success); blocks like wait."""
+        return self._fut.exception(timeout)
+
+
+def _launch(coro, op: str, group_name: str) -> CollectiveWork:
+    rt = get_runtime()
+    if threading.current_thread() is rt._thread:
+        raise CollectiveError(
+            "collective launch from the runtime io loop: you are "
+            "already async — just `await` the *_async twin (and don't "
+            "block the loop on wait())"
+        )
+    return CollectiveWork(
+        asyncio.run_coroutine_threadsafe(coro, rt._loop), op, group_name
+    )
+
+
+def allreduce_launch(tensor, group_name: str = DEFAULT_GROUP_NAME,
+                     op: ReduceOp = ReduceOp.SUM, *,
+                     wire_dtype: Optional[str] = None,
+                     algorithm: Optional[str] = None) -> CollectiveWork:
+    """Start an allreduce and return immediately: run compute while
+    the chunked ring/rd steps progress on the runtime loop, then
+    ``work.wait()`` for the reduced array."""
+    return _launch(
+        allreduce_async(tensor, group_name, op,
+                        wire_dtype=wire_dtype, algorithm=algorithm),
+        "allreduce", group_name,
+    )
+
+
+def broadcast_launch(tensor, src_rank: int = 0,
+                     group_name: str = DEFAULT_GROUP_NAME, *,
+                     wire_dtype: Optional[str] = None,
+                     algorithm: Optional[str] = None) -> CollectiveWork:
+    """Start a broadcast and return immediately (see
+    allreduce_launch)."""
+    return _launch(
+        broadcast_async(tensor, src_rank, group_name,
+                        wire_dtype=wire_dtype, algorithm=algorithm),
+        "broadcast", group_name,
+    )
+
+
+def allgather_launch(tensor,
+                     group_name: str = DEFAULT_GROUP_NAME) -> CollectiveWork:
+    """Start an allgather and return immediately (see
+    allreduce_launch)."""
+    return _launch(
+        allgather_async(tensor, group_name), "allgather", group_name
+    )

@@ -44,6 +44,20 @@ class JaxGangBackend(RuntimeBackend):
                 f"jax.distributed across exactly the member hosts, or "
                 f"use backend='rpc' for arbitrary actor sub-groups."
             )
+        opt = self.spec.options
+        if opt.wire_dtype not in (None, "fp32") or opt.algorithm is not None:
+            raise CollectiveError(
+                "the jax gang backend rides XLA's own collectives; "
+                "wire_dtype / algorithm group options apply to the "
+                "'rpc' backend only"
+            )
+
+    def _refuse_v2(self, wire_dtype, algorithm=None):
+        if wire_dtype not in (None, "fp32") or algorithm is not None:
+            raise CollectiveError(
+                "wire_dtype / algorithm overrides are not supported on "
+                "the jax gang backend; use backend='rpc'"
+            )
 
     def _reduce_stack(self, stacked, op: ReduceOp):
         import numpy as np
@@ -77,23 +91,29 @@ class JaxGangBackend(RuntimeBackend):
         )
         return [np.asarray(gathered[i]) for i in range(self.spec.world_size)]
 
-    async def allreduce(self, arr, op: ReduceOp):
+    async def allreduce(self, arr, op: ReduceOp, *, wire_dtype=None,
+                        algorithm=None):
         import numpy as np
 
+        self._refuse_v2(wire_dtype, algorithm)
         parts = await self.allgather(arr)
         return self._reduce_stack(np.stack(parts), op).reshape(
             np.asarray(arr).shape
         )
 
-    async def reducescatter(self, arr, op: ReduceOp):
+    async def reducescatter(self, arr, op: ReduceOp, *, wire_dtype=None):
         import numpy as np
 
+        self._refuse_v2(wire_dtype)
         reduced = (await self.allreduce(arr, op)).reshape(-1)
         splits = np.array_split(reduced, self.spec.world_size)
         return splits[self.spec.rank].copy()
 
-    async def broadcast(self, arr, root: int):
+    async def broadcast(self, arr, root: int, *, wire_dtype=None,
+                        algorithm=None):
         import numpy as np
+
+        self._refuse_v2(wire_dtype, algorithm)
         from jax.experimental import multihost_utils
 
         a = np.asarray(arr)

@@ -32,6 +32,7 @@ from ray_tpu.common.backoff import Backoff, BackoffPolicy
 from ray_tpu.common.config import cfg
 from ray_tpu.util.collective.types import (
     CollectiveError,
+    GroupOptions,
     GroupSpec,
     MemberInfo,
     RendezvousTimeoutError,
@@ -52,7 +53,8 @@ def _reform_key(group_name: str, incarnation: str, rank: int) -> str:
 
 
 async def declare(rt, group_name: str, world_size: int, rank: int,
-                  actor_id_hex: Optional[str], gen: int = 0) -> MemberInfo:
+                  actor_id_hex: Optional[str], gen: int = 0,
+                  options: Optional[GroupOptions] = None) -> MemberInfo:
     """Publish this rank's identity.  Overwrites any stale key from a
     previous same-named group (names are reusable only after destroy —
     concurrent same-named groups are user error and detected below by
@@ -60,7 +62,10 @@ async def declare(rt, group_name: str, world_size: int, rank: int,
     group's incarnation nonce; every rank adopts it at await_members,
     and wire chunks are keyed by it so stale traffic from a previous
     incarnation is dropped, never consumed.  ``gen`` is the reform
-    generation (0 for a fresh group)."""
+    generation (0 for a fresh group).  ``options`` (the Collectives v2
+    data-path config) rides every record: rank 0's copy is adopted
+    group-wide, and a replacement member inherits it from the stale
+    record (peek_record) so a reform never changes the wire format."""
     server = getattr(rt, "_worker_server", None)
     if server is None:
         raise CollectiveError(
@@ -76,6 +81,8 @@ async def declare(rt, group_name: str, world_size: int, rank: int,
         actor_id=actor_id_hex,
     )
     record = {"world_size": world_size, "member": me.to_dict(), "gen": gen}
+    if options is not None:
+        record["options"] = options.to_dict()
     if rank == 0:
         record["incarnation"] = os.urandom(8).hex()
     await rt.gcs.call(
@@ -92,11 +99,17 @@ async def declare(rt, group_name: str, world_size: int, rank: int,
 async def await_members(rt, group_name: str, world_size: int, rank: int,
                         me: MemberInfo,
                         timeout: Optional[float] = None,
-                        gen: int = 0):
+                        gen: int = 0,
+                        options: Optional[GroupOptions] = None):
     """Poll the KV table until every rank has declared; returns
-    ``(members in rank order, incarnation nonce)``.  Raises
-    RendezvousTimeoutError naming the missing ranks — the actionable
-    shape ("rank 2 never arrived") rather than a bare hang.
+    ``(members in rank order, incarnation nonce, group options)``.
+    Raises RendezvousTimeoutError naming the missing ranks — the
+    actionable shape ("rank 2 never arrived") rather than a bare hang.
+
+    The group-wide ``GroupOptions`` are RANK 0's (taken from the same
+    final re-read as the incarnation) so every member agrees on the
+    wire format; a non-rank-0 member that declared a CONFLICTING
+    non-default config gets a loud error, not a silent override.
 
     Records whose ``gen`` differs from ours are SKIPPED (treated as
     not-yet-declared): on the reform path those are a dead member's
@@ -151,7 +164,25 @@ async def await_members(rt, group_name: str, world_size: int, rank: int,
                 if "member" in rec and rank != 0
                 else members[0]
             )
-            return [members[i] for i in range(world_size)], incarnation
+            if rank == 0:
+                adopted = options or GroupOptions()
+            else:
+                adopted = GroupOptions.from_dict(rec.get("options"))
+                mine = (options or GroupOptions()).to_dict()
+                if (
+                    any(v is not None for v in mine.values())
+                    and mine != adopted.to_dict()
+                ):
+                    raise CollectiveError(
+                        f"collective group {group_name!r}: rank {rank} "
+                        f"declared options {mine} but rank 0 declared "
+                        f"{adopted.to_dict()} — the group config (wire "
+                        f"dtype / algorithm / chunk size) must agree; "
+                        f"rank 0's copy is authoritative"
+                    )
+            return (
+                [members[i] for i in range(world_size)], incarnation, adopted
+            )
         if time.monotonic() >= deadline:
             missing = sorted(set(range(world_size)) - set(members))
             raise RendezvousTimeoutError(
@@ -227,17 +258,27 @@ async def reform_roster(rt, group_name: str, old_spec: GroupSpec,
         await poll_backoff.wait()
 
 
-async def peek_gen(rt, group_name: str, rank: int) -> int:
-    """The reform generation recorded under ``rank``'s key (0 when the
-    key is absent or predates generations) — how a REPLACEMENT member,
-    which has no local group history, joins at the right generation."""
+async def peek_record(rt, group_name: str, rank: int):
+    """``(gen, options)`` recorded under ``rank``'s key — how a
+    REPLACEMENT member, which has no local group history, joins at the
+    right generation AND inherits the group's data-path config
+    (algorithm / wire dtype / chunk size) instead of silently
+    re-joining with defaults.  (0, None) when the key is absent or
+    predates generations."""
     blob = await rt.gcs.call("kv_get", {"key": _key(group_name, rank)})
     if blob is None:
-        return 0
+        return 0, None
     try:
-        return pickle.loads(blob).get("gen", 0)
+        rec = pickle.loads(blob)
+        return rec.get("gen", 0), GroupOptions.from_dict(rec.get("options"))
     except Exception:
-        return 0
+        return 0, None
+
+
+async def peek_gen(rt, group_name: str, rank: int) -> int:
+    """Back-compat shim: just the generation half of peek_record."""
+    gen, _ = await peek_record(rt, group_name, rank)
+    return gen
 
 
 async def reform_cleanup(rt, group_name: str, old_spec: GroupSpec,

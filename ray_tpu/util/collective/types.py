@@ -11,6 +11,11 @@ import enum
 from dataclasses import dataclass, field
 from typing import Any, List, Optional
 
+# wire dtypes a group/op may request for the quantized data path
+# (quantize.py implements the codecs); None/"fp32" = raw fp32 bytes,
+# the bit-exact default
+WIRE_DTYPES = ("fp32", "bf16", "int8")
+
 
 class ReduceOp(enum.Enum):
     SUM = "sum"
@@ -80,6 +85,66 @@ class MemberInfo:
 
 
 @dataclass
+class GroupOptions:
+    """Per-group data-path configuration (Collectives v2).
+
+    Every field defaults to None = "inherit": the selection layer
+    (``algorithms.py``) and the global config knobs decide.  The whole
+    object is persisted in the rendezvous records and carried through
+    ``reform_collective_group`` — a migration or shrink never silently
+    changes the group's wire format or algorithm choice.
+    """
+
+    # collective algorithm: None = the bit-compat default per op
+    # (ring for reductions, size-based ring/btree for broadcast),
+    # "auto" = full size x world x plane selection table,
+    # or an explicit name ("ring" | "rd" | "btree")
+    algorithm: Optional[str] = None
+    # payload codec for float32 tensors: None/"fp32" = raw bytes
+    # (bit-exact), "bf16" | "int8" = block-quantized (quantize.py)
+    wire_dtype: Optional[str] = None
+    # per-hop transfer chunk size; None = cfg.collective_chunk_bytes
+    chunk_bytes: Optional[int] = None
+    # elements per quantization block; None = cfg.collective_quant_block
+    quant_block: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "algorithm": self.algorithm,
+            "wire_dtype": self.wire_dtype,
+            "chunk_bytes": self.chunk_bytes,
+            "quant_block": self.quant_block,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "GroupOptions":
+        if not d:
+            return cls()
+        return cls(
+            algorithm=d.get("algorithm"),
+            wire_dtype=d.get("wire_dtype"),
+            chunk_bytes=d.get("chunk_bytes"),
+            quant_block=d.get("quant_block"),
+        )
+
+    def validate(self) -> "GroupOptions":
+        if self.wire_dtype is not None and self.wire_dtype not in WIRE_DTYPES:
+            raise CollectiveError(
+                f"unknown wire_dtype {self.wire_dtype!r}; "
+                f"one of {WIRE_DTYPES}"
+            )
+        if self.chunk_bytes is not None and int(self.chunk_bytes) < 1:
+            raise CollectiveError(
+                f"chunk_bytes must be >= 1, got {self.chunk_bytes}"
+            )
+        if self.quant_block is not None and int(self.quant_block) < 1:
+            raise CollectiveError(
+                f"quant_block must be >= 1, got {self.quant_block}"
+            )
+        return self
+
+
+@dataclass
 class GroupSpec:
     """Everything a backend needs to know about an initialized group."""
 
@@ -97,6 +162,11 @@ class GroupSpec:
     # records of its own generation — a survivor re-declaring can never
     # adopt the DEAD member's stale record (same key, older gen)
     reform_gen: int = 0
+    # Collectives v2 data-path config: algorithm override, wire dtype,
+    # chunk size.  Adopted from rank 0's rendezvous record so every
+    # member agrees, and carried through reform (a replacement member
+    # inherits it from the stale record it overwrites)
+    options: GroupOptions = field(default_factory=GroupOptions)
 
     def member(self, rank: int) -> MemberInfo:
         return self.members[rank]
