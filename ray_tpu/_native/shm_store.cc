@@ -821,13 +821,18 @@ int rt_store_create_object(void* handle, const uint8_t* id, uint64_t size,
   }
   // Creator pin BEFORE the insert: a crash after the entry exists must be
   // reapable through the pin ledger (reap aborts kCreated entries).
+  bool pinned;
   {
     LedgerLock led(s);
-    if (ledger_add(s, id) != RT_OK) {
-      MainLock main(s);
-      arena_free(s, off);
-      return RT_TOO_MANY_PINS;
-    }
+    pinned = ledger_add(s, id) == RT_OK;
+  }
+  if (!pinned) {
+    // unwind OUTSIDE the ledger scope: taking MAIN under ledger_mu
+    // inverts the MAIN < shard < ledger order and closes a deadlock
+    // cycle against publish_slot's shard->ledger hold (rtlint RT304)
+    MainLock main(s);
+    arena_free(s, off);
+    return RT_TOO_MANY_PINS;
   }
   // Pass 3 (shard): insert.  A lost race (concurrent creator of the same
   // id, or the shard filling meanwhile) unwinds: drop the creator pin,

@@ -1817,12 +1817,17 @@ class Runtime:
         # every submission.
         # rtlint: disable-next=RT108
         if not self._submit_q_scheduled:
+            # cross-plane by design: the protocol above makes the
+            # caller-side set / loop-side clear safe without a lock
+            # rtlint: disable-next=RT301
             self._submit_q_scheduled = True
             self._loop.call_soon_threadsafe(self._drain_submit_q)
 
     def _drain_submit_q(self):
         # clear the flag BEFORE draining: a submitter appending after the
         # clear schedules a fresh (possibly redundant, never missed) drain
+        # (GIL-ordered handshake with _submit_to_loop, audited above)
+        # rtlint: disable-next=RT301
         self._submit_q_scheduled = False
         q = self._submit_q
         while q:
@@ -3036,9 +3041,12 @@ class Runtime:
                     self._schedule_ref_flush()
 
     def _schedule_ref_flush(self):
-        # caller holds _ref_lock
+        # caller holds _ref_lock (every call site takes it; the flush
+        # callback clears the flag under it too) — locked, just not
+        # lexically here, which is past what rtrace can see
         if self._ref_flush_scheduled or self._closed:
             return
+        # rtlint: disable-next=RT301
         self._ref_flush_scheduled = True
         try:
             self._loop.call_soon_threadsafe(
@@ -3046,7 +3054,9 @@ class Runtime:
                 self._flush_ref_events,
             )
         except RuntimeError:
-            self._ref_flush_scheduled = False  # loop closing
+            # loop closing; same caller-held _ref_lock as the set above
+            # rtlint: disable-next=RT301
+            self._ref_flush_scheduled = False
 
     def _flush_ref_events(self):
         with self._ref_lock:

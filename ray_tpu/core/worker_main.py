@@ -750,24 +750,20 @@ class WorkerServer:
                 await asyncio.sleep(0.02)
             state = await loop.run_in_executor(self._exec, ck)
             s = self.rt.serialize(state)
-            if self._ckpt_blob_oid is not None:
-                # a previous capture's object-plane blob was never
-                # consumed (its reply was lost, or that drain fell over
-                # before the restore): this process is still alive, so
-                # that migration never happened — free the orphan
-                # instead of leaking a protected primary in the node
-                # arena, whatever size THIS capture turns out to be
-                # (double-free of a consumed blob is a benign tombstone
-                # hit)
-                try:
-                    await self.rt.gcs.call(
-                        "free_objects",
-                        {"object_ids": [self._ckpt_blob_oid]},
-                        timeout=10.0,
-                    )
-                except Exception:
-                    pass
-                self._ckpt_blob_oid = None
+            # a previous capture's object-plane blob was never consumed
+            # (its reply was lost, or that drain fell over before the
+            # restore): this process is still alive, so that migration
+            # never happened — free the orphan instead of leaking a
+            # protected primary in the node arena, whatever size THIS
+            # capture turns out to be (double-free of a consumed blob is
+            # a benign tombstone hit).  Swap-then-free, NOT
+            # check-free-clear: the free awaits GCS, and a concurrent
+            # capture (rpc retry after a lost reply) or abort runs on
+            # this same loop during that await — clearing AFTER it acts
+            # on a stale pre-await read and stomps whatever they set,
+            # orphaning a tracked blob (rtlint RT302)
+            orphan, self._ckpt_blob_oid = self._ckpt_blob_oid, None
+            await self._free_ckpt_blob(orphan)
             if s.total_bytes > cfg.actor_ckpt_inline_max_bytes:
                 from ray_tpu.common.ids import ObjectID
 
@@ -779,7 +775,11 @@ class WorkerServer:
                     lambda: self.rt._write_to_store(oid, s,
                                                     urgent_announce=True),
                 )
-                self._ckpt_blob_oid = oid
+                # same swap discipline as above: a concurrent capture may
+                # have tracked ITS blob during the store await; free it
+                # as we take over tracking, or it leaks untracked
+                stale, self._ckpt_blob_oid = self._ckpt_blob_oid, oid
+                await self._free_ckpt_blob(stale)
                 logger.info(
                     "actor %s checkpoint blob (%d bytes) stored in the "
                     "object plane as %s", self.actor_id, s.total_bytes,
@@ -797,6 +797,21 @@ class WorkerServer:
             self._ckpt_sealed = False
             self._ckpt_unseal.set()
             raise
+
+    async def _free_ckpt_blob(self, oid: Optional[bytes]) -> None:
+        """Best-effort free of an orphaned checkpoint blob.  Callers
+        must have already swapped the oid out of ``_ckpt_blob_oid``
+        BEFORE awaiting this (so a concurrent capture/abort never sees
+        — and double-handles — an oid that is being freed)."""
+        if oid is None:
+            return
+        try:
+            await self.rt.gcs.call(
+                "free_objects", {"object_ids": [oid]}, timeout=10.0
+            )
+        except Exception:
+            # unreachable GCS: the node's death still bounds the orphan
+            pass
 
     async def handle_checkpoint_abort(self) -> bool:
         """GCS → worker: the migration this capture was for is NOT

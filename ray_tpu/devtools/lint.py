@@ -6,19 +6,26 @@ this codebase has actually shipped (event-loop blocking, non-atomic
 persists, impure traced functions, ...).  Findings carry ``file:line``,
 a stable rule id, and a fix hint.
 
-Two tiers share this CLI: the per-file rules below (RT1xx), and the
+Three tiers share this CLI: the per-file rules below (RT1xx); the
 whole-program ``rtflow`` tier (RT2xx, ``ray_tpu.devtools.flow``) which
 indexes the full package into a call graph and runs interprocedural
 rules (actor deadlock cycles, ObjectRef leaks, unserializable captures,
-rank-divergent collectives).  ``--flow`` runs both.
+rank-divergent collectives); and the concurrency ``rtrace`` tier
+(RT3xx, ``ray_tpu.devtools.trace``) which classifies functions by
+execution plane (io loop / executor threads / caller threads), checks
+cross-plane state hand-offs, and runs a lock-order checker over the
+native ``_native/*.cc`` sources.  ``--flow`` / ``--trace`` add a tier;
+``--all`` runs every tier.
 
 CLI::
 
     python -m ray_tpu.devtools.lint ray_tpu            # text report
     python -m ray_tpu.devtools.lint --flow ray_tpu     # + RT2xx tier
+    python -m ray_tpu.devtools.lint --trace ray_tpu    # + RT3xx tier
+    python -m ray_tpu.devtools.lint --all ray_tpu      # every tier
     python -m ray_tpu.devtools.lint ray_tpu --format json
     python -m ray_tpu.devtools.lint ray_tpu --format sarif  # CI annotations
-    python -m ray_tpu.devtools.lint --flow ray_tpu --changed-only
+    python -m ray_tpu.devtools.lint --all ray_tpu --changed-only
     python -m ray_tpu.devtools.lint --list-rules
     python -m ray_tpu.devtools.lint ray_tpu --write-baseline
 
@@ -381,8 +388,12 @@ def write_baseline(findings: List[Finding], path: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+_LINTABLE_SUFFIXES = (".py", ".cc", ".cpp", ".cxx", ".h", ".hpp")
+
+
 def git_changed_files() -> Optional[set]:
-    """Absolute paths of .py files that are dirty (``git diff
+    """Absolute paths of lintable files (.py plus the native C++
+    suffixes the trace tier checks) that are dirty (``git diff
     --name-only HEAD``) or untracked (``git ls-files --others
     --exclude-standard`` — a brand-new module is the MOST important
     file in the edit loop), or None when git (or a repo) is
@@ -411,7 +422,7 @@ def git_changed_files() -> Optional[set]:
             out.update(
                 os.path.abspath(os.path.join(root, line.strip()))
                 for line in proc.stdout.splitlines()
-                if line.strip().endswith(".py")
+                if line.strip().endswith(_LINTABLE_SUFFIXES)
             )
         return out
     except (OSError, subprocess.SubprocessError):
@@ -432,26 +443,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--flow", action="store_true",
                         help="also run the whole-program rtflow tier "
                              "(RT2xx interprocedural rules)")
+    parser.add_argument("--trace", action="store_true",
+                        help="also run the rtrace concurrency tier "
+                             "(RT3xx plane/race rules plus the native "
+                             "lock-order checker over _native/*.cc)")
+    parser.add_argument("--all", action="store_true", dest="all_tiers",
+                        help="run every tier (equivalent to --flow "
+                             "--trace)")
     parser.add_argument("--changed-only", action="store_true",
                         help="report only on files dirty per `git diff "
-                             "--name-only HEAD` (flow still indexes the "
-                             "whole tree for cross-module edges); falls "
-                             "back to everything when git is unavailable")
+                             "--name-only HEAD` (flow/trace still index "
+                             "the whole tree for cross-module edges); "
+                             "falls back to everything when git is "
+                             "unavailable")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
                         help="baseline JSON path (RT1xx tier)")
     parser.add_argument("--flow-baseline", default=None,
                         help="baseline JSON path for the flow tier "
                              "(default: flow/flow_baseline.json)")
+    parser.add_argument("--trace-baseline", default=None,
+                        help="baseline JSON path for the trace tier "
+                             "(default: trace/trace_baseline.json)")
     parser.add_argument("--no-baseline", action="store_true",
                         help="ignore the baseline file(s)")
     parser.add_argument("--write-baseline", action="store_true",
                         help="regenerate the baseline(s) from this run")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
+    if args.all_tiers:
+        args.flow = True
+        args.trace = True
 
     flow_mod = None
+    trace_mod = None
     if args.flow or args.list_rules:
         from ray_tpu.devtools import flow as flow_mod  # lazy: index cost
+    if args.trace or args.list_rules:
+        from ray_tpu.devtools import trace as trace_mod
 
     if args.list_rules:
         for rule in all_rules():
@@ -461,6 +489,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"    hint: {rule.hint}")
         for rule in flow_mod.all_flow_rules():
             print(f"{rule.id}  {rule.name}  [whole-program, --flow]")
+            print(f"    {rule.description}")
+            print(f"    hint: {rule.hint}")
+        for rule in trace_mod.all_trace_rules():
+            scope = (
+                "native, --trace" if rule.kind == "native"
+                else "whole-program, --trace"
+            )
+            print(f"{rule.id}  {rule.name}  [{scope}]")
             print(f"    {rule.description}")
             print(f"    hint: {rule.hint}")
         return 0
@@ -494,14 +530,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "everything", file=sys.stderr,
             )
 
-    # partition --rules between the tiers when --flow is active
+    # partition --rules between the active tiers
     only_file = only
     only_flow = None
-    if args.flow:
-        flow_ids = set(flow_mod.flow_rule_ids())
+    only_trace = None
+    if args.flow or args.trace:
+        flow_ids = set(flow_mod.flow_rule_ids()) if args.flow else set()
+        trace_ids = (
+            set(trace_mod.trace_rule_ids()) if args.trace else set()
+        )
         if only is not None:
-            only_file = [r for r in only if r not in flow_ids]
+            only_file = [
+                r for r in only
+                if r not in flow_ids and r not in trace_ids
+            ]
             only_flow = [r for r in only if r in flow_ids]
+            only_trace = [r for r in only if r in trace_ids]
 
     findings: List[Finding] = []
     files_scanned = 0
@@ -532,6 +576,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 e for e in flow_report.parse_errors
                 if e not in parse_errors
             )
+        if args.trace and (only is None or only_trace):
+            trace_report = trace_mod.analyze_paths(
+                paths, rules=only_trace
+            )
+            trace_findings = trace_report.findings
+            if file_filter is not None:
+                # same narrowing as flow: planes need the whole index,
+                # reporting narrows to dirty files
+                trace_findings = [
+                    f for f in trace_findings
+                    if os.path.abspath(f.path) in file_filter
+                ]
+            findings.extend(trace_findings)
+            files_scanned = max(
+                files_scanned, trace_report.files_indexed
+            )
+            parse_errors.extend(
+                e for e in trace_report.parse_errors
+                if e not in parse_errors
+            )
     except ValueError as e:
         print(f"rtlint: {e}", file=sys.stderr)
         return 2
@@ -540,28 +604,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     flow_baseline_path = args.flow_baseline
     if flow_baseline_path is None and args.flow:
         flow_baseline_path = flow_mod.DEFAULT_FLOW_BASELINE
+    trace_baseline_path = args.trace_baseline
+    if trace_baseline_path is None and args.trace:
+        trace_baseline_path = trace_mod.DEFAULT_TRACE_BASELINE
 
     if args.write_baseline:
+        # each tier owns its own baseline file, keyed by rule-id prefix
+        file_findings = [
+            f for f in findings
+            if not f.rule.startswith(("RT2", "RT3"))
+        ]
+        wrote = []
+        write_baseline(file_findings, args.baseline)
+        wrote.append(f"{len(file_findings)} finding(s) to {args.baseline}")
         if args.flow:
-            file_findings = [
-                f for f in findings if not f.rule.startswith("RT2")
-            ]
             flow_findings = [
                 f for f in findings if f.rule.startswith("RT2")
             ]
-            write_baseline(file_findings, args.baseline)
             write_baseline(flow_findings, flow_baseline_path)
-            print(
-                f"rtlint: wrote {len(file_findings)} finding(s) to "
-                f"{args.baseline} and {len(flow_findings)} to "
-                f"{flow_baseline_path}"
+            wrote.append(f"{len(flow_findings)} to {flow_baseline_path}")
+        if args.trace:
+            trace_findings = [
+                f for f in findings if f.rule.startswith("RT3")
+            ]
+            write_baseline(trace_findings, trace_baseline_path)
+            wrote.append(
+                f"{len(trace_findings)} to {trace_baseline_path}"
             )
-        else:
-            write_baseline(findings, args.baseline)
-            print(
-                f"rtlint: wrote {len(findings)} finding(s) to "
-                f"{args.baseline}"
-            )
+        print("rtlint: wrote " + " and ".join(wrote))
         return 0
 
     baseline: Counter = Counter()
@@ -569,6 +639,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         baseline += load_baseline(args.baseline)
         if args.flow:
             baseline += load_baseline(flow_baseline_path)
+        if args.trace:
+            baseline += load_baseline(trace_baseline_path)
     new, grandfathered = split_baselined(findings, baseline)
 
     if args.format == "json":
@@ -590,6 +662,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         rules_meta = list(all_rules())
         if args.flow:
             rules_meta.extend(flow_mod.all_flow_rules())
+        if args.trace:
+            rules_meta.extend(trace_mod.all_trace_rules())
         print(json.dumps(
             render_sarif(new, grandfathered, rules_meta), indent=2,
         ))

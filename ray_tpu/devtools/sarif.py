@@ -1,10 +1,11 @@
-"""SARIF 2.1.0 output for rtlint/rtflow findings.
+"""SARIF 2.1.0 output for rtlint/rtflow/rtrace findings.
 
 SARIF is the interchange format CI systems (GitHub code scanning,
 Azure, Gitlab) render as inline PR annotations.  One run object carries
-both tiers; baselined findings are included but marked with an
-``external`` suppression so dashboards show them as accepted debt
-instead of new violations.
+every active tier (per-file RT1xx, whole-program RT2xx, concurrency
+RT3xx — including the native C++ lock-order findings); baselined
+findings are included but marked with an ``external`` suppression so
+dashboards show them as accepted debt instead of new violations.
 """
 
 from __future__ import annotations
@@ -62,8 +63,9 @@ def render_sarif(
     new: Sequence, baselined: Sequence, rules: Iterable
 ) -> dict:
     """Build the SARIF document for one lint invocation.  ``rules`` is
-    every rule object that COULD have fired (both tiers when --flow ran)
-    so rule metadata stays stable across runs."""
+    every rule object that COULD have fired (every active tier, e.g.
+    all three under --all) so rule metadata stays stable across
+    runs."""
     results: List[dict] = []
     for f in new:
         results.append(_result(f, suppressed=False))

@@ -1,0 +1,39 @@
+"""rtrace: the concurrency-analysis tier (RT3xx).
+
+The per-file tier (RT1xx) sees one module; the flow tier (RT2xx) sees
+the remote surface.  This third tier sees *threads*: it classifies
+every function by execution plane — the rt-io event loop, executor
+threads, caller threads entering the sync API — and checks the
+hand-off discipline between them, plus the native shm arena's
+documented lock order.
+
+- RT301 cross-plane-unlocked-mutation: an attribute rebound from two
+  planes with no lock and no ``call_soon_threadsafe`` funnel.
+- RT302 await-gap-check-then-act: ``self._x`` checked before an
+  ``await`` and acted on after it (the PR 13 drain-fence TOCTOU).
+- RT303 oneshot-rebound-under-waiters: an ``asyncio.Event``/``Future``
+  attribute replaced while waiters may be parked on the old instance.
+- RT304 native-lock-order: a ``MainLock``/``ShardLock``/``LedgerLock``
+  scope in ``_native/*.cc`` acquired against MAIN < shard < ledger.
+
+Findings ride the same ``Finding`` type, suppression comments, and
+baseline machinery as the other tiers; run everything with::
+
+    python -m ray_tpu.devtools.lint --all ray_tpu
+"""
+
+from ray_tpu.devtools.trace.engine import (  # noqa: F401
+    DEFAULT_TRACE_BASELINE,
+    TraceReport,
+    all_trace_rules,
+    analyze_paths,
+    analyze_sources,
+    trace_rule_ids,
+)
+from ray_tpu.devtools.trace.planes import (  # noqa: F401
+    CALLER,
+    EXEC,
+    LOOP,
+    PlaneMap,
+    build_planes,
+)

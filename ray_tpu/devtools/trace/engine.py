@@ -1,0 +1,268 @@
+"""rtrace engine: plane classification + RT3xx concurrency rules over
+the whole-program index, plus the native lock-order checker over
+``_native`` C++ sources.  Findings ride the SAME Finding/suppression/
+fingerprint machinery as the RT1xx/RT2xx tiers; C++ files honor the
+same directives inside ``//`` comments
+(``// rtlint: disable-next=RT304``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.devtools.lint import (
+    _SUPPRESS_RE,
+    Finding,
+    _apply_suppressions,
+)
+
+DEFAULT_TRACE_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "trace_baseline.json"
+)
+
+NATIVE_SUFFIXES = (".cc", ".cpp", ".cxx", ".h", ".hpp")
+
+
+class TraceRule:
+    """Whole-program concurrency rule: ``check(index, planes)`` walks
+    the index with the plane classification and reports through ``add``
+    into the owning module's context (so suppression comments apply)."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    hint: str = ""
+    kind: str = "python"
+
+    def check(self, index, planes) -> None:
+        raise NotImplementedError
+
+    def add(self, module, node, message=None, hint=None) -> None:
+        module.ctx.add(self, node, message=message, hint=hint)
+
+
+class NativeTraceRule(TraceRule):
+    """C++-side rule: ``check_native(path, source)`` returns
+    ``(lineno, col, message)`` tuples; the engine builds Findings and
+    applies ``//``-comment suppressions."""
+
+    kind = "native"
+
+    def check(self, index, planes) -> None:  # pragma: no cover
+        pass
+
+    def check_native(
+        self, path: str, source: str
+    ) -> List[Tuple[int, int, str]]:
+        raise NotImplementedError
+
+
+def all_trace_rules() -> List[TraceRule]:
+    # imported here: the rule modules import TraceRule from this module
+    from ray_tpu.devtools.trace.native import NativeLockOrder
+    from ray_tpu.devtools.trace.oneshot import OneShotReassign
+    from ray_tpu.devtools.trace.races import CrossPlaneMutation
+    from ray_tpu.devtools.trace.toctou import AwaitGapToctou
+
+    return [
+        CrossPlaneMutation(),
+        AwaitGapToctou(),
+        OneShotReassign(),
+        NativeLockOrder(),
+    ]
+
+
+def trace_rule_ids() -> Tuple[str, ...]:
+    return tuple(r.id for r in all_trace_rules())
+
+
+@dataclasses.dataclass
+class TraceReport:
+    findings: List[Finding]
+    files_indexed: int
+    parse_errors: List[str]
+
+
+def _select(rules: Optional[Sequence[str]]) -> List[TraceRule]:
+    selected = all_trace_rules()
+    if rules is not None:
+        wanted = set(rules)
+        unknown = wanted - {r.id for r in selected}
+        if unknown:
+            raise ValueError(f"unknown trace rule id(s): {sorted(unknown)}")
+        selected = [r for r in selected if r.id in wanted]
+    return selected
+
+
+# ---------------------------------------------------------------------------
+# Native-file suppressions (// rtlint: disable=RT304 ...)
+# ---------------------------------------------------------------------------
+
+
+def _native_suppressions(source: str):
+    per_line: Dict[int, set] = {}
+    file_wide: set = set()
+    for i, text in enumerate(source.splitlines(), start=1):
+        pos = text.find("//")
+        if pos < 0:
+            continue
+        # _SUPPRESS_RE anchors on the Python comment marker; present
+        # the C++ comment body as one
+        m = _SUPPRESS_RE.search("# " + text[pos + 2:])
+        if not m:
+            continue
+        kind, ids_text = m.group(1), m.group(2)
+        ids = {s.strip() for s in ids_text.split(",")}
+        if kind == "disable":
+            per_line.setdefault(i, set()).update(ids)
+        elif kind == "disable-next":
+            per_line.setdefault(i + 1, set()).update(ids)
+        else:
+            file_wide.update(ids)
+    return per_line, file_wide
+
+
+def _check_native_file(
+    path: str, source: str, rules: Sequence[NativeTraceRule]
+) -> List[Finding]:
+    lines = source.splitlines()
+    per_line, file_wide = _native_suppressions(source)
+    out: List[Finding] = []
+    for rule in rules:
+        for lineno, col, message in rule.check_native(path, source):
+            ids = per_line.get(lineno, set()) | file_wide
+            if rule.id in ids or "all" in ids:
+                continue
+            text = lines[lineno - 1] if 1 <= lineno <= len(lines) else ""
+            out.append(Finding(
+                path=path,
+                line=lineno,
+                col=col,
+                rule=rule.id,
+                message=message,
+                hint=rule.hint,
+                line_text=text,
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def _run(py_entries, native_files, rules) -> List[Finding]:
+    """py_entries: (finding_path, module_name, source, tree);
+    native_files: (finding_path, source)."""
+    from ray_tpu.devtools.flow.index import build_index
+    from ray_tpu.devtools.trace.planes import build_planes
+
+    selected = _select(rules)
+    py_rules = [r for r in selected if r.kind == "python"]
+    native_rules = [r for r in selected if r.kind == "native"]
+
+    findings: List[Finding] = []
+    if py_entries and py_rules:
+        index = build_index(py_entries)
+        planes = build_planes(index)
+        for rule in py_rules:
+            rule.check(index, planes)
+        for mname in sorted(index.modules):
+            findings.extend(_apply_suppressions(index.modules[mname].ctx))
+    if native_files and native_rules:
+        for path, source in native_files:
+            findings.extend(_check_native_file(path, source, native_rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_sources(
+    files: Dict[str, str], rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Fixture/test entry point: ``files`` maps package-relative paths
+    to sources; ``.py`` paths double as module names, native suffixes
+    route to the C++ checker."""
+    from ray_tpu.devtools.flow.index import module_name_from_relpath
+
+    py_entries = []
+    native_files = []
+    for path in sorted(files):
+        norm = path.replace(os.sep, "/")
+        if norm.endswith(NATIVE_SUFFIXES):
+            native_files.append((norm, files[path]))
+            continue
+        tree = ast.parse(files[path], filename=norm)
+        py_entries.append(
+            (norm, module_name_from_relpath(norm), files[path], tree)
+        )
+    return _run(py_entries, native_files, rules)
+
+
+def _collect_native(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    seen = set()
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(NATIVE_SUFFIXES):
+                out.append(p)
+            continue
+        for root, dirs, fnames in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for f in sorted(fnames):
+                if f.endswith(NATIVE_SUFFIXES):
+                    fp = os.path.join(root, f)
+                    ap = os.path.abspath(fp)
+                    if ap not in seen:
+                        seen.add(ap)
+                        out.append(fp)
+    return out
+
+
+def _finding_path(fpath: str) -> str:
+    rel = fpath
+    if os.path.isabs(fpath):
+        candidate = os.path.relpath(fpath)
+        if not candidate.startswith(".."):
+            rel = candidate
+    return rel.replace(os.sep, "/")
+
+
+def analyze_paths(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> TraceReport:
+    from ray_tpu.devtools.flow.engine import _collect_entries
+    from ray_tpu.devtools.flow.index import module_name_from_relpath
+
+    py_entries = []
+    errors: List[str] = []
+    for finding_path, rel_for_name, apath in _collect_entries(paths):
+        try:
+            with open(apath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=finding_path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{finding_path}: {e}")
+            continue
+        py_entries.append((
+            finding_path,
+            module_name_from_relpath(rel_for_name),
+            source,
+            tree,
+        ))
+    native_files = []
+    for fpath in _collect_native(paths):
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                native_files.append((_finding_path(fpath), fh.read()))
+        except (UnicodeDecodeError, OSError) as e:
+            errors.append(f"{_finding_path(fpath)}: {e}")
+    findings = _run(py_entries, native_files, rules)
+    return TraceReport(
+        findings, len(py_entries) + len(native_files), errors
+    )

@@ -1,0 +1,212 @@
+"""RT304: lexical RAII lock-order checker for the native shm arena.
+
+``_native/shm_store.cc`` documents a strict acquisition order —
+**MAIN < shard < ledger** — with one sanctioned composite: stop-world
+takes MAIN and then every shard ascending (the ``StopWorld`` RAII
+guard).  Every historical near-miss in review was an unwind path that
+re-entered the allocator (MAIN) while still inside a shard or ledger
+scope, so the checker tracks exactly that: brace-scoped lifetimes of
+``MainLock`` / ``ShardLock`` / ``LedgerLock`` declarations plus raw
+``lock_robust`` / ``pthread_mutex_lock`` / ``pthread_mutex_unlock``
+calls, classifying each mutex expression as MAIN (``hdr()->mutex``),
+shard (``shards[i].mutex``) or ledger (``ledger_mu``).
+
+Violations:
+
+- MAIN acquired while MAIN, a shard, or the ledger is held (order
+  inversion / self-deadlock — these mutexes are not recursive);
+- a shard acquired while the ledger is held (order inversion);
+- a second shard acquired while one is held (only stop-world may hold
+  multiple shards, and its ascending loop releases per lexical scope);
+- the ledger acquired while the ledger is held (self-deadlock).
+
+Approximations (documented, deliberate): raw ``lock_robust`` /
+``pthread_mutex_lock`` acquisitions are scoped to their enclosing
+brace like an RAII guard (this is how every live call site behaves,
+and it sanctions the stop-world ascending loop), and calls are not
+followed interprocedurally — a helper that takes MAIN internally
+documents that contract in a comment, same as the source does today.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from ray_tpu.devtools.trace.engine import NativeTraceRule
+
+MAIN, SHARD, LEDGER = "MAIN", "shard", "ledger"
+
+_DECL_RE = re.compile(r"\b(MainLock|ShardLock|LedgerLock)\s+\w+\s*[({]")
+_RAW_LOCK_RE = re.compile(
+    r"\b(?:lock_robust|pthread_mutex_lock)\s*\(\s*([^()]*(?:\([^()]*\))?"
+    r"[^()]*)\)"
+)
+_UNLOCK_RE = re.compile(
+    r"\bpthread_mutex_unlock\s*\(\s*([^()]*(?:\([^()]*\))?[^()]*)\)"
+)
+_DECL_KIND = {"MainLock": MAIN, "ShardLock": SHARD, "LedgerLock": LEDGER}
+
+
+def _classify(mutex_expr: str) -> str:
+    if "shard" in mutex_expr:
+        return SHARD
+    if "ledger" in mutex_expr:
+        return LEDGER
+    return MAIN
+
+
+def strip_code(source: str) -> str:
+    """Blank out comments, string and char literals (preserving line
+    structure) so brace/lock scanning never trips on their contents."""
+    out = []
+    i, n = 0, len(source)
+    state = None  # None | "line" | "block" | '"' | "'"
+    while i < n:
+        c = source[i]
+        nxt = source[i + 1] if i + 1 < n else ""
+        if state is None:
+            if c == "/" and nxt == "/":
+                state = "line"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                state = "block"
+                out.append("  ")
+                i += 2
+                continue
+            if c in "\"'":
+                state = c
+                out.append(" ")
+                i += 1
+                continue
+            out.append(c)
+        elif state == "line":
+            if c == "\n":
+                state = None
+                out.append(c)
+            else:
+                out.append(" ")
+        elif state == "block":
+            if c == "*" and nxt == "/":
+                state = None
+                out.append("  ")
+                i += 2
+                continue
+            out.append(c if c == "\n" else " ")
+        else:  # string/char literal
+            if c == "\\":
+                out.append("  ")
+                i += 2
+                continue
+            if c == state:
+                state = None
+            out.append(c if c == "\n" else " ")
+        i += 1
+    return "".join(out)
+
+
+class _Held:
+    __slots__ = ("kind", "depth", "line", "raw")
+
+    def __init__(self, kind: str, depth: int, line: int, raw: bool):
+        self.kind = kind
+        self.depth = depth
+        self.line = line
+        self.raw = raw
+
+
+class NativeLockOrder(NativeTraceRule):
+    id = "RT304"
+    name = "native-lock-order"
+    description = (
+        "shm arena lock acquired against the documented MAIN < shard "
+        "< ledger order (or re-acquired while already held)"
+    )
+    hint = (
+        "close the inner scope before taking the outer lock; only "
+        "StopWorld may hold MAIN plus shards (ascending)"
+    )
+
+    def check_native(
+        self, path: str, source: str
+    ) -> List[Tuple[int, int, str]]:
+        clean = strip_code(source)
+        findings: List[Tuple[int, int, str]] = []
+        held: List[_Held] = []
+        depth = 0
+        for lineno, line in enumerate(clean.splitlines(), start=1):
+            events: List[Tuple[int, str, Optional[str]]] = []
+            for m in _DECL_RE.finditer(line):
+                events.append(
+                    (m.start(), "acquire", _DECL_KIND[m.group(1)])
+                )
+            for m in _RAW_LOCK_RE.finditer(line):
+                # a parameter list ("void lock_robust(pthread_mutex_t*
+                # m)") is a definition, not an acquisition
+                if "pthread_mutex_t" in m.group(1):
+                    continue
+                events.append(
+                    (m.start(), "raw-acquire", _classify(m.group(1)))
+                )
+            for m in _UNLOCK_RE.finditer(line):
+                events.append((m.start(), "unlock", _classify(m.group(1))))
+            for col, ch in enumerate(line):
+                if ch == "{":
+                    events.append((col, "open", None))
+                elif ch == "}":
+                    events.append((col, "close", None))
+            events.sort(key=lambda e: e[0])
+            for col, kind, lock in events:
+                if kind == "open":
+                    depth += 1
+                elif kind == "close":
+                    depth -= 1
+                    held[:] = [h for h in held if h.depth <= depth]
+                elif kind == "unlock":
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i].kind == lock and held[i].raw:
+                            del held[i]
+                            break
+                else:
+                    msg = self._violation(lock, held)
+                    if msg is not None:
+                        findings.append((lineno, col + 1, msg))
+                    held.append(_Held(
+                        lock, depth, lineno, kind == "raw-acquire",
+                    ))
+        return findings
+
+    def _violation(self, lock: str, held: List[_Held]) -> Optional[str]:
+        if not held:
+            return None
+        if lock == MAIN:
+            worst = held[-1]
+            return (
+                f"MAIN acquired while {worst.kind} (line {worst.line}) "
+                f"is held — lock order is MAIN < shard < ledger"
+            )
+        if lock == SHARD:
+            for h in held:
+                if h.kind == LEDGER:
+                    return (
+                        f"shard acquired while ledger (line {h.line}) "
+                        f"is held — lock order is MAIN < shard < ledger"
+                    )
+            for h in held:
+                if h.kind == SHARD:
+                    return (
+                        f"second shard acquired while shard (line "
+                        f"{h.line}) is held — only StopWorld may hold "
+                        f"multiple shards"
+                    )
+            return None
+        # ledger
+        for h in held:
+            if h.kind == LEDGER:
+                return (
+                    f"ledger re-acquired while already held (line "
+                    f"{h.line}) — ledger_mu is not recursive"
+                )
+        return None

@@ -1,0 +1,124 @@
+"""RT303: one-shot synchronization object rebound while waiters may be
+parked on the old instance.
+
+``asyncio.Event`` / ``Future`` (and their threading/concurrent
+equivalents) are waited on BY IDENTITY: a coroutine parked in
+``await self._ev.wait()`` holds a reference to the *object*, not the
+attribute.  Rebinding ``self._ev = asyncio.Event()`` strands every
+parked waiter on the orphaned instance forever — the exact PR 13
+round-2 and round-3 stranded-waiter bug, shipped twice.
+
+A finding fires on any ``self.<attr> = <one-shot ctor>`` outside the
+``__init__`` family when some method of the same class waits on that
+attribute (``await self.<attr>``, ``self.<attr>.wait()``,
+``self.<attr>.result()``).  The compliant pattern — one persistent
+instance, cycled with ``.set()`` / ``.clear()`` — never rebinds and
+stays silent, as does rebinding an attribute nothing ever waits on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.trace.engine import TraceRule
+from ray_tpu.devtools.trace.planes import CTOR_NAMES
+
+_ONESHOT_TYPES = {
+    "asyncio.Event",
+    "asyncio.Future",
+    "threading.Event",
+    "concurrent.futures.Future",
+}
+_WAIT_METHODS = ("wait", "result")
+
+
+def _is_oneshot_ctor(module, expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    if isinstance(f, ast.Attribute) and f.attr == "create_future":
+        return True
+    resolved = module.resolve(f) or astutil.dotted_text(f) or ""
+    if resolved in _ONESHOT_TYPES:
+        return True
+    return any(resolved.endswith("." + t) for t in _ONESHOT_TYPES)
+
+
+def _self_attr(node: ast.AST):
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _waited_attrs(cls) -> Set[str]:
+    out: Set[str] = set()
+    for mname in cls.methods:
+        for node in ast.walk(cls.methods[mname].node):
+            if isinstance(node, ast.Await):
+                attr = _self_attr(node.value)
+                if attr is not None:
+                    out.add(attr)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WAIT_METHODS
+            ):
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+class OneShotReassign(TraceRule):
+    id = "RT303"
+    name = "oneshot-rebound-under-waiters"
+    description = (
+        "one-shot Event/Future attribute rebound outside __init__ "
+        "while other code waits on it by identity — parked waiters "
+        "stay parked on the orphaned instance forever"
+    )
+    hint = (
+        "keep ONE persistent instance and cycle it with .set()/"
+        ".clear(), or resolve the old instance before replacing it"
+    )
+
+    def check(self, index, planes) -> None:
+        for cqual in sorted(index.classes):
+            cls = index.classes[cqual]
+            waited = _waited_attrs(cls)
+            if not waited:
+                continue
+            for mname in sorted(cls.methods):
+                meth = cls.methods[mname]
+                if meth.name in CTOR_NAMES:
+                    continue
+                self._scan_method(cls, meth, waited)
+
+    def _scan_method(self, cls, meth, waited: Set[str]) -> None:
+        for node in ast.walk(meth.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not meth.node and node.name in CTOR_NAMES:
+                    continue
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _is_oneshot_ctor(cls.module, node.value):
+                continue
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None and attr in waited:
+                    self.add(
+                        cls.module,
+                        node,
+                        message=(
+                            f"`{cls.name}.{attr}` is waited on by "
+                            f"identity elsewhere in the class; "
+                            f"rebinding it here strands parked waiters "
+                            f"on the old instance"
+                        ),
+                    )

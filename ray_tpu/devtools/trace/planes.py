@@ -1,0 +1,258 @@
+"""Execution-plane classification for the rtrace tier.
+
+The runtime has exactly three kinds of thread a Python frame can run
+on (docs/architecture.md "Concurrency model"):
+
+- ``loop``   — the rt-io event loop thread: every coroutine body, every
+  ``loop.call_soon`` / ``call_soon_threadsafe`` / ``call_later``
+  callback.
+- ``exec``   — executor threads: sync actor methods (the worker's
+  ``rt-exec`` pool, concurrency-group pools), anything shipped through
+  ``run_in_executor`` / ``asyncio.to_thread`` / ``<pool>.submit`` /
+  ``threading.Thread(target=...)``, and plain ``@remote`` task bodies.
+- ``caller`` — user threads entering the public sync API of a class
+  that bridges onto a loop with ``run_coroutine_threadsafe`` (the
+  ``Runtime`` facade pattern).
+
+Classification is seeded from those dispatch-site shapes, then
+propagated caller -> callee over the sync call graph to a fixpoint, so
+a private helper invoked from both a coroutine and an executor-shipped
+method is known to run on both planes.  Nested ``def``s handed to a
+dispatch primitive get a per-node plane override (they do NOT inherit
+the enclosing function's planes); nested defs that are only called
+inline inherit the enclosing planes.
+
+An unreached function has no plane and contributes nothing — precision
+over recall, same contract as the flow tier.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.devtools import astutil
+
+LOOP = "loop"
+EXEC = "exec"
+CALLER = "caller"
+
+# method names whose body runs before the object is reachable from any
+# other plane (construction happens-before publication)
+CTOR_NAMES = frozenset(
+    {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+)
+
+
+class PlaneMap:
+    """qualname -> plane set, plus per-AST-node overrides for nested
+    defs/lambdas that a dispatch primitive ships to a specific plane."""
+
+    def __init__(self) -> None:
+        self.planes: Dict[object, Set[str]] = {}
+        self.overrides: Dict[ast.AST, str] = {}
+
+    def of(self, key: object) -> Set[str]:
+        return self.planes.get(key, set())
+
+    def add(self, key: object, plane: str) -> bool:
+        s = self.planes.setdefault(key, set())
+        if plane in s:
+            return False
+        s.add(plane)
+        return True
+
+
+def _uses_bridge(cls_node: ast.ClassDef) -> bool:
+    """Does this class hand coroutines to a loop it owns?  That is the
+    signature of a caller-thread facade (``Runtime._run``)."""
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Attribute):
+            if node.attr == "run_coroutine_threadsafe":
+                return True
+        elif isinstance(node, ast.Name):
+            if node.id == "run_coroutine_threadsafe":
+                return True
+    return False
+
+
+_POOLISH = ("exec", "pool", "thread")
+
+
+def _dispatch_args(call: ast.Call) -> Optional[Tuple[str, List[ast.AST]]]:
+    """(plane, [callable exprs]) when ``call`` is a dispatch primitive
+    that moves its argument onto a specific plane, else None."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        a = f.attr
+        if a in ("call_soon", "call_soon_threadsafe"):
+            return (LOOP, call.args[:1])
+        if a in ("call_later", "call_at"):
+            return (LOOP, call.args[1:2])
+        if a == "run_in_executor":
+            return (EXEC, call.args[1:2])
+        if a == "to_thread":
+            return (EXEC, call.args[:1])
+        if a == "submit":
+            recv = astutil.dotted_text(f.value) or ""
+            if any(t in recv.lower() for t in _POOLISH):
+                return (EXEC, call.args[:1])
+            return None
+    name = astutil.dotted_text(f) or ""
+    if name == "Thread" or name.endswith(".Thread"):
+        targets = [kw.value for kw in call.keywords if kw.arg == "target"]
+        if targets:
+            return (EXEC, targets)
+        return None
+    if name == "to_thread" or name.endswith(".to_thread"):
+        return (EXEC, call.args[:1])
+    return None
+
+
+def _nested_defs_by_name(fn_node: ast.AST) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn_node):
+        if node is fn_node:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _sync_callee(index, fn, expr: ast.AST):
+    """Resolve a callable expression to a sync FunctionInfo in the
+    index (module function, ``self.<m>``, or method of a typed
+    ``self.<attr>`` receiver).  Async targets return None — coroutine
+    bodies always run on the loop regardless of who created them."""
+    if isinstance(expr, ast.Name):
+        dotted = index.resolve_name(fn.module, expr)
+        tgt = index.functions.get(dotted) if dotted else None
+        if tgt is not None and not tgt.is_async:
+            return tgt
+        return None
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if (
+            isinstance(base, ast.Name)
+            and base.id == "self"
+            and fn.owner is not None
+        ):
+            m = fn.owner.methods.get(expr.attr)
+            if m is not None and not m.is_async:
+                return m
+            return None
+        recv = index.receiver_type(fn.module, base, None, fn.owner)
+        if recv is not None:
+            cls = index.classes.get(recv)
+            if cls is not None:
+                m = cls.methods.get(expr.attr)
+                if m is not None and not m.is_async:
+                    return m
+    return None
+
+
+def _mark_dispatched(index, fn, expr: ast.AST, plane: str, pm: PlaneMap,
+                     nested: Dict[str, ast.AST]) -> None:
+    # functools.partial(f, ...) wraps; classify the wrapped callable
+    if isinstance(expr, ast.Call):
+        nm = astutil.dotted_text(expr.func) or ""
+        if nm == "partial" or nm.endswith(".partial"):
+            for sub in expr.args[:1]:
+                _mark_dispatched(index, fn, sub, plane, pm, nested)
+        return
+    if isinstance(expr, ast.Lambda):
+        pm.overrides[expr] = plane
+        return
+    if isinstance(expr, ast.Name) and expr.id in nested:
+        nd = nested[expr.id]
+        if not isinstance(nd, ast.AsyncFunctionDef):
+            pm.overrides[nd] = plane
+        return
+    tgt = _sync_callee(index, fn, expr)
+    if tgt is not None:
+        pm.add(tgt.qualname, plane)
+
+
+def _collect_edges(index, fn, pm: PlaneMap, edges: list) -> None:
+    """(source key, callee qualname) edges for the sync call graph.
+    The source key switches to a pseudo node when descending into a
+    nested def that a dispatch primitive placed on a fixed plane."""
+
+    def walk(node: ast.AST, src: object) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ov = pm.overrides.get(child)
+                if ov is not None:
+                    key = (fn.qualname, child.name, child.lineno)
+                    pm.planes.setdefault(key, set()).add(ov)
+                    walk(child, key)
+                else:
+                    walk(child, src)
+                continue
+            if isinstance(child, ast.Call):
+                if _dispatch_args(child) is None:
+                    tgt = _sync_callee(index, fn, child.func)
+                    if tgt is not None:
+                        edges.append((src, tgt.qualname))
+            walk(child, src)
+
+    walk(fn.node, fn.qualname)
+
+
+def build_planes(index) -> PlaneMap:
+    pm = PlaneMap()
+
+    # ---- seeds ----------------------------------------------------------
+    for qual in sorted(index.functions):
+        fn = index.functions[qual]
+        if fn.is_async:
+            pm.add(qual, LOOP)
+        elif fn.is_remote and not fn.name.startswith("_"):
+            # public sync actor methods + plain remote task bodies run
+            # on a worker executor thread
+            pm.add(qual, EXEC)
+
+    for cqual in sorted(index.classes):
+        cls = index.classes[cqual]
+        if not _uses_bridge(cls.node):
+            continue
+        for name in sorted(cls.methods):
+            meth = cls.methods[name]
+            if not name.startswith("_") and not meth.is_async:
+                pm.add(meth.qualname, CALLER)
+
+    # ---- dispatch sites (also records nested-def overrides) -------------
+    for qual in sorted(index.functions):
+        fn = index.functions[qual]
+        nested = _nested_defs_by_name(fn.node)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _dispatch_args(node)
+            if hit is None:
+                continue
+            plane, exprs = hit
+            for expr in exprs:
+                _mark_dispatched(index, fn, expr, plane, pm, nested)
+
+    # ---- caller -> callee propagation to fixpoint -----------------------
+    edges: List[Tuple[object, str]] = []
+    for qual in sorted(index.functions):
+        fn = index.functions[qual]
+        if fn.is_async:
+            # the coroutine body is LOOP; its sync callees inherit LOOP
+            # through the edge below, not through an override
+            pass
+        _collect_edges(index, fn, pm, edges)
+
+    changed = True
+    while changed:
+        changed = False
+        for src, dst in edges:
+            dst_fn = index.functions.get(dst)
+            if dst_fn is None or dst_fn.is_async:
+                continue
+            for plane in pm.of(src):
+                if pm.add(dst, plane):
+                    changed = True
+    return pm

@@ -1,0 +1,181 @@
+"""RT301: instance/module attribute rebound from two execution planes
+with no lock (and no loop hand-off) on at least one side.
+
+The walker visits every planed function body, carrying the current
+plane set (switching to a nested def's dispatch override when entering
+one) and a lexical lock depth (``with <lockish>:`` regions).  A
+mutation site is a plain rebind — ``self.x = ...``, ``self.x += ...``,
+``del self.x``, or a declared-``global`` assignment.  Container method
+calls (``self.q.append``) are deliberately NOT mutations here: the
+GIL-atomic deque/dict protocols the runtime documents would all flag,
+and torn *rebinds* are the class PRs 7-13 actually shipped.
+
+A finding fires per unlocked mutation site of any attribute whose
+mutation sites span >= 2 planes.  ``__init__``-family bodies are exempt
+(construction happens-before publication), as are lock-named
+attributes.  The ``call_soon_threadsafe`` hand-off needs no special
+case: a callback handed to the loop IS classified ``loop``, so a
+properly funneled attribute collapses to one plane and never fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.trace.engine import TraceRule
+from ray_tpu.devtools.trace.planes import CTOR_NAMES
+
+
+class _Site:
+    __slots__ = ("fn", "node", "planes", "locked")
+
+    def __init__(self, fn, node, planes, locked):
+        self.fn = fn
+        self.node = node
+        self.planes = planes
+        self.locked = locked
+
+
+def _lockish_with(stmt) -> bool:
+    return any(astutil.is_lockish(item.context_expr) for item in stmt.items)
+
+
+def _global_names(fn_node: ast.AST) -> set:
+    out = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _mutation_keys(stmt, owner_qual: Optional[str], module_name: str,
+                   globals_declared: set) -> List[Tuple[tuple, ast.AST]]:
+    """(key, anchor node) per attribute/global this statement rebinds."""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    out: List[Tuple[tuple, ast.AST]] = []
+    for t in targets:
+        if isinstance(t, ast.Tuple):
+            out.extend(
+                _mutation_keys_from_target(
+                    e, owner_qual, module_name, globals_declared
+                )
+                for e in t.elts
+            )
+            out = [x for x in out if x is not None]
+            continue
+        hit = _mutation_keys_from_target(
+            t, owner_qual, module_name, globals_declared
+        )
+        if hit is not None:
+            out.append(hit)
+    return out
+
+
+def _mutation_keys_from_target(
+    t, owner_qual, module_name, globals_declared
+) -> Optional[Tuple[tuple, ast.AST]]:
+    if (
+        isinstance(t, ast.Attribute)
+        and isinstance(t.value, ast.Name)
+        and t.value.id == "self"
+        and owner_qual is not None
+    ):
+        if astutil.is_lockish(t):
+            return None  # rebinding a lock object is a different sin
+        return (("attr", owner_qual, t.attr), t)
+    if isinstance(t, ast.Name) and t.id in globals_declared:
+        return (("global", module_name, t.id), t)
+    return None
+
+
+class CrossPlaneMutation(TraceRule):
+    id = "RT301"
+    name = "cross-plane-unlocked-mutation"
+    description = (
+        "attribute rebound from two execution planes without a lock "
+        "or a call_soon_threadsafe hand-off on this side"
+    )
+    hint = (
+        "hold one lock at every rebind site, or funnel all mutations "
+        "onto the loop with call_soon_threadsafe"
+    )
+
+    def check(self, index, planes) -> None:
+        groups: Dict[tuple, List[_Site]] = {}
+        for qual in sorted(index.functions):
+            fn = index.functions[qual]
+            if fn.name in CTOR_NAMES:
+                continue
+            self._scan(fn, planes, groups)
+        for key in sorted(groups):
+            sites = groups[key]
+            spanned = set()
+            for s in sites:
+                spanned.update(s.planes)
+            if len(spanned) < 2:
+                continue
+            label = "+".join(sorted(spanned))
+            _, owner, attr = key
+            short = owner.rsplit(".", 1)[-1] if key[0] == "attr" else owner
+            for s in sites:
+                if s.locked:
+                    continue
+                self.add(
+                    s.fn.module,
+                    s.node,
+                    message=(
+                        f"`{short}.{attr}` is rebound from planes "
+                        f"{label}; this site holds no lock and is not "
+                        f"funneled through the loop"
+                    ),
+                )
+
+    def _scan(self, fn, planes, groups) -> None:
+        owner_qual = fn.owner.qualname if fn.owner is not None else None
+        module_name = fn.module.name
+        globals_declared = _global_names(fn.node)
+        base_planes = planes.of(fn.qualname)
+
+        def visit(node, cur_planes, lock_depth):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    if child.name in CTOR_NAMES:
+                        continue
+                    ov = planes.overrides.get(child)
+                    nxt = {ov} if ov is not None else cur_planes
+                    visit(child, nxt, lock_depth)
+                    continue
+                if isinstance(child, ast.Lambda):
+                    ov = planes.overrides.get(child)
+                    nxt = {ov} if ov is not None else cur_planes
+                    visit(child, nxt, lock_depth)
+                    continue
+                depth = lock_depth
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    if _lockish_with(child):
+                        depth += 1
+                if cur_planes and isinstance(
+                    child,
+                    (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Delete),
+                ):
+                    for key, anchor in _mutation_keys(
+                        child, owner_qual, module_name, globals_declared
+                    ):
+                        groups.setdefault(key, []).append(_Site(
+                            fn, anchor,
+                            frozenset(cur_planes), depth > 0,
+                        ))
+                visit(child, cur_planes, depth)
+
+        if base_planes or planes.overrides:
+            visit(fn.node, set(base_planes), 0)

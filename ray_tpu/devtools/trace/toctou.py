@@ -1,0 +1,158 @@
+"""RT302: check-then-act on shared attribute state split across an
+``await`` — the TOCTOU shape behind the PR 13 drain-fence bugs.
+
+Inside a coroutine, an ``if`` that tests ``self._x`` makes a decision;
+any ``await`` inside the guarded body yields the loop, and every other
+coroutine (and every ``call_soon_threadsafe`` hand-off) may run and
+change ``self._x`` before the body resumes.  Acting on the stale
+decision afterwards — rebinding ``self._x`` past the await, or in the
+same statement as the await (``self._x = await make()`` under an
+``if self._x is None:`` guard, the async double-lazy-init) — is flagged.
+
+Compliant shapes stay silent: re-checking the attribute in a fresh
+``if`` after the await, holding an ``async with <lock>`` across the
+whole check+act region, and ``while self._x: await ...`` loops (the
+loop re-evaluates its test every iteration by construction).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.trace.engine import TraceRule
+
+
+def _self_attr_reads(expr: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and isinstance(node.ctx, ast.Load)
+        ):
+            out.add(node.attr)
+    return out
+
+
+def _iter_preorder(body) -> List[ast.AST]:
+    """Preorder walk of a statement list that does not descend into
+    nested function/class definitions (separate scopes)."""
+    out: List[ast.AST] = []
+    stack = list(reversed(list(body)))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                   ast.Lambda)
+        ):
+            continue
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+    return out
+
+
+def _mutates_attr(node: ast.AST, attr: str) -> bool:
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = list(node.targets)
+    for t in targets:
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        for e in elts:
+            if (
+                isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self"
+                and e.attr == attr
+            ):
+                return True
+    return False
+
+
+class AwaitGapToctou(TraceRule):
+    id = "RT302"
+    name = "await-gap-check-then-act"
+    description = (
+        "attribute checked before an await and acted on after it — "
+        "the loop ran other coroutines in between and the check is "
+        "stale"
+    )
+    hint = (
+        "re-check the attribute after the await, or hold an "
+        "asyncio.Lock across the whole check-then-act region"
+    )
+
+    def check(self, index, planes) -> None:
+        for qual in sorted(index.functions):
+            fn = index.functions[qual]
+            if not fn.is_async:
+                continue
+            self._scan_stmts(fn, fn.node.body, 0)
+
+    def _scan_stmts(self, fn, body, lock_depth) -> None:
+        for stmt in body:
+            depth = lock_depth
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if any(
+                    astutil.is_lockish(item.context_expr)
+                    for item in stmt.items
+                ):
+                    depth += 1
+            if isinstance(stmt, ast.If) and depth == 0:
+                for attr in sorted(_self_attr_reads(stmt.test)):
+                    self._check_guard(fn, stmt, attr)
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(stmt, field, None)
+                if not sub:
+                    continue
+                if field == "handlers":
+                    for h in sub:
+                        self._scan_stmts(fn, h.body, depth)
+                else:
+                    self._scan_stmts(fn, sub, depth)
+
+    def _check_guard(self, fn, if_stmt: ast.If, attr: str) -> None:
+        nodes = _iter_preorder(if_stmt.body)
+        # regions freshly re-guarded by a nested test of the same attr
+        rechecked: Set[int] = set()
+        for node in nodes:
+            if (
+                isinstance(node, (ast.If, ast.While))
+                and attr in _self_attr_reads(node.test)
+            ):
+                rechecked.update(id(sub) for sub in ast.walk(node))
+                rechecked.discard(id(node))
+        await_seen = False
+        for node in nodes:
+            if isinstance(node, ast.Await) and id(node) not in rechecked:
+                await_seen = True
+                continue
+            if id(node) in rechecked:
+                continue
+            if not _mutates_attr(node, attr):
+                continue
+            gapped = await_seen
+            if not gapped and isinstance(node, (ast.Assign, ast.AugAssign)):
+                # `self._x = await make()` — the rebind lands after the
+                # value's own await completes
+                gapped = any(
+                    isinstance(sub, ast.Await)
+                    for sub in ast.walk(node.value)
+                )
+            if gapped:
+                self.add(
+                    fn.module,
+                    node,
+                    message=(
+                        f"`self.{attr}` was checked at line "
+                        f"{if_stmt.lineno} but the loop ran between "
+                        f"check and act (await in the gap); this "
+                        f"rebind acts on a stale read"
+                    ),
+                )

@@ -185,7 +185,13 @@ class DeploymentResponse:
                 if self._attempts <= 0:
                     self._settle()
                     raise
-                self._replica, self._ref = self._redispatch()
+                # under _dispatch_lock like _ensure_dispatched: a lazy
+                # response can be consumed from a driver thread AND the
+                # io loop at once (gather + chain), and two unlocked
+                # failovers would both redispatch — the losing rebind's
+                # request is orphaned and its in-flight count leaks
+                with self._dispatch_lock:
+                    self._replica, self._ref = self._redispatch()
                 continue
             except Exception:
                 self._settle()
@@ -232,9 +238,15 @@ class DeploymentResponse:
                     self._settle()
                     raise
                 loop = asyncio.get_running_loop()
-                self._replica, self._ref = await loop.run_in_executor(
-                    None, self._redispatch
-                )
+
+                def _failover():
+                    # rebind in the executor thread under _dispatch_lock
+                    # (see result()): serializes against a concurrent
+                    # sync-path failover or first dispatch
+                    with self._dispatch_lock:
+                        self._replica, self._ref = self._redispatch()
+
+                await loop.run_in_executor(None, _failover)
                 continue
             except Exception:
                 self._settle()

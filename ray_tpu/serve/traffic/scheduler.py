@@ -139,6 +139,11 @@ class RequestScheduler:
     # -- per-tick flush --------------------------------------------------
     def _schedule_flush(self) -> None:
         if not self._flush_scheduled:
+            # loop-confined despite the sync signature: submit() runs on
+            # the loop (get_running_loop above) and _flush rides
+            # call_soon on that same loop — rtrace's caller-plane seed
+            # for public sync methods over-approximates here
+            # rtlint: disable-next=RT301
             self._flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush)
 
@@ -146,6 +151,8 @@ class RequestScheduler:
         """Dispatch everything dispatchable, EDF order: shed expired
         requests, fill replica capacity least-loaded-first, leave the
         rest queued for the next capacity release / deadline sweep."""
+        # loop-confined; see _schedule_flush
+        # rtlint: disable-next=RT301
         self._flush_scheduled = False
         now = time.monotonic()
         replicas = self._replica_snapshot(now)

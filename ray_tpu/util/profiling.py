@@ -40,6 +40,9 @@ def dump_profile(timeout: float = 1.0) -> Optional[str]:
     if _active is None:
         return None
     prof, path, loop = _active
+    # dev-only tool: enable runs once at process startup, dump once at
+    # shutdown — the planes never actually overlap in time
+    # rtlint: disable-next=RT301
     _active = None
     done = threading.Event()
 

@@ -1,0 +1,171 @@
+// Single-process multi-thread hammer for the shm arena, built to run
+// under ThreadSanitizer.  TSan only instruments one address space, so
+// unlike the fork()ing ASan stress driver this one puts every worker in
+// a thread of the SAME process — each with its own attached client
+// handle — and drives the full lock surface concurrently:
+// create/seal2/get/unpin/delete (MAIN + shard + ledger),
+// reserve_slots/publish_slot/release_slots (the vectored put path),
+// evict pressure (the arena is sized barely above the floor), and
+// reap/stats/list_spillable sweeps (StopWorld).  Exit 0 = clean; any
+// TSan report makes the harness fail on stderr contents.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <pthread.h>
+#include <unistd.h>
+
+extern "C" {
+uint64_t rt_store_min_size();
+void* rt_store_create(const char* path, uint64_t size);
+void* rt_store_attach(const char* path);
+void rt_store_detach(void* h);
+int rt_store_create_object(void* h, const uint8_t* id, uint64_t size,
+                           uint64_t* out_offset);
+int rt_store_seal2(void* h, const uint8_t* id, int protect);
+int rt_store_abort(void* h, const uint8_t* id);
+int rt_store_get(void* h, const uint8_t* id, uint64_t* off, uint64_t* size);
+int rt_store_contains(void* h, const uint8_t* id);
+int rt_store_unpin(void* h, const uint8_t* id);
+int rt_store_delete(void* h, const uint8_t* id);
+int rt_store_reap(void* h);
+void rt_store_stats(void* h, uint64_t* cap, uint64_t* used, uint64_t* objs,
+                    uint64_t* evs);
+int rt_store_protect(void* h, const uint8_t* id, int on);
+uint64_t rt_store_list_spillable(void* h, uint8_t* ids, uint64_t* sizes,
+                                 uint64_t max_n);
+uint64_t rt_store_reserve_slots(void* h, uint64_t slot_size, uint64_t n,
+                                uint64_t* out_offsets);
+void rt_store_release_slots(void* h, const uint64_t* offsets, uint64_t n);
+int rt_store_publish_slot(void* h, const uint8_t* id, uint64_t offset,
+                          uint64_t size, int protect);
+void* rt_store_base(void* h);
+}
+
+static const char* g_path;
+static int g_iters;
+static int g_threads;
+
+static void make_id(uint8_t* id, int space, int worker, int i) {
+  memset(id, 0, 16);
+  id[0] = (uint8_t)space;
+  memcpy(id + 1, &worker, sizeof(worker));
+  memcpy(id + 5, &i, sizeof(i));
+}
+
+static void* hammer(void* arg) {
+  long t = (long)arg;
+  void* h = rt_store_attach(g_path);
+  if (!h) {
+    fprintf(stderr, "thread %ld: attach failed\n", t);
+    return (void*)1;
+  }
+  uint8_t* base = static_cast<uint8_t*>(rt_store_base(h));
+  unsigned seed = 7919u * (unsigned)(t + 1);
+  long failures = 0;
+  for (int i = 0; i < g_iters; i++) {
+    uint8_t id[16];
+    make_id(id, 1, (int)t, i);
+    uint64_t size = 64 + (rand_r(&seed) % (64 * 1024));
+    uint64_t off = 0;
+    if (rt_store_create_object(h, id, size, &off) == 0) {
+      memset(base + off, (int)((t + i) & 0xff), size);
+      if (i % 7 == 0) {
+        rt_store_abort(h, id);
+      } else {
+        rt_store_seal2(h, id, i % 5 == 0 ? 1 : 0);
+        uint64_t goff = 0, gsize = 0;
+        if (rt_store_get(h, id, &goff, &gsize) == 0) {
+          if (gsize != size ||
+              base[goff] != (uint8_t)((t + i) & 0xff) ||
+              base[goff + gsize - 1] != (uint8_t)((t + i) & 0xff)) {
+            fprintf(stderr, "thread %ld: data mismatch iter %d\n", t, i);
+            failures++;
+          }
+          rt_store_unpin(h, id);
+        }
+        if (i % 5 == 0) rt_store_protect(h, id, 0);
+        if (i % 4 == 0) rt_store_delete(h, id);
+      }
+    }
+    // contend on a NEIGHBOR thread's ids too: shared shard entries,
+    // pins, and payload bytes now cross threads, which is the whole
+    // point of a TSan run
+    uint8_t nid[16];
+    make_id(nid, 1, (int)((t + 1) % g_threads), i);
+    uint64_t noff = 0, nsize = 0;
+    if (rt_store_get(h, nid, &noff, &nsize) == 0) {
+      volatile uint8_t sink = base[noff];  // racy read if seal is broken
+      (void)sink;
+      rt_store_unpin(h, nid);
+    }
+    if (i % 9 == 0) {
+      // vectored put path: reserve a strip, publish half, release half
+      uint64_t offs[4] = {0, 0, 0, 0};
+      uint64_t got = rt_store_reserve_slots(h, 4096, 4, offs);
+      for (uint64_t k = 0; k < got; k++) {
+        if (k % 2 == 0) {
+          uint8_t sid[16];
+          make_id(sid, 2, (int)t, i + (int)k);
+          memset(base + offs[k], 0x5A, 4096);
+          if (rt_store_publish_slot(h, sid, offs[k], 4096, 0) != 0)
+            rt_store_release_slots(h, &offs[k], 1);
+        } else {
+          rt_store_release_slots(h, &offs[k], 1);
+        }
+      }
+    }
+    if (i % 13 == 0) {
+      rt_store_reap(h);
+      uint64_t c, u, o, e;
+      rt_store_stats(h, &c, &u, &o, &e);
+      if (u > c) {
+        fprintf(stderr, "thread %ld: used > capacity\n", t);
+        failures++;
+      }
+      uint8_t ids[16 * 32];
+      uint64_t sizes[32];
+      rt_store_list_spillable(h, ids, sizes, 32);
+    }
+  }
+  rt_store_detach(h);
+  return (void*)failures;
+}
+
+int main(int argc, char** argv) {
+  g_path = argc > 1 ? argv[1] : "/dev/shm/rt_tsan_arena";
+  g_threads = argc > 2 ? atoi(argv[2]) : 4;
+  g_iters = argc > 3 ? atoi(argv[3]) : 300;
+  unlink(g_path);
+  // barely above the floor: eviction must actually run under contention
+  uint64_t cap = rt_store_min_size() + (8ull << 20);
+  void* h = rt_store_create(g_path, cap);
+  if (!h) {
+    fprintf(stderr, "create failed\n");
+    return 1;
+  }
+  pthread_t tids[64];
+  if (g_threads > 64) g_threads = 64;
+  for (long t = 0; t < g_threads; t++)
+    pthread_create(&tids[t], nullptr, hammer, (void*)t);
+  long failures = 0;
+  for (int t = 0; t < g_threads; t++) {
+    void* rv = nullptr;
+    pthread_join(tids[t], &rv);
+    failures += (long)rv;
+  }
+  // arena still serviceable after the chaos
+  uint8_t id[16];
+  make_id(id, 3, 999, 1);
+  uint64_t off = 0;
+  if (rt_store_create_object(h, id, 4096, &off) != 0) {
+    fprintf(stderr, "post-chaos create failed\n");
+    failures++;
+  } else {
+    rt_store_seal2(h, id, 0);
+  }
+  rt_store_detach(h);
+  unlink(g_path);
+  return failures ? 1 : 0;
+}

@@ -575,3 +575,92 @@ class TestThreadedActors:
         t0 = _time.monotonic()
         ray_tpu.get([a.nap.remote(0.3) for _ in range(3)], timeout=60)
         assert _time.monotonic() - t0 >= 0.85
+
+
+# ---- checkpoint-capture blob tracking (no cluster needed) ----------------
+
+
+class TestCheckpointBlobTracking:
+    """Regression: concurrent capture RPCs (a GCS retry after a lost
+    reply) must not orphan an object-plane checkpoint blob.  The old
+    code checked ``_ckpt_blob_oid`` before an awaited free and cleared
+    it after — the second capture's stale clear stomped the first's
+    fresh blob tracking, leaking it as a protected primary (rtlint
+    RT302).  The fix swaps the attribute BEFORE every await."""
+
+    def test_concurrent_captures_leak_no_blob(self):
+        import asyncio
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu.common.config import cfg
+        from ray_tpu.core.worker_main import WorkerServer
+
+        class FakeSer:
+            def __init__(self, n):
+                self.total_bytes = n
+
+            def to_bytes(self):
+                return b"x" * self.total_bytes
+
+        class FakeRT:
+            def __init__(self):
+                self.freed = []
+                self.stored = []
+                self.gcs = self
+
+            def serialize(self, state):
+                # always ride the object plane, never inline
+                return FakeSer(cfg.actor_ckpt_inline_max_bytes + 1)
+
+            def _write_to_store(self, oid, s, urgent_announce=False):
+                self.stored.append(oid)
+
+            async def call(self, method, payload, timeout=None):
+                assert method == "free_objects"
+                # widen the interleaving window: the loop runs the
+                # OTHER capture while this free is in flight
+                await asyncio.sleep(0.01)
+                self.freed.extend(payload["object_ids"])
+                return {}
+
+        class Inst:
+            def __rt_checkpoint__(self):
+                return {"state": 1}
+
+            def __rt_restore__(self, state):
+                pass
+
+        old_blob = b"OLD-unconsumed!!"  # 16 bytes, reply was lost
+
+        async def scenario():
+            ws = WorkerServer.__new__(WorkerServer)
+            ws.rt = FakeRT()
+            ws.actor_id = "ckpt-race-test"
+            ws.actor_instance = Inst()
+            ws._exec = ThreadPoolExecutor(max_workers=1)
+            ws._ckpt_sealed = False
+            ws._ckpt_unseal = asyncio.Event()
+            ws._ckpt_unseal.set()
+            ws._actor_exec_inflight = 0
+            ws._ckpt_blob_oid = old_blob
+            try:
+                r1, r2 = await asyncio.gather(
+                    ws.handle_checkpoint_actor({}),
+                    ws.handle_checkpoint_actor({}),
+                )
+            finally:
+                ws._exec.shutdown(wait=True)
+            return ws, r1, r2
+
+        ws, r1, r2 = asyncio.run(scenario())
+        assert r1["supported"] and r2["supported"]
+        assert r1["blob_ref"] != r2["blob_ref"]
+        rt = ws.rt
+        # every blob this process ever tracked or stored is either
+        # freed or still tracked — nothing may leak untracked
+        accounted = set(rt.freed) | {ws._ckpt_blob_oid}
+        leaked = (set(rt.stored) | {old_blob}) - accounted
+        assert leaked == set(), f"orphaned checkpoint blob(s): {leaked}"
+        # the stale pre-retry blob specifically must have been freed,
+        # and exactly once (the swap makes the free single-shot)
+        assert rt.freed.count(old_blob) == 1

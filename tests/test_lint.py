@@ -1081,6 +1081,60 @@ class TestFramework:
 
 
 # ---------------------------------------------------------------------------
+# CLI: every tier through one invocation
+# ---------------------------------------------------------------------------
+
+
+class TestCliAllTiers:
+    def test_all_tiers_cli_is_green(self, capsys):
+        # the documented CI invocation: python -m ray_tpu.devtools.lint
+        # --all ray_tpu must exit 0 (clean or fully baselined)
+        from ray_tpu.devtools.lint import main
+
+        rc = main(["--all", PKG])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 new finding(s)" in out
+
+    def test_sarif_merges_all_three_tiers_into_one_run(self, capsys):
+        import json
+
+        from ray_tpu.devtools.lint import main
+
+        rc = main(["--all", PKG, "--format", "sarif"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["version"] == "2.1.0"
+        assert len(doc["runs"]) == 1  # ONE run object, all tiers
+        rule_ids = {
+            r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]
+        }
+        # per-file, whole-program, and concurrency (incl. native) tiers
+        # all contribute rule metadata to the same driver
+        assert any(r.startswith("RT1") for r in rule_ids)
+        assert any(r.startswith("RT2") for r in rule_ids)
+        assert {"RT301", "RT302", "RT303", "RT304"} <= rule_ids
+        # the tree is clean/baselined: no unsuppressed results
+        unsuppressed = [
+            r for r in doc["runs"][0]["results"]
+            if not r.get("suppressions")
+        ]
+        assert unsuppressed == []
+
+    def test_trace_only_rules_partition(self, capsys):
+        # --rules with a trace id must route to the trace tier alone
+        from ray_tpu.devtools.lint import main
+
+        rc = main(["--trace", PKG, "--rules", "RT304", "--format",
+                   "json"])
+        import json
+
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["new_findings"] == []
+
+
+# ---------------------------------------------------------------------------
 # The gate: the installed package stays clean
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,455 @@
+"""rtrace (RT3xx): per-rule fixture pairs + the whole-package gate.
+
+Same contract as tests/test_rtflow_lint.py one tier down: every
+concurrency rule must flag its positive fixture and stay silent on the
+compliant twin, the plane classification the tier is built on is
+pinned explicitly, the native lock-order checker provably catches a
+seeded shard-before-MAIN inversion, and the final gate runs the real
+analysis over the installed package (Python AND `_native` C++) so the
+tree stays clean going forward.
+"""
+
+import os
+
+from ray_tpu.devtools.flow.index import build_index
+from ray_tpu.devtools.lint import load_baseline, split_baselined
+from ray_tpu.devtools.trace import (
+    CALLER,
+    DEFAULT_TRACE_BASELINE,
+    EXEC,
+    LOOP,
+    analyze_paths,
+    analyze_sources,
+    build_planes,
+    trace_rule_ids,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+
+
+def trace_ids(files, rules=None):
+    return [f.rule for f in analyze_sources(files, rules=rules)]
+
+
+def _planes_of(source, qualname):
+    import ast
+
+    tree = ast.parse(source)
+    index = build_index([("pkg/m.py", "pkg.m", source, tree)])
+    planes = build_planes(index)
+    return planes.of(qualname)
+
+
+# ---------------------------------------------------------------------------
+# Plane classification (the substrate every python rule stands on)
+# ---------------------------------------------------------------------------
+
+
+BRIDGE_SRC = '''
+import asyncio
+
+class Bridge:
+    def __init__(self):
+        self._loop = None
+        self._exec = None
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _run(self):
+        return 1
+
+    def _drain(self):
+        return 2
+
+    def kickoff(self):
+        self._loop.call_soon_threadsafe(self._drain)
+
+    async def offload(self):
+        await asyncio.get_running_loop().run_in_executor(
+            self._exec, self._blocking
+        )
+
+    def _blocking(self):
+        return 3
+'''
+
+
+class TestPlanes:
+    def test_async_def_is_loop(self):
+        assert LOOP in _planes_of(BRIDGE_SRC, "pkg.m.Bridge._run")
+
+    def test_bridge_public_sync_method_is_caller(self):
+        assert CALLER in _planes_of(BRIDGE_SRC, "pkg.m.Bridge.submit")
+
+    def test_call_soon_callback_is_loop(self):
+        assert LOOP in _planes_of(BRIDGE_SRC, "pkg.m.Bridge._drain")
+
+    def test_run_in_executor_target_is_exec(self):
+        assert EXEC in _planes_of(BRIDGE_SRC, "pkg.m.Bridge._blocking")
+
+    def test_remote_actor_public_method_is_exec(self):
+        src = '''
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def work(self):
+        return 1
+'''
+        assert EXEC in _planes_of(src, "pkg.m.A.work")
+
+
+# ---------------------------------------------------------------------------
+# RT301 cross-plane-unlocked-mutation
+# ---------------------------------------------------------------------------
+
+
+class TestCrossPlaneMutation:
+    def test_flags_both_unlocked_sites(self):
+        files = {"pkg/m.py": '''
+import asyncio
+
+class Bridge:
+    def __init__(self):
+        self._x = None
+
+    def submit(self, coro):
+        self._x = 1
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _run(self):
+        self._x = 2
+'''}
+        assert trace_ids(files, rules=["RT301"]) == ["RT301", "RT301"]
+
+    def test_silent_when_both_sides_hold_a_lock(self):
+        files = {"pkg/m.py": '''
+import asyncio
+
+class Bridge:
+    def __init__(self):
+        self._x = None
+        self._lock = None
+
+    def submit(self, coro):
+        with self._lock:
+            self._x = 1
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _run(self):
+        with self._lock:
+            self._x = 2
+'''}
+        assert trace_ids(files, rules=["RT301"]) == []
+
+    def test_silent_when_caller_funnels_through_the_loop(self):
+        # compliant twin: the caller side never touches the attribute,
+        # it schedules the loop-side mutator instead
+        files = {"pkg/m.py": '''
+import asyncio
+
+class Bridge:
+    def __init__(self):
+        self._x = None
+
+    def submit(self, coro):
+        self._loop.call_soon_threadsafe(self._set)
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    def _set(self):
+        self._x = 1
+
+    async def _run(self):
+        self._x = 2
+'''}
+        assert trace_ids(files, rules=["RT301"]) == []
+
+    def test_flags_cross_plane_module_global(self):
+        files = {"pkg/m.py": '''
+import asyncio
+
+_active = None
+
+class Bridge:
+    def start(self, coro):
+        global _active
+        _active = 1
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _run(self):
+        global _active
+        _active = None
+'''}
+        assert trace_ids(files, rules=["RT301"]) == ["RT301", "RT301"]
+
+    def test_ctor_writes_are_exempt(self):
+        files = {"pkg/m.py": '''
+import asyncio
+
+class Bridge:
+    def __init__(self):
+        self._x = None
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _run(self):
+        self._x = 2
+'''}
+        assert trace_ids(files, rules=["RT301"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT302 await-gap-check-then-act
+# ---------------------------------------------------------------------------
+
+
+class TestAwaitGapToctou:
+    def test_flags_stale_rebind_after_await(self):
+        files = {"pkg/m.py": '''
+class W:
+    async def go(self):
+        if self._blob is not None:
+            await self.free(self._blob)
+            self._blob = None
+'''}
+        assert trace_ids(files, rules=["RT302"]) == ["RT302"]
+
+    def test_silent_when_rechecked_after_the_await(self):
+        files = {"pkg/m.py": '''
+class W:
+    async def go(self):
+        if self._blob is not None:
+            await self.free(self._blob)
+            if self._blob is not None:
+                self._blob = None
+'''}
+        assert trace_ids(files, rules=["RT302"]) == []
+
+    def test_silent_under_an_async_lock(self):
+        files = {"pkg/m.py": '''
+class W:
+    async def go(self):
+        async with self._lock:
+            if self._blob is not None:
+                await self.free(self._blob)
+                self._blob = None
+'''}
+        assert trace_ids(files, rules=["RT302"]) == []
+
+    def test_flags_lazy_init_awaiting_in_the_assignment(self):
+        # the await is INSIDE the acting statement: two coroutines both
+        # pass the None check and both build a connection
+        files = {"pkg/m.py": '''
+class W:
+    async def conn(self):
+        if self._c is None:
+            self._c = await self.connect()
+        return self._c
+'''}
+        assert trace_ids(files, rules=["RT302"]) == ["RT302"]
+
+
+# ---------------------------------------------------------------------------
+# RT303 oneshot-rebound-under-waiters
+# ---------------------------------------------------------------------------
+
+
+class TestOneShotReassign:
+    def test_flags_rebinding_a_waited_event(self):
+        files = {"pkg/m.py": '''
+import asyncio
+
+class E:
+    def __init__(self):
+        self._ev = asyncio.Event()
+
+    async def waiter(self):
+        await self._ev.wait()
+
+    def reset(self):
+        self._ev = asyncio.Event()
+'''}
+        assert trace_ids(files, rules=["RT303"]) == ["RT303"]
+
+    def test_silent_on_set_clear_cycling(self):
+        files = {"pkg/m.py": '''
+import asyncio
+
+class E:
+    def __init__(self):
+        self._ev = asyncio.Event()
+
+    async def waiter(self):
+        await self._ev.wait()
+
+    def reset(self):
+        self._ev.clear()
+
+    def fire(self):
+        self._ev.set()
+'''}
+        assert trace_ids(files, rules=["RT303"]) == []
+
+    def test_silent_when_nothing_waits_on_the_attribute(self):
+        files = {"pkg/m.py": '''
+import asyncio
+
+class E:
+    def __init__(self):
+        self._ev = asyncio.Event()
+
+    def reset(self):
+        self._ev = asyncio.Event()
+'''}
+        assert trace_ids(files, rules=["RT303"]) == []
+
+
+# ---------------------------------------------------------------------------
+# RT304 native-lock-order
+# ---------------------------------------------------------------------------
+
+
+CC_SHARD_BEFORE_MAIN = """
+int f(Store* s, uint32_t si) {
+  ShardLock lk(s, si);
+  MainLock main(s);  // inversion: MAIN under a shard
+  return 0;
+}
+"""
+
+CC_COMPLIANT = """
+int f(Store* s, uint32_t si) {
+  {
+    ShardLock lk(s, si);
+  }
+  MainLock main(s);  // shard scope closed first: fine
+  return 0;
+}
+int g(Store* s) {
+  MainLock main(s);
+  ShardLock lk(s, 0);   // MAIN then shard is the documented order
+  LedgerLock led(s);    // and ledger innermost
+  return 0;
+}
+"""
+
+CC_STOPWORLD = """
+void lock_robust(pthread_mutex_t* m) {
+  pthread_mutex_lock(m);
+}
+void stop_world(Store* s) {
+  lock_robust(&s->hdr()->mutex);
+  for (uint32_t i = 0; i < kShards; i++)
+    lock_robust(&s->hdr()->shards[i].mutex);
+  for (uint32_t i = 0; i < kShards; i++)
+    pthread_mutex_unlock(&s->hdr()->shards[i].mutex);
+  pthread_mutex_unlock(&s->hdr()->mutex);
+}
+"""
+
+
+class TestNativeLockOrder:
+    def test_flags_seeded_shard_before_main(self):
+        files = {"pkg/_native/x.cc": CC_SHARD_BEFORE_MAIN}
+        found = analyze_sources(files, rules=["RT304"])
+        assert [f.rule for f in found] == ["RT304"]
+        assert "MAIN acquired while shard" in found[0].message
+
+    def test_silent_on_compliant_order(self):
+        files = {"pkg/_native/x.cc": CC_COMPLIANT}
+        assert trace_ids(files, rules=["RT304"]) == []
+
+    def test_flags_ledger_to_shard_inversion(self):
+        files = {"pkg/_native/x.cc": """
+int f(Store* s) {
+  LedgerLock led(s);
+  ShardLock lk(s, 0);  // inversion: shard under ledger
+  return 0;
+}
+"""}
+        assert trace_ids(files, rules=["RT304"]) == ["RT304"]
+
+    def test_stopworld_ascending_raw_locks_are_sanctioned(self):
+        # MAIN + every shard via raw lock_robust — the one composite the
+        # discipline allows; the lock_robust DEFINITION must not count
+        # as an acquisition either
+        files = {"pkg/_native/x.cc": CC_STOPWORLD}
+        assert trace_ids(files, rules=["RT304"]) == []
+
+    def test_comment_suppression_applies_in_cc(self):
+        files = {"pkg/_native/x.cc": """
+int f(Store* s, uint32_t si) {
+  ShardLock lk(s, si);
+  // rtlint: disable-next=RT304
+  MainLock main(s);
+  return 0;
+}
+"""}
+        assert trace_ids(files, rules=["RT304"]) == []
+
+
+# ---------------------------------------------------------------------------
+# Machinery
+# ---------------------------------------------------------------------------
+
+
+class TestMachinery:
+    def test_rule_ids_are_rt3xx(self):
+        ids = trace_rule_ids()
+        assert ids == ("RT301", "RT302", "RT303", "RT304")
+
+    def test_fingerprints_are_deterministic(self):
+        files = {
+            "pkg/m.py": '''
+import asyncio
+
+class Bridge:
+    def submit(self, coro):
+        self._x = 1
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _run(self):
+        self._x = 2
+''',
+            "pkg/_native/x.cc": CC_SHARD_BEFORE_MAIN,
+        }
+        a = [f.fingerprint() for f in analyze_sources(files)]
+        b = [f.fingerprint() for f in analyze_sources(files)]
+        assert a == b
+        assert len(set(a)) == len(a)  # distinct findings, distinct keys
+
+    def test_python_suppression_applies(self):
+        files = {"pkg/m.py": '''
+import asyncio
+
+class Bridge:
+    def submit(self, coro):
+        # rtlint: disable-next=RT301
+        self._x = 1
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _run(self):
+        self._x = 2  # rtlint: disable=RT301
+'''}
+        assert trace_ids(files, rules=["RT301"]) == []
+
+
+# ---------------------------------------------------------------------------
+# The gate: the real tree stays clean
+# ---------------------------------------------------------------------------
+
+
+class TestWholePackage:
+    def test_package_has_no_non_baselined_findings(self):
+        report = analyze_paths([PKG])
+        assert report.parse_errors == []
+        assert report.files_indexed > 100  # python + _native sources
+        baseline = load_baseline(DEFAULT_TRACE_BASELINE)
+        new, _ = split_baselined(report.findings, baseline)
+        assert new == [], (
+            "non-baselined RT3xx findings:\n"
+            + "\n".join(f.render() for f in new)
+        )

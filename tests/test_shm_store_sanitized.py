@@ -1,11 +1,21 @@
 """Sanitizer harness for the C shm arena.
 
-Builds `tests/native/stress_shm_store.cc` together with
-`ray_tpu/_native/shm_store.cc` under AddressSanitizer + UBSan and runs
-a multi-process stress (concurrent create/seal/get/delete/protect, one
-worker SIGKILLed while holding a pin) — the repo's ASAN/race-harness
-role for its one native component (reference analogue: plasma-store
-ASAN CI).  A sanitizer report or invariant violation fails the run.
+Two lanes over `ray_tpu/_native/shm_store.cc`:
+
+- ASan/UBSan: builds `tests/native/stress_shm_store.cc` and runs a
+  multi-process stress (concurrent create/seal/get/delete/protect, one
+  worker SIGKILLed while holding a pin) — the repo's memory-safety
+  harness for its one native component (reference analogue:
+  plasma-store ASAN CI).
+- TSan: builds `tests/native/tsan_hammer_shm_store.cc` with
+  `-fsanitize=thread` and runs a single-process multi-thread hammer
+  over reserve/publish/seal/evict/reap — ThreadSanitizer only
+  instruments one address space, so this lane (not the fork()ing one)
+  is what actually checks the MAIN < shard < ledger lock discipline
+  that rtlint RT304 checks lexically.
+
+Either lane skips LOUDLY when the toolchain can't produce its binary; a
+sanitizer report or invariant violation fails the run.
 """
 
 import os
@@ -17,6 +27,9 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC = os.path.join(REPO, "ray_tpu", "_native", "shm_store.cc")
 DRIVER = os.path.join(REPO, "tests", "native", "stress_shm_store.cc")
+TSAN_DRIVER = os.path.join(
+    REPO, "tests", "native", "tsan_hammer_shm_store.cc"
+)
 
 
 @pytest.fixture(scope="module")
@@ -57,6 +70,40 @@ def stress_bin(tmp_path_factory):
     return out
 
 
+@pytest.fixture(scope="module")
+def tsan_bin(tmp_path_factory):
+    # Same loud-skip contract as the ASan lane: no g++ or no libtsan
+    # must report "TSan coverage did not run", never a silent green.
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("tsan hammer build unavailable: no g++ on PATH")
+    out = str(tmp_path_factory.mktemp("tsan") / "tsan_hammer_shm_store")
+    try:
+        build = subprocess.run(
+            ["g++", "-O1", "-g", "-std=c++17", "-pthread",
+             "-fsanitize=thread", "-fno-omit-frame-pointer",
+             TSAN_DRIVER, SRC, "-o", out],
+            capture_output=True, text=True, timeout=300,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("tsan hammer build unavailable: g++ timed out")
+    if build.returncode != 0:
+        err = build.stderr or ""
+        missing_rt = any(
+            s in err for s in ("cannot find -ltsan",
+                               "unrecognized argument to '-fsanitize'",
+                               "unrecognized command line option")
+        )
+        if missing_rt:
+            pytest.skip(
+                "tsan hammer build unavailable: toolchain lacks the "
+                f"TSan runtime — {err.strip().splitlines()[-1]}"
+            )
+        pytest.fail(f"tsan hammer build failed:\n{err[-2000:]}")
+    return out
+
+
 class TestSanitizedArena:
     def test_multiprocess_stress_clean_under_asan_ubsan(
         self, stress_bin, tmp_path
@@ -76,3 +123,20 @@ class TestSanitizedArena:
         )
         assert "ERROR: AddressSanitizer" not in r.stderr
         assert "runtime error:" not in r.stderr  # UBSan report line
+
+
+class TestTsanArena:
+    def test_multithread_hammer_clean_under_tsan(self, tsan_bin, tmp_path):
+        arena = "/dev/shm/rt_tsan_" + os.path.basename(str(tmp_path))
+        r = subprocess.run(
+            [tsan_bin, arena, "4", "300"],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ,
+                 # nonzero exit on the first race report
+                 "TSAN_OPTIONS": "halt_on_error=1:exitcode=99"},
+        )
+        sys.stderr.write(r.stderr[-2000:])
+        assert r.returncode == 0, (
+            f"rc={r.returncode}\n{r.stderr[-3000:]}"
+        )
+        assert "WARNING: ThreadSanitizer" not in r.stderr
