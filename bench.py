@@ -1289,6 +1289,183 @@ def bench_pipeline_preemption(steps: int = 8, seed: int = 2026):
     }
 
 
+def bench_podracer_throughput(
+    trials: int = 3, updates_per_window: int = 6, device_ms: float = 40.0,
+):
+    """Podracer throughput plane vs the synchronous EnvRunnerGroup.sample
+    loop, interleaved A/B windows on the SAME 2-runner CartPole config.
+
+    Arm A (podracer): free-running fleet — per-runner fragments land as
+    shm refs, the central learner actor batches them with staleness
+    bounds, weights fan out over one broadcast_tree.  Arm B (sync): the
+    gang loop — sample both runners (payload through the driver),
+    update in-driver, sync_weights, repeat.  Windows alternate A/B per
+    trial so host drift hits both arms equally; the podracer fleet is
+    drained (paused) outside its windows so arm B is never contended.
+
+    BOTH arms train through the same device-proxy learner: a real (CPU)
+    IMPALA update plus a ``device_ms`` non-CPU wait standing in for the
+    accelerator step the plane is built around (the paper's learner is
+    a TPU; this CI box is one CPU core, where a CPU-bound learner would
+    falsely serialize against env stepping and hide the overlap the
+    architecture exists to exploit).  The podracer arm overlaps env
+    stepping with the device-blocked update; the gang loop cannot.
+    ``device_ms=0`` gives the pure-CPU-learner number.
+
+    Also reports: trained (not just sampled) env-steps/s for both arms,
+    a bit-reproducibility precheck (two seeded train=False fleets must
+    emit identical fragment payloads per (runner, seq)), the
+    fragment-staleness histogram over trained fragments, and the
+    weight_broadcast_ms fp32-vs-int8 A/B on the idle fleet.
+
+    Own cluster (5 single-CPU actors across both arms outlive the
+    family cluster's budget); call after the family runtime shut down.
+    """
+    import functools
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.rllib.algorithm import build_module_config, probe_env_spaces
+    from ray_tpu.rllib.env_runner import EnvRunnerGroup
+    from ray_tpu.rllib.impala import (
+        IMPALAConfig,
+        IMPALALearner,
+        impala_batch_from_fragments,
+    )
+    from ray_tpu.rllib.podracer import PodracerConfig, PodracerRunner
+
+    class DeviceProxyLearner(IMPALALearner):
+        """IMPALA learner whose update blocks ``device_ms`` without
+        consuming host CPU — the accelerator-step proxy (weights still
+        really change; only the wall profile of update() differs)."""
+
+        def update(self, batch):
+            stats = super().update(batch)
+            time.sleep(device_ms / 1e3)
+            return stats
+
+    FRAG, N_RUNNERS, N_ENVS = 16, 2, 4
+    config = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=N_RUNNERS, num_envs_per_env_runner=N_ENVS,
+            rollout_fragment_length=FRAG,
+        )
+    )
+    mc = build_module_config(config, probe_env_spaces(config.env, None))
+    factory = functools.partial(DeviceProxyLearner, config, mc)
+
+    def make_group(seed):
+        return EnvRunnerGroup(
+            config.env, mc, num_runners=N_RUNNERS,
+            num_envs_per_runner=N_ENVS, seed=seed,
+        )
+
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    try:
+        # -- bit-reproducibility precheck (acceptance pin) --------------
+        streams = []
+        for _ in range(2):
+            g = make_group(17)
+            pr = PodracerRunner(
+                g, factory, impala_batch_from_fragments,
+                PodracerConfig(rollout_fragment_length=FRAG),
+                train=False, keep_fragment_refs=True,
+            )
+            try:
+                pr.run(min_fragments=4)
+                streams.append({
+                    (i, m["seq"]): ray_tpu.get(ref, timeout=60.0)
+                    for i, m, ref in pr.fragment_log
+                })
+            finally:
+                pr.stop()
+                g.stop()
+        common = set(streams[0]) & set(streams[1])
+        bit_repro = bool(common) and all(
+            np.array_equal(streams[0][k][f], streams[1][k][f])
+            for k in common for f in streams[0][k]
+        )
+        del streams
+
+        # -- interleaved A/B windows ------------------------------------
+        group_a = make_group(0)
+        pr = PodracerRunner(
+            group_a, factory, impala_batch_from_fragments,
+            PodracerConfig(
+                rollout_fragment_length=FRAG, batch_fragments=2,
+                max_policy_lag=4, weight_sync_period=2,
+            ),
+        )
+        group_b = make_group(1)
+        learner_b = DeviceProxyLearner(config, mc)
+        group_b.sync_weights(learner_b.get_weights())
+
+        def sync_window():
+            """updates_per_window iterations of the gang loop; returns
+            env steps sampled."""
+            steps = 0
+            for _ in range(updates_per_window):
+                frags = group_b.sample(FRAG)
+                batch = impala_batch_from_fragments(frags)
+                learner_b.update(batch)
+                group_b.sync_weights(learner_b.get_weights())
+                steps += FRAG * N_ENVS * len(frags)
+            return steps
+
+        # warm both arms outside the timed windows (jit compile, actor
+        # spin-up, first collective rendezvous)
+        pr.run(min_updates=1)
+        pr.drain_in_flight()
+        sync_window()
+
+        a_rates, a_trained, b_rates = [], [], []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            trained0 = pr.learner_stats()["env_steps_trained"]
+            out = pr.run(min_updates=updates_per_window)
+            dt = time.perf_counter() - t0
+            a_rates.append(out["env_steps_sampled"] / dt)
+            a_trained.append(
+                (pr.learner_stats()["env_steps_trained"] - trained0) / dt
+            )
+            pr.drain_in_flight()  # pause the fleet: arm B runs alone
+            t0 = time.perf_counter()
+            steps = sync_window()
+            b_rates.append(steps / (time.perf_counter() - t0))
+        a_med = sorted(a_rates)[len(a_rates) // 2]
+        at_med = sorted(a_trained)[len(a_trained) // 2]
+        b_med = sorted(b_rates)[len(b_rates) // 2]
+
+        # -- weight fan-out fp32 vs int8 on the idle fleet --------------
+        fp32_ms, int8_ms = [], []
+        for _ in range(3):
+            fp32_ms.append(pr.broadcast_weights(None))
+            int8_ms.append(pr.broadcast_weights("int8"))
+        stats = pr.learner_stats()
+        pr.stop()
+        group_a.stop()
+        group_b.stop()
+        return {
+            "env_steps_per_s": a_med,
+            "trained_env_steps_per_s": at_med,
+            "sync_env_steps_per_s": b_med,
+            "ratio": a_med / b_med,
+            "trained_ratio": at_med / b_med,
+            "learner_device_ms": device_ms,
+            "bit_reproducible": bit_repro,
+            "staleness_hist": stats["staleness_hist"],
+            "max_trained_lag": stats["max_trained_lag"],
+            "dropped_stale": stats["dropped_stale"],
+            "weight_broadcast_fp32_ms": sorted(fp32_ms)[1],
+            "weight_broadcast_int8_ms": sorted(int8_ms)[1],
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 def bench_serve_rps(ray_tpu, service_ms=100.0, max_ongoing=4,
                     slo_ms=750.0, max_queue_depth=12,
                     steady_s=4.0, overload_s=5.0):
@@ -1824,6 +2001,50 @@ def main():
             )
         except Exception as e:  # noqa: BLE001
             emit("tokens_lost_to_preemption", 0.0, "tokens", error=repr(e))
+
+    # podracer throughput plane: free-running env fleet + central
+    # learner vs the synchronous gang loop, interleaved windows on the
+    # same 2-runner config, plus the fp32/int8 weight fan-out A/B (own
+    # cluster; full protocol in BENCH.md "Podracer throughput")
+    if remaining() > 120:
+        try:
+            pt = bench_podracer_throughput()
+            emit(
+                "env_steps_per_s", pt["env_steps_per_s"], "steps/s",
+                sync_env_steps_per_s=round(pt["sync_env_steps_per_s"], 1),
+                ratio=round(pt["ratio"], 3),
+                trained_env_steps_per_s=round(
+                    pt["trained_env_steps_per_s"], 1
+                ),
+                trained_ratio=round(pt["trained_ratio"], 3),
+                learner_device_ms=pt["learner_device_ms"],
+                bit_reproducible=pt["bit_reproducible"],
+                staleness_hist={
+                    str(k): v for k, v in pt["staleness_hist"].items()
+                },
+                max_trained_lag=pt["max_trained_lag"],
+                dropped_stale=pt["dropped_stale"],
+                note="2 runners x 4 CartPole envs, fragment 16; sync "
+                     "arm = EnvRunnerGroup.sample + update + "
+                     "sync_weights per iteration; both arms train "
+                     "through the same device-proxy learner (real CPU "
+                     "update + learner_device_ms device-blocked wait "
+                     "standing in for the accelerator step)",
+            )
+            emit(
+                "weight_broadcast_ms", pt["weight_broadcast_fp32_ms"],
+                "ms",
+                int8_ms=round(pt["weight_broadcast_int8_ms"], 3),
+                int8_speedup=round(
+                    pt["weight_broadcast_fp32_ms"]
+                    / pt["weight_broadcast_int8_ms"], 3,
+                ),
+                note="broadcast_tree over learner+2 runners, idle "
+                     "fleet, median of 3; int8 = block-quantized "
+                     "wire (~1/4 bytes), replicas bit-identical",
+            )
+        except Exception as e:  # noqa: BLE001
+            emit("env_steps_per_s", 0.0, "steps/s", error=repr(e))
 
     # scheduler scale excerpt: 1k virtual nodes, lease-churn latency
     # (full tier: tests/test_scheduler_scale.py).  After the cluster

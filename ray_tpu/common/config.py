@@ -166,6 +166,13 @@ D("collective_suspect_refresh_s", float, 1.0)
 D("collective_shm_min_bytes", int, 64 * 1024)
 D("collective_op_timeout_s", float, 120.0)  # per-wait peer-traffic budget
 D("collective_rendezvous_timeout_s", float, 60.0)
+# podracer plane: abort a run() that made no sufficient progress in this
+# window (a wedged fleet must surface as an error, not a silent hang)
+D("podracer_progress_timeout_s", float, 300.0)
+# podracer learner queue cap as a multiple of batch_fragments (beyond
+# it the oldest queued fragment is shed — backpressure on sampling
+# transiently outpacing training)
+D("podracer_queue_factor", int, 4)
 # peer-conn loss on a SUSPECT node defers poisoning until the GCS
 # confirms the node's fate (dead -> poison, recovered -> no-op); this
 # bounds the wait (unresolved past it poisons — fail-safe), with

@@ -35,6 +35,7 @@ from ray_tpu.rllib.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rllib.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae  # noqa: F401
 from ray_tpu.rllib import connectors  # noqa: F401
+from ray_tpu.rllib import podracer  # noqa: F401
 
 # NOTE: the model catalog (CNN family) lives in ray_tpu.models.catalog —
 # imported there, not here, to keep rllib importable from the catalog

@@ -8,6 +8,13 @@ off-policyness, and the importance ratio is clipped PPO-style so one
 very-stale fragment cannot blow up the update.  The optional target
 network (use_kl_loss analogue collapsed: the clip does the trust-region
 work) smooths tgt_logp drift between weight syncs.
+
+APPO inherits IMPALA's ``throughput_mode="podracer"`` wholesale — the
+podracer plane builds its central learner from ``learner_cls``, so the
+clipped-surrogate learner rides the free-running fleet unchanged
+(``tests/test_zz_podracer.py::TestImpalaPodracerMode``).  The ratio
+clip matters MORE there: fragments arrive at up to ``max_policy_lag``
+versions stale by design.
 """
 
 from __future__ import annotations

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -51,6 +51,15 @@ class IMPALAConfig(AlgorithmConfig):
     hidden: tuple = (64, 64)
     # how many fragments to consume per training_step call
     updates_per_iteration: int = 4
+    # "podracer" routes the loop onto the podracer throughput plane
+    # (free-running fleet + central learner actor + collective weight
+    # fan-out); None keeps the legacy in-driver loop bit-for-bit
+    throughput_mode: Optional[str] = None
+    podracer_batch_fragments: int = 2
+    podracer_max_policy_lag: int = 4
+    podracer_weight_sync_period: int = 1
+    # None = exact fp32 fan-out; "int8" = block-quantized (~1/4 wire)
+    podracer_weight_wire_dtype: Optional[str] = None
 
 
 def vtrace(behavior_logp, target_logp, rewards, values, dones, last_values,
@@ -144,16 +153,45 @@ class IMPALALearner(Learner):
         }
 
 
+def impala_batch_from_fragments(frags) -> Dict[str, np.ndarray]:
+    """Stack rollout fragments along the env (B) axis into one V-trace
+    batch — the podracer learner's batch assembler.  Fragments share T
+    (one ``rollout_fragment_length``); B may differ per runner."""
+    obs = np.concatenate([f["obs"] for f in frags], axis=1)
+    last_obs = np.concatenate(
+        [f["final_obs"].reshape(f["obs"].shape[1], -1) for f in frags],
+        axis=0,
+    )
+    return {
+        "obs": obs.astype(np.float32),
+        "actions": np.concatenate([f["actions"] for f in frags], axis=1),
+        "logp": np.concatenate([f["logp"] for f in frags], axis=1),
+        "rewards": np.concatenate([f["rewards"] for f in frags], axis=1),
+        "dones": np.concatenate([f["dones"] for f in frags], axis=1),
+        "last_obs": last_obs,
+    }
+
+
 class IMPALA(Algorithm):
     """Async decoupled actor-learner (ray: impala.py training_step's
     aggregated async sampling, minus the GPU aggregation actors the
-    single-learner case doesn't need)."""
+    single-learner case doesn't need).
+
+    ``throughput_mode="podracer"`` swaps the in-driver update loop for
+    the podracer plane: the learner moves into a dedicated actor fed by
+    a free-running fleet over shm fragment refs, with staleness-bounded
+    batching (V-trace is exactly the correction that makes the extra
+    policy lag sound) and collective weight fan-out."""
 
     learner_cls = IMPALALearner  # overridden by APPO
 
     def _setup(self, config: IMPALAConfig):
         import ray_tpu
 
+        self._podracer = None
+        if getattr(config, "throughput_mode", None) == "podracer":
+            self._setup_podracer(config)
+            return
         spaces = probe_env_spaces(config.env, config.env_to_module)
         self.module_config = build_module_config(config, spaces)
         self.learner = self.learner_cls(config, self.module_config)
@@ -173,7 +211,56 @@ class IMPALA(Algorithm):
         }
         self._ray = ray_tpu
 
+    def _setup_podracer(self, config: IMPALAConfig):
+        import functools
+
+        import ray_tpu
+        from ray_tpu.rllib.podracer import PodracerConfig, PodracerRunner
+
+        spaces = probe_env_spaces(config.env, config.env_to_module)
+        self.module_config = build_module_config(config, spaces)
+        self.env_runner_group = EnvRunnerGroup(
+            config.env,
+            self.module_config,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed,
+            env_to_module_fn=config.env_to_module,
+        )
+        # the learner lives in the podracer actor, not this process
+        self.learner = None
+        self._podracer = PodracerRunner(
+            self.env_runner_group,
+            functools.partial(self.learner_cls, config, self.module_config),
+            impala_batch_from_fragments,
+            PodracerConfig(
+                rollout_fragment_length=config.rollout_fragment_length,
+                batch_fragments=config.podracer_batch_fragments,
+                max_policy_lag=config.podracer_max_policy_lag,
+                weight_sync_period=config.podracer_weight_sync_period,
+                weight_wire_dtype=config.podracer_weight_wire_dtype,
+            ),
+        )
+        self._inflight = {}
+        self._ray = ray_tpu
+
+    def _eval_weights(self):
+        if self._podracer is not None:
+            return self._podracer.get_weights()
+        return super()._eval_weights()
+
+    def _podracer_training_step(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.monotonic()
+        out = self._podracer.run(min_updates=c.updates_per_iteration)
+        self._record_returns(np.asarray(out.pop("episode_returns")))
+        self._total_steps += int(out["env_steps_sampled"])
+        out["iter_time_s"] = time.monotonic() - t0
+        return out
+
     def training_step(self) -> Dict[str, Any]:
+        if self._podracer is not None:
+            return self._podracer_training_step()
         c = self.config
         stats_acc: Dict[str, float] = {}
         t0 = time.monotonic()
@@ -221,14 +308,26 @@ class IMPALA(Algorithm):
         return stats_acc
 
     def get_state(self) -> Dict[str, Any]:
+        if self._podracer is not None:
+            return {"params": self._podracer.get_weights()}
         return {"params": self.learner.params}
 
     def set_state(self, state: Dict[str, Any]) -> None:
+        if self._podracer is not None:
+            self._ray.get(
+                self._podracer.learner.set_weights.remote(state["params"]),
+                timeout=120.0,
+            )
+            self._podracer._put_sync_all()
+            return
         self.learner.params = state["params"]
         self.env_runner_group.sync_weights(self.learner.params)
 
     def stop(self) -> None:
         self._inflight.clear()
+        if self._podracer is not None:
+            self._podracer.stop()
+            self._podracer = None
         self.env_runner_group.stop()
 
 

@@ -1,0 +1,20 @@
+"""Podracer throughput plane (arXiv 2104.06272, Sebulba shape).
+
+Free-running vectorized env actors feed a central learner actor through
+shm-ref'd rollout fragments; weights fan back out over one block-
+quantizable ``broadcast_tree``.  First end-to-end composition of the
+batched task plane, data-plane v2 and Collectives v2 — and the
+regression net for all three (``env_steps_per_s`` in bench.py).
+"""
+
+from ray_tpu.rllib.podracer.fragment import FragmentMeta, StalenessHistogram
+from ray_tpu.rllib.podracer.learner import PodracerLearnerActor
+from ray_tpu.rllib.podracer.runner import PodracerConfig, PodracerRunner
+
+__all__ = [
+    "FragmentMeta",
+    "StalenessHistogram",
+    "PodracerLearnerActor",
+    "PodracerConfig",
+    "PodracerRunner",
+]
