@@ -1514,68 +1514,24 @@ def bench_serve_rps(ray_tpu, service_ms=100.0, max_ongoing=4,
     url = f"http://127.0.0.1:{port}/rps"
     capacity = max_ongoing * 1000.0 / service_ms
 
-    async def drive(rate, duration):
-        import aiohttp
+    # the open-loop client lives in ray_tpu.soak.load now (the soak
+    # plane drives the same schedule); uniform arrivals preserve A/B
+    # against the pre-extraction serve_rps records
+    from ray_tpu.soak import load as soak_load
 
-        lat_ok: list = []
-        counts = {"shed": 0, "error": 0}
-
-        async with aiohttp.ClientSession() as sess:
-
-            async def one():
-                t0 = time.perf_counter()
-                try:
-                    async with sess.get(url) as r:
-                        await r.read()
-                        if r.status == 200:
-                            lat_ok.append(time.perf_counter() - t0)
-                        elif r.status == 503:
-                            counts["shed"] += 1
-                        else:
-                            counts["error"] += 1
-                except Exception:
-                    counts["error"] += 1
-
-            # route + policy warmup, sequential (also the readiness wait)
-            deadline = time.monotonic() + 30
-            while time.monotonic() < deadline:
-                async with sess.get(url) as r:
-                    await r.read()
-                    if r.status == 200:
-                        break
-                await asyncio.sleep(0.3)
-            for _ in range(10):
-                await one()
-            lat_ok.clear()
-            counts.update(shed=0, error=0)
-
-            n = int(rate * duration)
-            interval = 1.0 / rate
-            t_start = time.perf_counter()
-            tasks = []
-            for i in range(n):
-                delay = t_start + i * interval - time.perf_counter()
-                if delay > 0:
-                    await asyncio.sleep(delay)
-                tasks.append(asyncio.ensure_future(one()))
-            await asyncio.gather(*tasks)
-            elapsed = time.perf_counter() - t_start
-
-        lat_ok.sort()
-
-        def pct(p):
-            if not lat_ok:
-                return 0.0
-            return lat_ok[min(len(lat_ok) - 1,
-                              int(p / 100.0 * len(lat_ok)))] * 1000.0
-
+    def drive(rate, duration):
+        offsets = soak_load.arrival_offsets(
+            rate, duration, process="uniform"
+        )
+        records = asyncio.run(soak_load.drive_http(url, offsets))
+        s = soak_load.summarize(records, elapsed_s=duration)
         return {
             "offered_rps": round(rate, 1),
-            "admitted_rps": round(len(lat_ok) / elapsed, 1),
-            "p50_ms": round(pct(50), 1),
-            "p99_ms": round(pct(99), 1),
-            "shed_rate": round(counts["shed"] / max(1, n), 3),
-            "errors": counts["error"],
+            "admitted_rps": s["admitted_rps"],
+            "p50_ms": s["p50_ms"],
+            "p99_ms": s["p99_ms"],
+            "shed_rate": s["shed_rate"],
+            "errors": s["errors"],
         }
 
     async def depth1(n=50):
@@ -1594,8 +1550,8 @@ def bench_serve_rps(ray_tpu, service_ms=100.0, max_ongoing=4,
         return round(lats[len(lats) // 2] * 1000.0, 2)
 
     try:
-        steady = asyncio.run(drive(capacity * 0.5, steady_s))
-        overload = asyncio.run(drive(capacity * 2.0, overload_s))
+        steady = drive(capacity * 0.5, steady_s)
+        overload = drive(capacity * 2.0, overload_s)
         d1 = asyncio.run(depth1())
         return {
             "capacity_rps": round(capacity, 1),
@@ -1610,6 +1566,69 @@ def bench_serve_rps(ray_tpu, service_ms=100.0, max_ongoing=4,
             serve.delete("rps_bench")
         except Exception:
             pass
+
+
+def bench_soak(profile: str = "short", seed: int = 7):
+    """Soak-plane rows: the deterministic acceptance soak + the
+    spot-fleet ledger, both pure functions of the seed (run twice and
+    diff the bytes — that IS the regression check).
+
+    Profiles: ``short`` simulates the 30 s acceptance scenario
+    (finishes in seconds — the slow-marked test tier runs this);
+    ``full`` simulates a 180 s storm with a kill added, the
+    BENCH.md-record shape.
+    """
+    from ray_tpu.soak import (
+        acceptance_scenario,
+        economics_rows,
+        run_sim,
+        run_spot_economics,
+    )
+
+    if profile == "short":
+        scenario = acceptance_scenario(seed=seed, duration_s=30.0)
+    else:
+        import dataclasses as _dc
+
+        from ray_tpu.soak import StormSpec
+
+        base = acceptance_scenario(seed=seed, duration_s=180.0)
+        scenario = _dc.replace(
+            base,
+            name="acceptance_full",
+            storm=StormSpec(preempts=2, partitions=2, node_kills=1,
+                            partition_duration_s=2.0),
+        )
+    # the full storm downs more nodes than the fleet holds — it only
+    # makes sense with the provider's min_workers replacement live
+    res = run_sim(scenario, replace_nodes=(profile != "short"))
+    rows = list(res.scorecard.to_rows())
+    rows += economics_rows(run_spot_economics(scenario))
+    for r in rows:
+        r.setdefault("profile", profile)
+    return rows
+
+
+def soak_main(argv):
+    """``python bench.py --soak [--full]``: emit the soak rows and a
+    final headline line (same contract as the main bench: the driver
+    parses the LAST line)."""
+    profile = "full" if "--full" in argv else "short"
+    rows = []
+    try:
+        rows = bench_soak(profile=profile)
+        for r in rows:
+            r = dict(r)
+            emit(r.pop("metric"), r.pop("value"), r.pop("unit"), **r)
+    except Exception as e:  # noqa: BLE001
+        emit("soak_availability", 0.0, "frac", error=repr(e),
+             profile=profile)
+    with _PRINT_LOCK:
+        _FINISHED.set()
+        head = dict(ROWS[0]) if ROWS else {"metric": "soak_availability",
+                                           "value": 0.0, "unit": "frac"}
+        head["rows"] = ROWS
+        print(json.dumps(head), flush=True)
 
 
 def _tpu_probe_platform(timeout_s: float = 120.0):
@@ -2090,4 +2109,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--soak" in sys.argv[1:]:
+        soak_main(sys.argv[1:])
+    else:
+        main()

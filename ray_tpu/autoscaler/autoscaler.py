@@ -38,6 +38,13 @@ class NodeTypeConfig:
     min_workers: int = 0
     max_workers: int = 100
     labels: Dict[str, str] = field(default_factory=dict)
+    #: relative $/node-second — the launch planner prefers the cheaper
+    #: of two types that both fit a demand (spot-fleet economics)
+    price: float = 1.0
+    #: preemptible capacity: the provider may revoke it with a notice
+    #: (soak.spot drives the seeded revocation process); the fleet's
+    #: answer to churn is the drain plane + min_workers replacement
+    preemptible: bool = False
 
 
 @dataclass
@@ -167,14 +174,18 @@ class Autoscaler:
         """First-fit-decreasing onto the smallest node type that fits."""
         if not unmet:
             return self._min_workers_topup(state)
-        counts = self._current_counts(state)
+        counts = self._current_counts(state, exclude_draining=True)
         plan: List[str] = []
         # virtual free pools of nodes we are about to launch
         virtual: List[ResourceSet] = []
 
+        # smallest that fits, and among equal sizes the CHEAPER type —
+        # with a discounted preemptible type configured this is the
+        # spot-fleet bet: provision cheap churny capacity and let the
+        # drain plane + min_workers replacement absorb the revocations
         types_small_first = sorted(
             self.config.node_types,
-            key=lambda t: sum(t.resources.values()),
+            key=lambda t: (sum(t.resources.values()), t.price),
         )
         for d in unmet:
             placed = False
@@ -208,7 +219,8 @@ class Autoscaler:
         return plan + self._min_workers_topup(state, counts)
 
     def _min_workers_topup(self, state, counts=None) -> List[str]:
-        counts = counts if counts is not None else self._current_counts(state)
+        if counts is None:
+            counts = self._current_counts(state, exclude_draining=True)
         plan = []
         for tc in self.config.node_types:
             have = counts.get(tc.name, 0)
@@ -217,9 +229,26 @@ class Autoscaler:
                 counts[tc.name] = counts.get(tc.name, 0) + 1
         return plan
 
-    def _current_counts(self, state) -> Dict[str, int]:
+    def _current_counts(self, state=None,
+                        exclude_draining: bool = False) -> Dict[str, int]:
+        """Provider-side node counts by type.  ``exclude_draining``
+        drops nodes the GCS reports mid-drain — a preemption-noticed
+        node is walking dead, and counting it would suppress the
+        replacement launch until AFTER the kill (a full blackout of
+        provisioning latency instead of an overlap).  Idle drains never
+        flap under this: they only start while counts exceed
+        min_workers, so the excluded victim still leaves >= min."""
+        draining = set()
+        if exclude_draining and state is not None:
+            draining = {
+                n["node_id"] for n in state["nodes"]
+                if n["alive"] and n.get("draining")
+            }
         counts: Dict[str, int] = {}
         for pn in self.provider.non_terminated_nodes():
+            nids = pn.meta.get("node_ids") or [pn.node_id_hex]
+            if draining and all(nid in draining for nid in nids):
+                continue
             counts[pn.node_type] = counts.get(pn.node_type, 0) + 1
         return counts
 
@@ -384,6 +413,10 @@ def main():
                     resources,
                     int(fields.get("min", 0)),
                     int(fields.get("max", 100)),
+                    price=float(fields.get("price", 1.0)),
+                    preemptible=fields.get(
+                        "preemptible", "false"
+                    ).lower() in ("1", "true", "yes"),
                 )
             )
 

@@ -194,6 +194,7 @@ class FaultController:
                         "ctx": ctx,
                         "hit": a.hits,
                         "action": plan.action,
+                        "ts": time.monotonic(),
                     })
         return fired
 
@@ -299,7 +300,8 @@ def cut_link(src: str, dst: str, duration_s: Optional[float] = None) -> None:
     with _LINKS_LOCK:
         _LINKS[(src, dst)] = deadline
         _LINK_LOG.append({"event": "cut", "src": src, "dst": dst,
-                          "duration_s": duration_s})
+                          "duration_s": duration_s,
+                          "ts": time.monotonic()})
         LINKS_ACTIVE = True
 
 
@@ -322,7 +324,8 @@ def heal_link(src: Optional[str] = None, dst: Optional[str] = None) -> None:
                 match = True
             if match:
                 del _LINKS[key]
-                _LINK_LOG.append({"event": "heal", "src": s, "dst": d})
+                _LINK_LOG.append({"event": "heal", "src": s, "dst": d,
+                                  "ts": time.monotonic()})
         if not _LINKS:
             LINKS_ACTIVE = False
 
@@ -339,7 +342,8 @@ def link_is_cut(src: Optional[str], dst: Optional[str]) -> bool:
             return False
         if time.monotonic() >= deadline:
             del _LINKS[(src, dst)]
-            _LINK_LOG.append({"event": "auto_heal", "src": src, "dst": dst})
+            _LINK_LOG.append({"event": "auto_heal", "src": src, "dst": dst,
+                              "ts": time.monotonic()})
             if not _LINKS:
                 LINKS_ACTIVE = False
             return False
@@ -390,6 +394,57 @@ class ChaosController:
     def _record(self, event: str, **detail) -> None:
         self.log.append({"event": event, "detail": detail,
                          "ts": time.monotonic()})
+
+    def record_external(self, event: str, **detail) -> None:
+        """Log a storm event applied by an outside driver (e.g. a
+        spot-fleet preemption issued through the autoscaler's provider
+        rather than through this controller) so the unified
+        ``storm_log()`` still covers it."""
+        self._record(event, **detail)
+
+    def storm_log(self) -> List[Dict[str, Any]]:
+        """The ONE replayable storm record: the controller's own event
+        log, the link-cut log, and the fault-injection trace of this
+        process, merged and monotonically ordered.
+
+        Before this existed a composed chaos scenario recorded in three
+        places with three schemas; attributing an availability dip to
+        "the partition, not the lease fault" meant hand-joining them.
+        Every entry is normalized to the pinned schema
+        ``{"ts", "source", "event", "detail"}`` with ``source`` one of
+        ``"chaos"`` (process-level events driven from here), ``"link"``
+        (partition cut/heal/auto-heal), ``"fault"`` (site-hook firings —
+        NOTE: only firings in THIS process; sites armed via RT_FAULTS in
+        raylet/worker subprocesses trace in those processes).  ``ts`` is
+        ``time.monotonic()`` of this process; entries sort by it, ties
+        keep insertion order (stable sort)."""
+        entries: List[Dict[str, Any]] = []
+        for e in self.log:
+            entries.append({
+                "ts": e["ts"],
+                "source": "chaos",
+                "event": e["event"],
+                "detail": dict(e["detail"]),
+            })
+        for e in link_log():
+            detail = {k: v for k, v in e.items()
+                      if k not in ("event", "ts")}
+            entries.append({
+                "ts": e.get("ts", 0.0),
+                "source": "link",
+                "event": e["event"],
+                "detail": detail,
+            })
+        for e in trace():
+            entries.append({
+                "ts": e.get("ts", 0.0),
+                "source": "fault",
+                "event": e["action"],
+                "detail": {"site": e["site"], "ctx": e["ctx"],
+                           "hit": e["hit"]},
+            })
+        entries.sort(key=lambda e: e["ts"])
+        return entries
 
     # -- GCS (head) faults ----------------------------------------------
     def kill_gcs(self) -> None:

@@ -17,6 +17,7 @@ Rule ids are stable and grouped by family:
 - RT113 half-checkpoint-pair        (checkpoint)
 - RT114 wall-clock-liveness         (clock)
 - RT115 bytes-copy-on-hot-path      (bytescopy)
+- RT116 unseeded-randomness         (seeded)
 
 The RT2xx series (actor-deadlock, objectref-leak, unserializable-
 capture, rank-divergent-collective) is the whole-program rtflow tier —
@@ -44,6 +45,7 @@ from ray_tpu.devtools.rules.remote_api import (
     NestedBlockingGet,
 )
 from ray_tpu.devtools.rules.retry import UnboundedRetryLoop
+from ray_tpu.devtools.rules.seeded import UnseededRandomness
 from ray_tpu.devtools.rules.traced import ImpureTracedFn
 
 ALL_RULES = [
@@ -62,4 +64,5 @@ ALL_RULES = [
     HalfCheckpointPair,
     WallClockLiveness,
     BytesCopyOnHotPath,
+    UnseededRandomness,
 ]

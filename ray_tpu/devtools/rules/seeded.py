@@ -1,0 +1,199 @@
+"""RT116: unseeded or wall-clock-seeded randomness in replay-critical
+code.
+
+The soak plane's whole contract is that a scenario seed replays: the
+storm timeline, the fault-plan firings, the arrival schedule, the spot
+revocation process — byte-identical scorecards from the same seed.
+One call into Python's GLOBAL random module (``random.random()``,
+``random.choice(...)``) inside that code breaks the contract silently:
+the global RNG is seeded from OS entropy at import and shared with
+every library in the process, so the "replayable" log stops replaying
+and nobody notices until a storm can't be reproduced under a debugger.
+Seeding from the wall clock (``random.Random(time.time())``,
+``rng.seed(time.time_ns())``) is the same bug wearing a seed costume.
+
+Scope: ``soak/`` and ``common/faults.py`` (the replay-critical set) —
+elsewhere ad-hoc randomness is fine and common.  What fires:
+
+- any call through the global random module or a name imported from
+  it (``random.random()``, ``from random import choice; choice(...)``)
+  — replayable code must draw from an explicitly-seeded
+  ``random.Random(seed)`` instance,
+- ``random.Random()`` with no arguments (an unseeded instance is the
+  global RNG with extra steps),
+- a wall-clock call (``time.time()``, ``time.time_ns()``,
+  ``time.monotonic()``) or ``os.urandom`` / ``uuid4`` appearing inside
+  the seed argument of ``random.Random(...)`` / ``.seed(...)``, or
+  assigned to a name containing ``seed``.
+
+``random.Random(f"{seed}:storm")`` — the derived-substream idiom this
+package uses — passes: the argument chain starts from a caller-supplied
+seed, not from entropy.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import astutil
+from ray_tpu.devtools.lint import Rule
+
+#: module-level random functions that draw from the GLOBAL RNG
+_GLOBAL_DRAWS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "expovariate", "gauss", "normalvariate",
+    "betavariate", "triangular", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "lognormvariate", "getrandbits", "seed",
+}
+
+#: entropy sources that make a seed non-replayable
+_ENTROPY_CALLS = {
+    ("time", "time"), ("time", "time_ns"), ("time", "monotonic"),
+    ("time", "monotonic_ns"), ("time", "perf_counter"),
+    ("time", "perf_counter_ns"), ("os", "urandom"), ("uuid", "uuid4"),
+    ("secrets", "token_bytes"), ("secrets", "randbits"),
+}
+
+
+def _is_entropy_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return (fn.value.id, fn.attr) in _ENTROPY_CALLS
+    if isinstance(fn, ast.Name):
+        return any(fn.id == f for _m, f in _ENTROPY_CALLS
+                   if f not in ("time",)) or fn.id == "uuid4"
+    return False
+
+
+def _subtree_has_entropy(node: ast.AST) -> bool:
+    return any(_is_entropy_call(sub) for sub in ast.walk(node))
+
+
+class _SeededVisitor(astutil.ScopedVisitor):
+    def __init__(self, rule, ctx):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self._random_aliases = {"random"}
+        #: bare names bound to global-RNG functions via
+        #: ``from random import choice [as pick]``
+        self._fn_aliases: dict = {}
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            if alias.name == "random":
+                self._random_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module == "random":
+            for alias in node.names:
+                if alias.name in _GLOBAL_DRAWS:
+                    self._fn_aliases[alias.asname or alias.name] = (
+                        alias.name
+                    )
+        self.generic_visit(node)
+
+    # -- classification -------------------------------------------------
+
+    def _global_draw(self, node: ast.Call) -> str:
+        """Name of the global-RNG function this call draws from, or ''."""
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if (
+                isinstance(fn.value, ast.Name)
+                and fn.value.id in self._random_aliases
+                and fn.attr in _GLOBAL_DRAWS
+            ):
+                return f"random.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in self._fn_aliases:
+            return f"random.{self._fn_aliases[fn.id]}"
+        return ""
+
+    def _is_random_ctor(self, node: ast.Call) -> bool:
+        fn = node.func
+        return (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in ("Random", "SystemRandom")
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in self._random_aliases
+        ) or (isinstance(fn, ast.Name) and fn.id in ("Random",
+                                                     "SystemRandom"))
+
+    def visit_Call(self, node: ast.Call):
+        draw = self._global_draw(node)
+        if draw:
+            self.ctx.add(
+                self.rule, node,
+                message=f"{draw}() draws from the process-global RNG — "
+                        "in replay-critical code every draw must come "
+                        "from an explicitly seeded random.Random "
+                        "instance or the scenario can't replay",
+                hint="derive a substream: "
+                     "rng = random.Random(f'{seed}:purpose')",
+            )
+        elif self._is_random_ctor(node):
+            if not node.args and not node.keywords:
+                self.ctx.add(
+                    self.rule, node,
+                    message="random.Random() with no seed is OS entropy "
+                            "— an unseeded instance cannot replay",
+                    hint="pass the scenario seed (or a derived "
+                         "substream string) to Random(...)",
+                )
+            elif any(_subtree_has_entropy(a) for a in node.args) or any(
+                _subtree_has_entropy(kw.value) for kw in node.keywords
+            ):
+                self.ctx.add(
+                    self.rule, node,
+                    message="seeding an RNG from the clock/entropy is "
+                            "unseeded randomness wearing a seed costume "
+                            "— the value differs every run",
+                    hint="seed from the scenario's seed field, never "
+                         "from time.time()/urandom",
+                )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "seed"
+            and any(_subtree_has_entropy(a) for a in node.args)
+        ):
+            self.ctx.add(
+                self.rule, node,
+                message="re-seeding from the clock/entropy makes the "
+                        "stream non-replayable",
+                hint="seed from the scenario's seed field",
+            )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if (
+            any("seed" in n.lower() for n in names)
+            and _subtree_has_entropy(node.value)
+        ):
+            self.ctx.add(
+                self.rule, node,
+                message="a seed derived from the clock/entropy differs "
+                        "every run — the log it stamps can't replay",
+                hint="take the seed from the scenario (or config) "
+                     "instead of time.time()",
+            )
+        self.generic_visit(node)
+
+
+class UnseededRandomness(Rule):
+    id = "RT116"
+    name = "unseeded-randomness"
+    description = (
+        "global-RNG draw or wall-clock-derived seed in replay-critical "
+        "code (soak/, common/faults.py) — seeded replay is the "
+        "contract; one entropy draw silently breaks it"
+    )
+    hint = (
+        "draw from an explicitly seeded random.Random; derive "
+        "substreams as random.Random(f'{seed}:purpose')"
+    )
+    path_markers = ("soak/", "common/faults")
+    visitor_cls = _SeededVisitor

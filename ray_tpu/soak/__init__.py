@@ -1,0 +1,75 @@
+"""ray_tpu.soak — the million-user-day soak plane.
+
+A soak is the integration test the unit suite cannot be: one sustained
+serve workload, every fault plane firing at once from a single seed,
+and an availability scorecard that must EXPLAIN every dip it shows.
+
+Layout (one concern per module):
+
+- ``scenario``  declarative ``SoakScenario``: workload + SLOs + storm
+  composition + armed fault plans, strict JSON round-trip.
+- ``storm``     ``build_storm`` (pure seeded timeline) and the live
+  ``StormDriver`` (timeline → ChaosController).
+- ``load``      the shared open-loop arrival model + HTTP driver +
+  ``RequestRecord`` stream (bench.py serve_rps consumes this too).
+- ``scorecard`` goodput / shed / p99-vs-SLO / per-incident blackout
+  attribution; canonical ``to_json`` is the reproducibility surface.
+- ``sim``       deterministic twin: the scenario through a modeled
+  fleet with REAL FaultController + storm + scorecard code —
+  byte-identical scorecards from the same seed.
+- ``runner``    live mode against a real cluster (proxy → admission →
+  scheduler → autoscaled replicas, storm thread, health sampler).
+- ``spot``      spot-fleet mode: live seeded revocation process and
+  the deterministic throughput-per-cost ledger vs on-demand.
+"""
+
+from ray_tpu.soak.load import (
+    RequestRecord,
+    arrival_offsets,
+    drive_http,
+    summarize,
+)
+from ray_tpu.soak.scenario import (
+    SLOSpec,
+    SoakScenario,
+    StormEvent,
+    StormSpec,
+    WorkloadSpec,
+    acceptance_scenario,
+)
+from ray_tpu.soak.scorecard import Incident, Scorecard, compute_scorecard
+from ray_tpu.soak.sim import SimParams, SimResult, run_sim
+from ray_tpu.soak.spot import (
+    SpotFleet,
+    SpotFleetConfig,
+    economics_rows,
+    run_spot_economics,
+    spot_preempt_times,
+)
+from ray_tpu.soak.storm import StormDriver, build_storm
+
+__all__ = [
+    "Incident",
+    "RequestRecord",
+    "SLOSpec",
+    "Scorecard",
+    "SimParams",
+    "SimResult",
+    "SoakScenario",
+    "SpotFleet",
+    "SpotFleetConfig",
+    "StormDriver",
+    "StormEvent",
+    "StormSpec",
+    "WorkloadSpec",
+    "acceptance_scenario",
+    "arrival_offsets",
+    "build_storm",
+    "compute_scorecard",
+    "drive_http",
+    "economics_rows",
+    "run_sim",
+    "run_spot_economics",
+    "spot_preempt_times",
+    "summarize",
+]

@@ -1,0 +1,180 @@
+"""Open-loop load generation: the ONE traffic model bench and soak share.
+
+Open-loop means arrivals follow a fixed schedule computed up front —
+the client never waits for a response before sending the next request.
+A closed-loop client self-throttles at saturation (each in-flight
+request blocks the next), which HIDES overload: the serve_rps bench and
+the soak plane both exist to measure behavior PAST saturation, so both
+must drive the same open-loop schedule.  Extracted from bench.py's
+serve_rps inline loop so the bench row and the soak scorecard measure
+with identical arrival semantics.
+
+Arrival processes:
+
+- ``poisson`` — exponential inter-arrivals from a ``random.Random``
+  seeded by the scenario (memoryless: bursts and gaps occur naturally,
+  the realistic open-internet shape).  Everything derives from the
+  seed — same seed, same schedule, bit-for-bit (RT116 enforces this
+  discipline package-wide).
+- ``uniform`` — fixed 1/rate spacing (the legacy serve_rps schedule;
+  kept for A/B against old records).
+
+Per-request outcomes are normalized to ``RequestRecord`` — the
+request-latency stream the scorecard window-joins against storm
+events.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+__all__ = [
+    "RequestRecord",
+    "arrival_offsets",
+    "drive_http",
+    "summarize",
+]
+
+
+@dataclass(frozen=True)
+class RequestRecord:
+    """One request in the latency stream.
+
+    ``t_s``        arrival offset from the load window's start (s).
+    ``latency_ms`` admission→completion latency; for sheds, the time to
+                   the 503 (cheap); for errors, time to the failure.
+    ``status``     "ok" | "shed" | "error".
+    """
+
+    t_s: float
+    latency_ms: float
+    status: str
+
+
+def arrival_offsets(
+    rate_rps: float,
+    duration_s: float,
+    seed: Optional[int] = None,
+    process: str = "poisson",
+) -> List[float]:
+    """The open-loop schedule: sorted arrival offsets in [0, duration).
+
+    ``poisson`` draws exponential inter-arrivals from
+    ``random.Random(seed)`` — the seed is REQUIRED for poisson (a
+    schedule that can't be replayed can't feed a reproducible
+    scorecard).  ``uniform`` ignores the seed.
+    """
+    if process == "uniform":
+        n = int(rate_rps * duration_s)
+        return [i / rate_rps for i in range(n)]
+    if process != "poisson":
+        raise ValueError(f"unknown arrival process {process!r}")
+    if seed is None:
+        raise ValueError("poisson arrivals require a seed")
+    rng = random.Random(seed)
+    out: List[float] = []
+    t = rng.expovariate(rate_rps)
+    while t < duration_s:
+        out.append(t)
+        t += rng.expovariate(rate_rps)
+    return out
+
+
+async def drive_http(
+    url: str,
+    offsets: Sequence[float],
+    warmup: int = 10,
+    ready_timeout_s: float = 30.0,
+    request_timeout_s: float = 30.0,
+    on_start=None,
+) -> List[RequestRecord]:
+    """Fire the schedule at ``url`` (GET) and collect the latency
+    stream.  200 → ok, 503 → shed, anything else (or a transport
+    error) → error.  Waits for a first 200 (route/replica readiness)
+    and runs ``warmup`` unrecorded requests before the clock starts.
+    ``on_start`` (if given) is called exactly when the schedule clock
+    starts — the soak runner uses it to launch the storm on the same
+    t0 so event offsets and request offsets share one timeline.
+    """
+    import asyncio
+    import time
+
+    import aiohttp
+
+    records: List[RequestRecord] = []
+    timeout = aiohttp.ClientTimeout(total=request_timeout_s)
+
+    async with aiohttp.ClientSession(timeout=timeout) as sess:
+
+        async def one(t_arrive: float, record: bool = True):
+            t0 = time.perf_counter()
+            try:
+                async with sess.get(url) as r:
+                    await r.read()
+                    status = (
+                        "ok" if r.status == 200
+                        else "shed" if r.status == 503
+                        else "error"
+                    )
+            except Exception:
+                status = "error"
+            if record:
+                records.append(RequestRecord(
+                    t_s=t_arrive,
+                    latency_ms=(time.perf_counter() - t0) * 1000.0,
+                    status=status,
+                ))
+
+        # readiness: first 200 within the window, then warmup
+        deadline = time.monotonic() + ready_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                async with sess.get(url) as r:
+                    await r.read()
+                    if r.status == 200:
+                        break
+            except Exception:
+                pass
+            await asyncio.sleep(0.3)
+        for _ in range(warmup):
+            await one(0.0, record=False)
+
+        if on_start is not None:
+            on_start()
+        t_start = time.perf_counter()
+        tasks = []
+        for off in offsets:
+            delay = t_start + off - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.ensure_future(one(off)))
+        await asyncio.gather(*tasks)
+    return records
+
+
+def summarize(records: Sequence[RequestRecord],
+              elapsed_s: Optional[float] = None) -> dict:
+    """The serve_rps row shape: admitted rate + latency percentiles of
+    the OK stream, shed rate over everything offered."""
+    ok = sorted(r.latency_ms for r in records if r.status == "ok")
+    n = len(records)
+    if elapsed_s is None:
+        elapsed_s = max((r.t_s for r in records), default=0.0) or 1.0
+
+    def pct(p: float) -> float:
+        if not ok:
+            return 0.0
+        return ok[min(len(ok) - 1, int(p / 100.0 * len(ok)))]
+
+    return {
+        "offered": n,
+        "admitted_rps": round(len(ok) / max(elapsed_s, 1e-9), 1),
+        "p50_ms": round(pct(50), 1),
+        "p99_ms": round(pct(99), 1),
+        "shed_rate": round(
+            sum(1 for r in records if r.status == "shed") / max(1, n), 3
+        ),
+        "errors": sum(1 for r in records if r.status == "error"),
+    }

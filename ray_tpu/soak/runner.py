@@ -1,0 +1,197 @@
+"""Live soak: the scenario against a real cluster.
+
+``run_live`` assumes an initialized runtime (``ray_tpu.init`` +
+``serve.start()`` already done by the caller — same contract as the
+serve tests) and drives the FULL production path: aiohttp proxy →
+admission → RequestScheduler → autoscaled replicas, while the storm
+thread delivers the scenario's seeded timeline through
+``ChaosController`` (drain-protocol preemptions, directional
+partitions with auto-heal, hard kills) and the armed ``RT_FAULTS``
+plans fire on their nth hits in every process.  A health-sampler
+thread polls the ``node_health`` rpc through the storm so the
+scorecard's incident join has phi/suspect/incarnation evidence.
+
+Wall-clock latencies are measured, so a live scorecard's NUMBERS are
+not byte-stable — the storm timeline, the unified log schema, and the
+attribution structure are what reproduce (the deterministic twin lives
+in ``soak.sim``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ray_tpu.common.faults import ChaosController
+from ray_tpu.soak import load as soak_load
+from ray_tpu.soak.scenario import SoakScenario
+from ray_tpu.soak.scorecard import Scorecard, compute_scorecard
+from ray_tpu.soak.storm import StormDriver, build_storm
+
+__all__ = ["LiveSoakResult", "HealthSampler", "run_live"]
+
+
+@dataclass
+class LiveSoakResult:
+    scorecard: Scorecard
+    records: List[soak_load.RequestRecord]
+    storm_log: List[dict]
+    health_samples: List[dict]
+    applied_events: List[dict] = field(default_factory=list)
+    t0: float = 0.0
+
+
+class HealthSampler:
+    """Polls the GCS ``node_health`` rpc on a thread; flattens each
+    reply into per-node rows the scorecard window-joins."""
+
+    def __init__(self, interval_s: float = 0.5):
+        self.interval_s = interval_s
+        self.samples: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _poll_once(self) -> None:
+        from ray_tpu.core.runtime import get_runtime
+
+        try:
+            rt = get_runtime()
+            rows = rt._run(rt.gcs.call("node_health", {}), timeout=2.0)
+        except Exception:
+            return  # GCS briefly unreachable mid-storm: skip the beat
+        now = time.monotonic()
+        for nid, r in rows.items():
+            self.samples.append({
+                "t_s": now,
+                "node": nid,
+                "phi": r.get("phi"),
+                "suspect": bool(r.get("suspect")),
+                "incarnation": r.get("incarnation"),
+                "alive": bool(r.get("alive")),
+            })
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._poll_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="soak-health", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def deploy_workload(scenario: SoakScenario, name: str = "soak",
+                    route: str = "/soak", port: int = 18765,
+                    actor_options: Optional[dict] = None) -> str:
+    """Deploy the scenario's workload (fixed-service-time deployment
+    under the scenario's traffic + autoscaling policy) and return the
+    proxy URL.  ``actor_options`` pins replica placement (tests use a
+    custom resource to put replicas on the storm's victim nodes)."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+
+    w = scenario.workload
+    service_s = w.service_ms / 1000.0
+
+    @serve.deployment(
+        ray_actor_options=actor_options or {},
+        max_ongoing_requests=w.max_ongoing,
+        traffic_config={
+            "slo_ms": w.slo_ms,
+            "max_queue_depth": w.max_queue_depth,
+            "shed_retry_after_s": 0.5,
+            "target_queue_depth_per_replica":
+                w.target_queue_depth_per_replica,
+            "stats_push_interval_s": 0.2,
+            "drain_timeout_s": 10.0,
+        },
+        autoscaling_config={
+            "min_replicas": w.min_replicas,
+            "max_replicas": w.max_replicas,
+            "target_ongoing_requests": float(w.max_ongoing),
+            "upscale_delay_s": w.upscale_delay_s,
+            "downscale_delay_s": w.downscale_delay_s,
+        },
+    )
+    class Fixed:
+        async def __call__(self):
+            await asyncio.sleep(service_s)
+            return "ok"
+
+    serve.run(Fixed.bind(), name=name, route_prefix=route)
+    proxy = serve_api._get_or_create_proxy(port)
+    actual = ray_tpu.get(proxy.start.remote(), timeout=60)
+    return f"http://127.0.0.1:{actual}{route}"
+
+
+def run_live(
+    scenario: SoakScenario,
+    cluster,
+    url: Optional[str] = None,
+    port: int = 18765,
+    actor_options: Optional[dict] = None,
+) -> LiveSoakResult:
+    """Run the scenario against ``cluster`` (a ``cluster_utils.Cluster``
+    with the runtime already initialized against it).  Deploys the
+    workload unless ``url`` points at one already deployed.
+
+    NOTE on fault plans: nth-hit site faults must be armed BEFORE the
+    cluster spawns (``faults.plans_to_json`` → ``RT_FAULTS`` env) for
+    subprocesses to inherit them; plans installed after spawn only
+    cover the driver process.  The runner does not arm them itself —
+    arming is a spawn-time decision the caller owns.
+    """
+    if url is None:
+        url = deploy_workload(scenario, name=scenario.name, port=port,
+                              actor_options=actor_options)
+
+    controller = ChaosController(cluster, seed=scenario.seed)
+    driver = StormDriver(controller, build_storm(scenario))
+    sampler = HealthSampler()
+
+    offsets = soak_load.arrival_offsets(
+        scenario.workload.offered_rps,
+        scenario.duration_s,
+        seed=f"{scenario.seed}:arrivals",
+        process=scenario.workload.arrival_process,
+    )
+
+    t0_box = {"t0": 0.0}
+
+    def _go():
+        t0_box["t0"] = time.monotonic()
+        driver.start(t0_box["t0"])
+
+    sampler.start()
+    try:
+        records = asyncio.run(soak_load.drive_http(
+            url, offsets, on_start=_go,
+            request_timeout_s=max(5.0, scenario.workload.slo_ms / 250.0),
+        ))
+        driver.join(timeout=scenario.duration_s + 30.0)
+    finally:
+        sampler.stop()
+
+    storm_log = controller.storm_log()
+    card = compute_scorecard(
+        scenario, records, storm_log, sampler.samples, t0=t0_box["t0"]
+    )
+    return LiveSoakResult(
+        scorecard=card,
+        records=records,
+        storm_log=storm_log,
+        health_samples=sampler.samples,
+        applied_events=driver.applied,
+        t0=t0_box["t0"],
+    )

@@ -1,0 +1,242 @@
+"""The declarative soak scenario: workload + SLOs + seeded storm.
+
+A ``SoakScenario`` is the whole experiment in one JSON-serializable
+value: the serve workload to sustain (service time, offered rate,
+queueing/autoscaling policy), the SLOs the scorecard enforces, the
+storm to deliver while the workload runs (counts and shapes of
+preemptions / partitions / node kills, expanded into a concrete
+timeline by ``storm.build_storm`` as a pure function of the seed), and
+the nth-hit fault plans armed at t=0 (``RT_FAULTS`` inheritance pushes
+them into every cluster subprocess).
+
+Everything nondeterministic derives from ``seed`` — arrivals, storm
+timing, victim choice, fault-plan firing.  Same scenario JSON ⇒ same
+storm timeline ⇒ (in sim mode) the same scorecard byte-for-byte.
+``from_dict`` is strict like ``FaultPlan.from_dict``: a typo'd field
+silently disarming half the storm makes the soak lie, so it raises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+from ray_tpu.common.faults import FaultPlan
+
+__all__ = [
+    "SLOSpec",
+    "SoakScenario",
+    "StormEvent",
+    "StormSpec",
+    "WorkloadSpec",
+    "acceptance_scenario",
+]
+
+
+def _strict_fields(cls, d: dict) -> dict:
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - names
+    if unknown:
+        raise ValueError(
+            f"{cls.__name__} has no field(s) {sorted(unknown)}; "
+            f"valid fields: {sorted(names)}"
+        )
+    return {k: d[k] for k in names if k in d}
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    """The sustained serve workload (the PR 6 serve_rps shape scaled
+    up): a fixed-service-time deployment under SLO-aware traffic
+    management with queue-driven replica autoscaling live."""
+
+    service_ms: float = 100.0
+    max_ongoing: int = 4
+    #: open-loop offered rate; capacity per replica is
+    #: max_ongoing * 1000 / service_ms
+    offered_rps: float = 30.0
+    arrival_process: str = "poisson"
+    slo_ms: float = 750.0
+    max_queue_depth: int = 32
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_queue_depth_per_replica: float = 4.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkloadSpec":
+        return cls(**_strict_fields(cls, d))
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """What the scorecard enforces.  ``goodput_floor`` is the fraction
+    of OFFERED requests that must complete inside the per-request
+    ``WorkloadSpec.slo_ms`` budget over the whole run — the one number
+    that speaks to availability under storm."""
+
+    p99_ms: float = 750.0
+    goodput_floor: float = 0.6
+    shed_ceiling: float = 0.35
+    max_error_rate: float = 0.05
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SLOSpec":
+        return cls(**_strict_fields(cls, d))
+
+
+@dataclass(frozen=True)
+class StormSpec:
+    """Storm composition knobs; ``storm.build_storm`` expands them into
+    a concrete ``StormEvent`` timeline from the scenario seed.  Events
+    land inside [start_frac, end_frac] of the run so the scorecard sees
+    a clean head and tail to baseline against."""
+
+    preempts: int = 1
+    preempt_deadline_s: float = 4.0
+    partitions: int = 1
+    partition_duration_s: float = 2.0
+    node_kills: int = 0
+    start_frac: float = 0.2
+    end_frac: float = 0.8
+    #: minimum spacing between consecutive storm events — overlapping
+    #: recoveries are a (harder) scenario of their own; 0 allows pileup
+    min_gap_s: float = 2.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StormSpec":
+        return cls(**_strict_fields(cls, d))
+
+
+@dataclass(frozen=True)
+class StormEvent:
+    """One concrete timeline entry: at ``t_s`` (offset from load
+    start), apply ``kind`` with ``args``.
+
+    Kinds: ``preempt`` (spot notice → drain → kill; args victim,
+    deadline_s), ``partition`` (directional-pair cut victim<->gcs;
+    args victim, duration_s — heal is the auto-heal deadline),
+    ``kill`` (hard node kill, no notice; args victim).  ``victim`` is a
+    stable worker INDEX into the scenario's initial worker list —
+    resolved to a live node id by whichever harness (sim or cluster)
+    executes the timeline.
+    """
+
+    t_s: float
+    kind: str
+    args: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"t_s": self.t_s, "kind": self.kind,
+                "args": dict(self.args)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StormEvent":
+        out = _strict_fields(cls, d)
+        out["args"] = dict(out.get("args") or {})
+        return cls(**out)
+
+
+@dataclass(frozen=True)
+class SoakScenario:
+    name: str = "soak"
+    seed: int = 0
+    duration_s: float = 30.0
+    initial_workers: int = 2
+    workload: WorkloadSpec = field(default_factory=WorkloadSpec)
+    slo: SLOSpec = field(default_factory=SLOSpec)
+    storm: StormSpec = field(default_factory=StormSpec)
+    #: nth-hit / seeded-probability site faults armed for the WHOLE run
+    #: in EVERY cluster process (rpc.send.frame, raylet.lease.grant,
+    #: store.put, ... — the PR 7 registry)
+    fault_plans: Tuple[FaultPlan, ...] = ()
+    #: scorecard binning + attribution knobs
+    bucket_s: float = 1.0
+    attribution_window_s: float = 6.0
+
+    def capacity_rps(self) -> float:
+        """Saturation rate of ONE replica (arithmetic, not a mood)."""
+        w = self.workload
+        return w.max_ongoing * 1000.0 / w.service_ms
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "duration_s": self.duration_s,
+            "initial_workers": self.initial_workers,
+            "workload": self.workload.to_dict(),
+            "slo": self.slo.to_dict(),
+            "storm": self.storm.to_dict(),
+            "fault_plans": [p.to_dict() for p in self.fault_plans],
+            "bucket_s": self.bucket_s,
+            "attribution_window_s": self.attribution_window_s,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SoakScenario":
+        out = _strict_fields(cls, d)
+        if "workload" in out:
+            out["workload"] = WorkloadSpec.from_dict(out["workload"])
+        if "slo" in out:
+            out["slo"] = SLOSpec.from_dict(out["slo"])
+        if "storm" in out:
+            out["storm"] = StormSpec.from_dict(out["storm"])
+        out["fault_plans"] = tuple(
+            FaultPlan.from_dict(p) for p in out.get("fault_plans", ())
+        )
+        return cls(**out)
+
+    @classmethod
+    def from_json(cls, text: str) -> "SoakScenario":
+        return cls.from_dict(json.loads(text))
+
+
+def acceptance_scenario(seed: int = 7,
+                        duration_s: float = 30.0) -> SoakScenario:
+    """The ISSUE-18 acceptance shape: ≥3 fault planes active at once —
+    a preemption notice (drain plane), a directional partition + heal
+    (health plane), and nth-hit injected rpc + lease faults (chaos
+    plane) — under queue-driven autoscaling, all derived from one
+    seed."""
+    return SoakScenario(
+        name="acceptance",
+        seed=seed,
+        duration_s=duration_s,
+        initial_workers=2,
+        # min_replicas=2 spreads the serving set across both workers so
+        # the storm's victims are never spectators; 50 rps against
+        # 2 × 40 rps capacity keeps both replicas earning
+        workload=WorkloadSpec(
+            service_ms=100.0, max_ongoing=4, offered_rps=50.0,
+            slo_ms=750.0, max_queue_depth=32,
+            min_replicas=2, max_replicas=4,
+        ),
+        slo=SLOSpec(p99_ms=750.0, goodput_floor=0.6,
+                    shed_ceiling=0.35, max_error_rate=0.05),
+        storm=StormSpec(preempts=1, partitions=1,
+                        partition_duration_s=2.0, node_kills=0),
+        fault_plans=(
+            FaultPlan(site="rpc.send.frame", action="drop",
+                      nth=40, count=3, seed=seed),
+            FaultPlan(site="raylet.lease.grant", action="kill",
+                      nth=5, count=1, seed=seed + 1),
+            FaultPlan(site="store.put", action="error",
+                      nth=30, count=1, seed=seed + 2),
+        ),
+    )

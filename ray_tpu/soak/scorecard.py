@@ -1,0 +1,347 @@
+"""The availability scorecard: goodput, shed, p99-vs-SLO, and
+per-incident blackout attribution.
+
+``compute_scorecard`` is a PURE function of its inputs — the request
+latency stream, the unified storm log, and the health-plane samples —
+so the scorecard of a deterministic (sim-harness) run is byte-stable:
+``Scorecard.to_json()`` canonicalizes (sorted keys, floats rounded) and
+two runs from the same scenario seed produce identical bytes.  For a
+live run the same code path renders measured numbers; what stays
+reproducible there is the storm timeline and the attribution
+STRUCTURE.
+
+Blackout attribution (the method, also in docs/architecture.md):
+
+1. Bin the request stream into ``bucket_s`` windows; per bucket count
+   in-SLO completions, sheds, errors.
+2. A bucket is a DIP when it contains errors, or when its in-SLO
+   completion count falls below half the run's median bucket (the
+   robust baseline — the storm occupies a minority of buckets by
+   construction, so the median is a clean-weather number).
+3. Window-join each dip bucket against the storm log's process-level
+   events (preemption notices, partitions, node kills): an event
+   explains a dip if the dip starts inside
+   [event_ts, event_ts + attribution_window_s (+ partition duration)].
+   The LATEST explaining event wins — blame the nearest cause.
+4. Dip buckets attributed to the same event group into one
+   ``Incident`` carrying blackout seconds, lost in-SLO completions vs
+   the median baseline, shed/error counts, the health plane's evidence
+   over the window (max phi, suspect nodes, incarnation bumps), and
+   the site-fault firings that landed inside it.
+5. Dips no event explains land in ``unattributed_dips`` — the
+   acceptance gate asserts this list is EMPTY: every availability dip
+   must trace to a storm event, or the soak found a bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ray_tpu.soak.load import RequestRecord
+from ray_tpu.soak.scenario import SoakScenario
+
+__all__ = ["Incident", "Scorecard", "compute_scorecard"]
+
+#: storm-log (source, event) pairs that can own an incident
+_INCIDENT_EVENTS = {
+    ("chaos", "node_preempt"),
+    ("chaos", "node_kill"),
+    ("chaos", "partition"),
+    ("chaos", "cut"),
+    ("chaos", "spot_preempt"),
+    ("chaos", "gcs_kill"),
+    ("link", "cut"),
+}
+
+
+@dataclass
+class Incident:
+    """One storm event and the availability damage attributed to it."""
+
+    event: str
+    event_ts: float
+    detail: dict
+    start_s: float
+    end_s: float
+    blackout_s: float
+    ok_lost: float
+    shed: int
+    errors: int
+    max_phi: Optional[float] = None
+    suspect_nodes: List[str] = field(default_factory=list)
+    incarnation_bumps: int = 0
+    fault_firings: List[dict] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class Scorecard:
+    scenario: str
+    seed: int
+    duration_s: float
+    offered: int
+    completed_ok: int
+    in_slo: int
+    goodput_rps: float
+    #: in-SLO completions / offered — what SLOSpec.goodput_floor gates
+    goodput_frac: float
+    shed: int
+    shed_rate: float
+    errors: int
+    error_rate: float
+    p50_ms: float
+    p99_ms: float
+    slo_p99_ms: float
+    #: fraction of buckets that were NOT dips
+    availability: float
+    slo_pass: bool
+    slo_failures: List[str]
+    incidents: List[Incident]
+    unattributed_dips: List[dict]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return _round_floats(d)
+
+    def to_json(self) -> str:
+        """Canonical rendering — the bit-reproducibility surface."""
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def to_rows(self) -> List[dict]:
+        """bench.py ``soak_availability`` row family."""
+        rows = [{
+            "metric": "soak_availability",
+            "value": round(self.availability, 4),
+            "unit": "frac",
+            "goodput_rps": round(self.goodput_rps, 2),
+            "goodput_frac": round(self.goodput_frac, 4),
+            "shed_rate": round(self.shed_rate, 4),
+            "error_rate": round(self.error_rate, 4),
+            "p99_ms": round(self.p99_ms, 1),
+            "slo_p99_ms": self.slo_p99_ms,
+            "slo_pass": self.slo_pass,
+            "incidents": len(self.incidents),
+            "unattributed_dips": len(self.unattributed_dips),
+            "scenario": self.scenario,
+            "seed": self.seed,
+        }]
+        for inc in self.incidents:
+            rows.append({
+                "metric": "soak_incident",
+                "value": round(inc.blackout_s, 2),
+                "unit": "s blackout",
+                "event": inc.event,
+                "at_s": round(inc.event_ts, 2),
+                "ok_lost": round(inc.ok_lost, 1),
+                "shed": inc.shed,
+                "errors": inc.errors,
+                "max_phi": (
+                    round(inc.max_phi, 2)
+                    if inc.max_phi is not None else None
+                ),
+                "suspects": len(inc.suspect_nodes),
+            })
+        return rows
+
+
+def _round_floats(x, ndigits: int = 6):
+    if isinstance(x, float):
+        return round(x, ndigits)
+    if isinstance(x, dict):
+        return {k: _round_floats(v, ndigits) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_round_floats(v, ndigits) for v in x]
+    return x
+
+
+def _pct(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(p / 100.0 * len(sorted_vals)))]
+
+
+def compute_scorecard(
+    scenario: SoakScenario,
+    records: Sequence[RequestRecord],
+    storm_log: Sequence[dict] = (),
+    health_samples: Sequence[dict] = (),
+    t0: float = 0.0,
+) -> Scorecard:
+    """Render the scorecard.  ``records`` carry offsets from the load
+    window start; ``storm_log``/``health_samples`` timestamps are
+    normalized by subtracting ``t0`` (pass the monotonic load-start of
+    a live run; sim harnesses emit offsets directly and pass 0).
+
+    ``health_samples`` rows: ``{"t_s", "node", "phi", "suspect",
+    "incarnation", "alive"}`` — the ``rpc_node_health`` reply flattened
+    per node per poll."""
+    slo_ms = scenario.workload.slo_ms
+    bucket_s = scenario.bucket_s
+    n_buckets = max(1, int(round(scenario.duration_s / bucket_s)))
+
+    ok_lat = sorted(r.latency_ms for r in records if r.status == "ok")
+    offered = len(records)
+    completed_ok = len(ok_lat)
+    in_slo_total = sum(1 for v in ok_lat if v <= slo_ms)
+    shed = sum(1 for r in records if r.status == "shed")
+    errors = sum(1 for r in records if r.status == "error")
+
+    # -- bucketize ------------------------------------------------------
+    b_in_slo = [0] * n_buckets
+    b_shed = [0] * n_buckets
+    b_err = [0] * n_buckets
+    b_total = [0] * n_buckets
+    for r in records:
+        i = min(n_buckets - 1, max(0, int(r.t_s / bucket_s)))
+        b_total[i] += 1
+        if r.status == "ok" and r.latency_ms <= slo_ms:
+            b_in_slo[i] += 1
+        elif r.status == "shed":
+            b_shed[i] += 1
+        elif r.status == "error":
+            b_err[i] += 1
+    median_ok = sorted(b_in_slo)[n_buckets // 2]
+
+    def is_dip(i: int) -> bool:
+        if b_err[i] > 0:
+            return True
+        # dip = the bucket SERVED under half of what arrived in it —
+        # judged against the bucket's own offered count, not the run
+        # median, so an open-loop Poisson lull (few arrivals, all
+        # served) never reads as a blackout.  Requests are bucketed by
+        # ARRIVAL time, so a stall shows up here as arrivals whose
+        # latency blew the SLO.  Low-count guard: < 4 arrivals carries
+        # no signal either way.
+        return b_total[i] >= 4 and b_in_slo[i] < 0.5 * b_total[i]
+
+    dips = [i for i in range(n_buckets) if is_dip(i)]
+
+    # -- storm events that can own an incident --------------------------
+    events = []
+    for e in storm_log:
+        if (e.get("source"), e.get("event")) in _INCIDENT_EVENTS:
+            ev = dict(e)
+            ev["t_s"] = float(e.get("ts", 0.0)) - t0
+            events.append(ev)
+    # "latest explaining event wins" below — at equal timestamps the
+    # process-level chaos event must outrank its own low-level link
+    # rows, so sort link entries first
+    events.sort(key=lambda e: (e["t_s"], 0 if e["source"] == "link" else 1))
+
+    def explains(ev: dict, dip_start: float) -> bool:
+        window = scenario.attribution_window_s
+        window += float(ev.get("detail", {}).get("duration_s") or 0.0)
+        # the bucket containing the event counts too, hence - bucket_s
+        return ev["t_s"] - bucket_s <= dip_start <= ev["t_s"] + window
+
+    # -- attribute dips -------------------------------------------------
+    by_event: Dict[int, List[int]] = {}
+    unattributed: List[dict] = []
+    for i in dips:
+        dip_start = i * bucket_s
+        owner = None
+        for k, ev in enumerate(events):
+            if explains(ev, dip_start):
+                owner = k  # latest explaining event wins (sorted asc)
+        if owner is None:
+            unattributed.append({
+                "bucket_s": dip_start,
+                "in_slo": b_in_slo[i],
+                "shed": b_shed[i],
+                "errors": b_err[i],
+            })
+        else:
+            by_event.setdefault(owner, []).append(i)
+
+    incidents: List[Incident] = []
+    for k in sorted(by_event):
+        ev, idxs = events[k], by_event[k]
+        start = min(idxs) * bucket_s
+        end = (max(idxs) + 1) * bucket_s
+        h = [s for s in health_samples
+             if start <= float(s.get("t_s", 0.0)) - t0 <= end]
+        phis = [s["phi"] for s in h if s.get("phi") is not None]
+        suspects = sorted({s["node"] for s in h if s.get("suspect")})
+        bumps = 0
+        first_inc: Dict[str, int] = {}
+        for s in h:
+            node, inc = s.get("node"), s.get("incarnation")
+            if node is None or inc is None:
+                continue
+            if node in first_inc and inc > first_inc[node]:
+                bumps += 1
+            first_inc.setdefault(node, inc)
+        firings = [
+            {"site": e.get("detail", {}).get("site"),
+             "t_s": round(float(e.get("ts", 0.0)) - t0, 3)}
+            for e in storm_log
+            if e.get("source") == "fault"
+            and start <= float(e.get("ts", 0.0)) - t0 <= end
+        ]
+        incidents.append(Incident(
+            event=ev["event"],
+            event_ts=round(ev["t_s"], 3),
+            detail=dict(ev.get("detail", {})),
+            start_s=start,
+            end_s=end,
+            blackout_s=len(idxs) * bucket_s,
+            ok_lost=sum(max(0.0, median_ok - b_in_slo[i])
+                        for i in idxs),
+            shed=sum(b_shed[i] for i in idxs),
+            errors=sum(b_err[i] for i in idxs),
+            max_phi=max(phis) if phis else None,
+            suspect_nodes=suspects,
+            incarnation_bumps=bumps,
+            fault_firings=firings,
+        ))
+
+    # -- SLO verdict ----------------------------------------------------
+    goodput_frac = in_slo_total / offered if offered else 0.0
+    shed_rate = shed / offered if offered else 0.0
+    error_rate = errors / offered if offered else 0.0
+    p99 = _pct(ok_lat, 99)
+    failures = []
+    if goodput_frac < scenario.slo.goodput_floor:
+        failures.append(
+            f"goodput {goodput_frac:.3f} < floor "
+            f"{scenario.slo.goodput_floor}"
+        )
+    if shed_rate > scenario.slo.shed_ceiling:
+        failures.append(
+            f"shed {shed_rate:.3f} > ceiling {scenario.slo.shed_ceiling}"
+        )
+    if error_rate > scenario.slo.max_error_rate:
+        failures.append(
+            f"errors {error_rate:.3f} > max {scenario.slo.max_error_rate}"
+        )
+    if p99 > scenario.slo.p99_ms:
+        failures.append(f"p99 {p99:.1f}ms > {scenario.slo.p99_ms}ms")
+
+    return Scorecard(
+        scenario=scenario.name,
+        seed=scenario.seed,
+        duration_s=scenario.duration_s,
+        offered=offered,
+        completed_ok=completed_ok,
+        in_slo=in_slo_total,
+        goodput_rps=in_slo_total / scenario.duration_s,
+        goodput_frac=goodput_frac,
+        shed=shed,
+        shed_rate=shed_rate,
+        errors=errors,
+        error_rate=error_rate,
+        p50_ms=_pct(ok_lat, 50),
+        p99_ms=p99,
+        slo_p99_ms=scenario.slo.p99_ms,
+        availability=(n_buckets - len(dips)) / n_buckets,
+        slo_pass=not failures,
+        slo_failures=failures,
+        incidents=incidents,
+        unattributed_dips=unattributed,
+    )

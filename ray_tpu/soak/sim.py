@@ -1,0 +1,462 @@
+"""Deterministic soak harness: the bit-reproducible half of the plane.
+
+A live soak measures wall-clock latencies — real, but never
+byte-stable.  This harness runs the SAME scenario through a
+discrete-time model of the serve fleet instead, and it deliberately
+reuses every piece of production policy code that is pure enough to
+run under simulated time:
+
+- the storm timeline comes from ``storm.build_storm`` (identical to
+  the live run's),
+- site faults are evaluated by a REAL ``faults.FaultController`` —
+  the sim calls ``hit()`` at the same named sites (``rpc.send.frame``
+  per dispatch, ``raylet.lease.grant`` per replica launch,
+  ``store.put`` per result) so nth-hit windows and seeded-p draws
+  exercise the actual selection code,
+- arrivals come from ``load.arrival_offsets`` (the shared open-loop
+  Poisson model),
+- the scorecard is ``scorecard.compute_scorecard`` verbatim.
+
+What IS modeled: replica occupancy/queueing (max_ongoing slots, fixed
+service time, bounded queue with admission + deadline expiry — the PR 6
+queue model), queue-driven replica autoscaling with launch latency,
+and each fault plane's availability signature with constants taken
+from the measured PR 9/10 benches (drain blackout ~ms, phi suspect
+detection ~0.6 s, partition rpc timeouts).  The sim is single-threaded
+and consumes no wall clock or OS entropy, so the whole run — request
+stream, storm log, health samples, scorecard — is a pure function of
+the scenario: ``run_sim(s).scorecard.to_json()`` is byte-identical
+across runs and hosts.  That is the regression net: a cross-feature
+policy change that shifts availability math shows up as a scorecard
+diff, pinned by seed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.common.faults import FaultController
+from ray_tpu.soak.load import RequestRecord, arrival_offsets
+from ray_tpu.soak.scenario import SoakScenario
+from ray_tpu.soak.scorecard import Scorecard, compute_scorecard
+from ray_tpu.soak.storm import build_storm
+
+__all__ = ["SimParams", "SimResult", "run_sim"]
+
+
+@dataclass(frozen=True)
+class SimParams:
+    """Availability constants of the modeled planes — each one anchored
+    to a measured number from BENCH.md rather than invented."""
+
+    dt_s: float = 0.01
+    #: phi-accrual suspect detection after a partition's silence starts
+    #: (failure_detection bench: ~0.6 s at 100 ms beats)
+    partition_detect_s: float = 0.6
+    #: a dispatch into the undetected-partition window times out
+    partition_error_s: float = 1.0
+    #: router re-admits a healed node's replicas after this long
+    partition_rejoin_s: float = 0.3
+    #: graceful-drain migration blackout (preemption_recovery bench:
+    #: ~2 ms object/actor — modeled as one dispatch tick)
+    preempt_migrate_s: float = 0.3
+    #: hook-less restart after a HARD kill (fault_recovery bench:
+    #: ~450 ms lease+spawn)
+    kill_restart_s: float = 1.0
+    kill_error_s: float = 0.5
+    #: replica launch latency for autoscale scale-up
+    replica_launch_s: float = 0.5
+    #: fresh NODE provisioning latency (spot-fleet replacement)
+    node_launch_s: float = 2.0
+    #: retry penalty a fired rpc drop/reset costs one request
+    rpc_retry_s: float = 0.2
+    store_retry_s: float = 0.1
+    lease_fault_delay_s: float = 1.0
+    autoscale_tick_s: float = 0.25
+    health_sample_s: float = 0.5
+    #: keep simulating (no new arrivals) this long past duration so
+    #: in-flight work lands in the record stream
+    tail_s: float = 5.0
+
+
+@dataclass
+class SimResult:
+    scorecard: Scorecard
+    records: List[RequestRecord]
+    storm_log: List[dict]
+    health_samples: List[dict]
+    #: node-seconds by price actually accrued (spot economics input)
+    node_seconds: float = 0.0
+    replica_launches: int = 0
+    min_up_nodes: int = 0
+
+
+class _Node:
+    __slots__ = ("idx", "up", "draining_since", "down_at",
+                 "partition_t", "heal_t", "incarnation", "launched_at")
+
+    def __init__(self, idx: int, t: float = 0.0):
+        self.idx = idx
+        self.up = True
+        self.draining_since: Optional[float] = None
+        self.down_at: Optional[float] = None
+        self.partition_t: Optional[float] = None
+        self.heal_t: Optional[float] = None
+        self.incarnation = 1
+        self.launched_at = t
+
+
+class _Replica:
+    __slots__ = ("node", "busy", "ready_at")
+
+    def __init__(self, node: int, ready_at: float = 0.0):
+        self.node = node
+        self.busy = 0
+        self.ready_at = ready_at
+
+
+def run_sim(
+    scenario: SoakScenario,
+    params: SimParams = SimParams(),
+    replace_nodes: bool = False,
+    preempt_extra: Optional[List[dict]] = None,
+) -> SimResult:
+    """One deterministic soak.  ``replace_nodes`` models a provider +
+    min_workers floor behind the fleet (spot mode): a downed node is
+    re-provisioned after ``node_launch_s`` with a bumped incarnation.
+    ``preempt_extra`` injects additional ``{"t_s", "victim",
+    "deadline_s"}`` preemptions (the spot-fleet arrival process) on top
+    of the scenario storm."""
+    w = scenario.workload
+    p = params
+    ctl = FaultController(list(scenario.fault_plans))
+
+    nodes = [_Node(i) for i in range(max(1, scenario.initial_workers))]
+    replicas = [
+        _Replica(i % len(nodes)) for i in range(w.min_replicas)
+    ]
+    storm_log: List[dict] = []
+    health: List[dict] = []
+    records: List[RequestRecord] = []
+
+    def log(source: str, event: str, t: float, **detail):
+        storm_log.append({"ts": t, "source": source, "event": event,
+                          "detail": detail})
+
+    def hit(site: str, ctx: str, t: float) -> Optional[str]:
+        plan = ctl.hit(site, ctx)
+        if plan is None:
+            return None
+        log("fault", plan.action, t, site=site, ctx=ctx)
+        return plan.action
+
+    # -- storm timeline (shared with the live driver) -------------------
+    events = [
+        {"t_s": ev.t_s, "kind": ev.kind, "args": dict(ev.args)}
+        for ev in build_storm(scenario)
+    ]
+    for ex in (preempt_extra or []):
+        events.append({
+            "t_s": float(ex["t_s"]), "kind": "preempt",
+            "args": {"victim": int(ex["victim"]),
+                     "deadline_s": float(ex.get("deadline_s", 4.0)),
+                     "spot": True},
+        })
+    events.sort(key=lambda e: e["t_s"])
+
+    arrivals = arrival_offsets(
+        w.offered_rps, scenario.duration_s,
+        seed=f"{scenario.seed}:arrivals", process=w.arrival_process,
+    )
+
+    # queue entries: (arrival_t, deadline_t); in-flight:
+    # (complete_at, arrival_t, replica_idx, fails: bool)
+    queue: List[tuple] = []
+    inflight: List[list] = []
+    pending_replicas: List[float] = []  # ready_at times of launches
+    pending_nodes: List[tuple] = []  # (ready_at, reuse_idx)
+    over_since: Optional[float] = None
+    idle_since: Optional[float] = None
+    next_autoscale = 0.0
+    next_health = 0.0
+    node_seconds = 0.0
+    replica_launches = 0
+    min_up = len(nodes)
+    ai = 0  # next arrival index
+    ei = 0  # next storm event index
+
+    def live_node(n: _Node, t: float) -> bool:
+        return n.up
+
+    def routable(n: _Node, t: float) -> bool:
+        """Router willingly dispatches here: up, not mid-partition
+        (once DETECTED), not healing, not mid-drain-migration."""
+        if not n.up:
+            return False
+        if n.draining_since is not None:
+            return False
+        if n.partition_t is not None:
+            det = n.partition_t + p.partition_detect_s
+            if t >= det and (n.heal_t is None
+                             or t < n.heal_t + p.partition_rejoin_s):
+                return False
+        return True
+
+    def blind_partitioned(n: _Node, t: float) -> bool:
+        """Partition started but phi hasn't crossed suspect yet — the
+        router still dispatches here, and those requests time out."""
+        return (
+            n.up and n.partition_t is not None
+            and n.partition_t <= t < n.partition_t + p.partition_detect_s
+        )
+
+    def place_replicas(victim_idx: int, t: float, delay: float):
+        """Re-place the victim node's replicas on routable survivors
+        (fewest-first); with no survivor they park and re-place when a
+        node returns."""
+        targets = [n for n in nodes if n.up and n.idx != victim_idx
+                   and n.draining_since is None]
+        for r in replicas:
+            if r.node == victim_idx:
+                if targets:
+                    tgt = min(
+                        targets,
+                        key=lambda n: sum(1 for x in replicas
+                                          if x.node == n.idx),
+                    )
+                    r.node = tgt.idx
+                r.busy = 0
+                r.ready_at = max(r.ready_at, t + delay)
+
+    def apply_event(ev: dict, t: float):
+        nonlocal replica_launches
+        kind = ev["kind"]
+        up_nodes = [n for n in nodes if n.up]
+        if not up_nodes:
+            log("chaos", "storm_skip", t, kind=kind,
+                reason="no live nodes")
+            return
+        victim = up_nodes[int(ev["args"].get("victim", 0)) % len(up_nodes)]
+        nid = f"sim-{victim.idx}"
+        if kind == "preempt":
+            deadline = float(ev["args"].get("deadline_s", 4.0))
+            victim.draining_since = t
+            victim.down_at = t + deadline
+            place_replicas(victim.idx, t, p.preempt_migrate_s)
+            # the lease for each migrated replica rides the lease site
+            for _ in [r for r in replicas if r.node != victim.idx]:
+                if hit("raylet.lease.grant", "soak.migrate", t) == "kill":
+                    pass  # grant retried: modeled inside migrate delay
+            log("chaos", "node_preempt", t, node_id=nid,
+                deadline_s=deadline,
+                spot=bool(ev["args"].get("spot")))
+            if replace_nodes:
+                pending_nodes.append((t + deadline + p.node_launch_s,
+                                      victim.idx))
+        elif kind == "partition":
+            d = float(ev["args"].get("duration_s", 2.0))
+            victim.partition_t = t
+            victim.heal_t = t + d
+            log("chaos", "partition", t, a=nid, b="gcs", duration_s=d)
+            log("link", "cut", t, src=nid, dst="gcs", duration_s=d)
+            log("link", "cut", t, src="gcs", dst=nid, duration_s=d)
+        elif kind == "kill":
+            victim.up = False
+            victim.down_at = t
+            for f in inflight:
+                r = replicas[f[2]]
+                if r.node == victim.idx:
+                    f[3] = True  # fails at its (shortened) deadline
+                    f[0] = min(f[0], t + p.kill_error_s)
+            place_replicas(victim.idx, t, p.kill_restart_s)
+            log("chaos", "node_kill", t, node_id=nid, graceful=False)
+            if replace_nodes:
+                pending_nodes.append((t + p.node_launch_s, victim.idx))
+
+    t = 0.0
+    end = scenario.duration_s + p.tail_s
+    while t < end:
+        # 1. storm
+        while ei < len(events) and events[ei]["t_s"] <= t:
+            apply_event(events[ei], t)
+            ei += 1
+        # node lifecycle: drain completion, heal, replacement
+        for n in nodes:
+            if n.up and n.down_at is not None and t >= n.down_at:
+                n.up = False
+                if n.draining_since is not None:
+                    log("chaos", "node_kill", t,
+                        node_id=f"sim-{n.idx}", graceful=True)
+                n.draining_since = None
+            if (n.partition_t is not None and n.heal_t is not None
+                    and t >= n.heal_t + p.partition_rejoin_s):
+                log("link", "auto_heal", n.heal_t,
+                    src=f"sim-{n.idx}", dst="gcs")
+                n.partition_t = n.heal_t = None
+        for ready_at, idx in list(pending_nodes):
+            if t >= ready_at:
+                pending_nodes.remove((ready_at, idx))
+                n = nodes[idx]
+                n.up = True
+                n.down_at = None
+                n.incarnation += 1
+                n.launched_at = t
+                log("chaos", "node_launch", t, node_id=f"sim-{idx}",
+                    incarnation=n.incarnation)
+        min_up = min(min_up, sum(1 for n in nodes if n.up))
+        node_seconds += sum(1 for n in nodes if n.up) * p.dt_s
+
+        # 2. autoscale (queue-depth driven, PR 6 controller shape)
+        if t >= next_autoscale:
+            next_autoscale = t + p.autoscale_tick_s
+            n_rep = len(replicas) + len(pending_replicas)
+            depth_per = len(queue) / max(1, n_rep)
+            if depth_per > w.target_queue_depth_per_replica:
+                idle_since = None
+                if over_since is None:
+                    over_since = t
+                elif (t - over_since >= w.upscale_delay_s
+                      and n_rep < w.max_replicas):
+                    launch = p.replica_launch_s
+                    if hit("raylet.lease.grant", "soak.scale_up",
+                           t) is not None:
+                        launch += p.lease_fault_delay_s
+                    pending_replicas.append(t + launch)
+                    replica_launches += 1
+                    over_since = t
+            else:
+                over_since = None
+                busy = sum(r.busy for r in replicas)
+                if len(queue) == 0 and busy <= 1:
+                    if idle_since is None:
+                        idle_since = t
+                    elif (t - idle_since >= w.downscale_delay_s
+                          and len(replicas) > w.min_replicas):
+                        idle = [r for r in replicas if r.busy == 0]
+                        if idle:
+                            replicas.remove(idle[-1])
+                            idle_since = t
+                else:
+                    idle_since = None
+        for ready in list(pending_replicas):
+            if t >= ready:
+                pending_replicas.remove(ready)
+                targets = [n for n in nodes if routable(n, t)]
+                if targets:
+                    tgt = min(targets, key=lambda n: sum(
+                        1 for x in replicas if x.node == n.idx))
+                    replicas.append(_Replica(tgt.idx, ready_at=t))
+                else:  # nowhere to land yet: retry next tick
+                    pending_replicas.append(t + p.dt_s)
+
+        # 3. completions
+        for f in list(inflight):
+            if f[0] <= t:
+                inflight.remove(f)
+                complete_at, arrival, ridx, fails = f
+                if ridx < len(replicas):
+                    replicas[ridx].busy = max(
+                        0, replicas[ridx].busy - 1)
+                lat_ms = (complete_at - arrival) * 1000.0
+                if fails:
+                    records.append(RequestRecord(arrival, lat_ms,
+                                                 "error"))
+                else:
+                    if hit("store.put", "soak.result",
+                           complete_at) is not None:
+                        lat_ms += p.store_retry_s * 1000.0
+                    records.append(RequestRecord(arrival, lat_ms, "ok"))
+
+        # 4. admission of arrivals due by now
+        while ai < len(arrivals) and arrivals[ai] <= t:
+            a = arrivals[ai]
+            ai += 1
+            if len(queue) >= w.max_queue_depth:
+                records.append(RequestRecord(a, 1.0, "shed"))
+                continue
+            # predicted-delay trip (admission.py shape)
+            cap = max(1, len(replicas)) * w.max_ongoing
+            predicted_ms = (len(queue) / cap) * w.service_ms
+            if predicted_ms > w.slo_ms:
+                records.append(RequestRecord(a, 1.0, "shed"))
+                continue
+            queue.append((a, a + w.slo_ms / 1000.0))
+
+        # 5. deadline expiry sweep (EDF shed of lapsed queue entries)
+        for q in list(queue):
+            if q[1] <= t:
+                queue.remove(q)
+                records.append(RequestRecord(
+                    q[0], (t - q[0]) * 1000.0, "shed"))
+
+        # 6. dispatch — least-busy among replicas the router BELIEVES
+        # healthy: a blind-partitioned node is an equal candidate until
+        # phi crosses suspect (the router can't route around silence it
+        # hasn't detected), and dispatches there time out
+        while queue:
+            ridx = None
+            for i, r in enumerate(replicas):
+                if r.busy >= w.max_ongoing or r.ready_at > t:
+                    continue
+                n = nodes[r.node]
+                if not (routable(n, t) or blind_partitioned(n, t)):
+                    continue
+                if ridx is None or r.busy < replicas[ridx].busy:
+                    ridx = i
+            if ridx is None:
+                break
+            arrival, _deadline = queue.pop(0)
+            r = replicas[ridx]
+            slot = None if blind_partitioned(nodes[r.node], t) else ridx
+            r.busy += 1
+            penalty = 0.0
+            act = hit("rpc.send.frame", "soak.dispatch", t)
+            if act in ("drop", "reset", "delay"):
+                penalty += p.rpc_retry_s
+            elif act == "error":
+                inflight.append([t + 0.05, arrival, ridx, True])
+                continue
+            if slot is None:  # dispatched into the undetected partition
+                inflight.append(
+                    [t + p.partition_error_s, arrival, ridx, True])
+            else:
+                inflight.append(
+                    [t + penalty + w.service_ms / 1000.0,
+                     arrival, ridx, False])
+
+        # 7. health samples
+        if t >= next_health:
+            next_health = t + p.health_sample_s
+            for n in nodes:
+                phi = 0.02
+                suspect = False
+                if n.partition_t is not None and t >= n.partition_t:
+                    silent = t - n.partition_t
+                    if n.heal_t is not None and t > n.heal_t:
+                        silent = 0.0
+                    phi = 0.02 + 3.0 * silent / p.partition_detect_s
+                    suspect = phi >= 3.0
+                health.append({
+                    "t_s": round(t, 3), "node": f"sim-{n.idx}",
+                    "phi": round(phi, 3), "suspect": suspect,
+                    "incarnation": n.incarnation, "alive": n.up,
+                })
+
+        if (ai >= len(arrivals) and not inflight and not queue
+                and t >= scenario.duration_s):
+            break
+        t = round(t + p.dt_s, 6)
+
+    records.sort(key=lambda r: (r.t_s, r.status, r.latency_ms))
+    storm_log.sort(key=lambda e: e["ts"])
+    card = compute_scorecard(scenario, records, storm_log, health, t0=0.0)
+    return SimResult(
+        scorecard=card,
+        records=records,
+        storm_log=storm_log,
+        health_samples=health,
+        node_seconds=round(node_seconds, 6),
+        replica_launches=replica_launches,
+        min_up_nodes=min_up,
+    )

@@ -1,0 +1,242 @@
+"""Spot-fleet mode: deliberately provision preemptible capacity and
+price the churn.
+
+The spot bet: preemptible nodes cost a fraction of on-demand but the
+provider revokes them with a short notice.  This module supplies both
+halves of evaluating that bet:
+
+- ``SpotFleet`` — the LIVE revocation process.  A seeded arrival
+  process picks a preemptible provider node and delivers the full GCE
+  preemption sequence through production machinery: GCS
+  ``drain_node(reason="preemption")`` (PR 9 drain plane evacuates
+  leases/actors/sole-copy objects), poll ``get_drain_status`` to
+  settle, then provider ``terminate_node`` — while the autoscaler's
+  min_workers floor launches the replacement (draining nodes are
+  excluded from its counts, so replacement provisioning OVERLAPS the
+  drain).  Every revocation lands in the unified storm log via
+  ``ChaosController.record_external``.
+
+- ``run_spot_economics`` — the DETERMINISTIC ledger.  Two ``soak.sim``
+  runs from the same scenario seed: an on-demand fleet (scenario storm
+  only) and a spot fleet (same storm PLUS the seeded revocation
+  process, nodes replaced at provisioning latency), each accruing
+  node-seconds.  The verdict is throughput-per-cost: in-SLO
+  completions per node-second-dollar, spot vs on-demand, plus the
+  goodput each fleet kept.  Byte-stable like every sim scorecard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ray_tpu.soak.scenario import SoakScenario
+from ray_tpu.soak.sim import SimParams, run_sim
+
+__all__ = [
+    "SpotFleet",
+    "SpotFleetConfig",
+    "economics_rows",
+    "economics_to_json",
+    "run_spot_economics",
+    "spot_preempt_times",
+]
+
+_SETTLED = ("drained", "failed", "dead", "none", "unknown")
+
+
+@dataclass(frozen=True)
+class SpotFleetConfig:
+    """Economics + churn knobs.  Prices are relative $/node-second
+    (only the RATIO matters); the default 0.35 is the classic ~65%
+    spot discount."""
+
+    spot_price: float = 0.35
+    ondemand_price: float = 1.0
+    #: mean revocations per minute across the fleet (seeded Poisson)
+    preempts_per_min: float = 4.0
+    preempt_deadline_s: float = 3.0
+    #: revocations only land inside this window of the run (the head
+    #: and tail stay clean so the scorecard has a baseline)
+    start_frac: float = 0.15
+    end_frac: float = 0.9
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def spot_preempt_times(scenario: SoakScenario,
+                       cfg: SpotFleetConfig) -> List[dict]:
+    """The seeded revocation schedule: Poisson arrivals inside the
+    config window, victims drawn per event — all from
+    ``random.Random(f"{seed}:spot")`` (RT116 discipline: replayable or
+    it didn't happen)."""
+    rng = random.Random(f"{scenario.seed}:spot")
+    rate_s = cfg.preempts_per_min / 60.0
+    lo = scenario.duration_s * cfg.start_frac
+    hi = scenario.duration_s * cfg.end_frac
+    out: List[dict] = []
+    t = lo + rng.expovariate(rate_s) if rate_s > 0 else hi
+    while t < hi:
+        out.append({
+            "t_s": round(t, 3),
+            "victim": rng.randrange(max(1, scenario.initial_workers)),
+            "deadline_s": cfg.preempt_deadline_s,
+        })
+        t += rng.expovariate(rate_s)
+    return out
+
+
+def run_spot_economics(
+    scenario: SoakScenario,
+    cfg: SpotFleetConfig = SpotFleetConfig(),
+    params: SimParams = SimParams(),
+) -> dict:
+    """Same seed, two fleets, one ledger.  Returns a dict whose
+    ``json.dumps(..., sort_keys=True)`` is byte-stable across runs."""
+    ondemand = run_sim(scenario, params=params, replace_nodes=True)
+    spot = run_sim(
+        scenario, params=params, replace_nodes=True,
+        preempt_extra=spot_preempt_times(scenario, cfg),
+    )
+
+    def ledger(res, price: float) -> dict:
+        cost = res.node_seconds * price
+        in_slo = res.scorecard.in_slo
+        return {
+            "in_slo": in_slo,
+            "goodput_frac": round(res.scorecard.goodput_frac, 6),
+            "availability": round(res.scorecard.availability, 6),
+            "node_seconds": round(res.node_seconds, 3),
+            "cost": round(cost, 6),
+            "throughput_per_cost": round(in_slo / cost, 6) if cost else 0.0,
+            "incidents": len(res.scorecard.incidents),
+        }
+
+    od = ledger(ondemand, cfg.ondemand_price)
+    sp = ledger(spot, cfg.spot_price)
+    advantage = (
+        sp["throughput_per_cost"] / od["throughput_per_cost"]
+        if od["throughput_per_cost"] else 0.0
+    )
+    return {
+        "scenario": scenario.name,
+        "seed": scenario.seed,
+        "config": cfg.to_dict(),
+        "ondemand": od,
+        "spot": sp,
+        #: >1 means the discount beat the churn
+        "spot_advantage": round(advantage, 4),
+        "spot_goodput_retained": round(
+            sp["goodput_frac"] / od["goodput_frac"], 4
+        ) if od["goodput_frac"] else 0.0,
+    }
+
+
+def economics_to_json(econ: dict) -> str:
+    return json.dumps(econ, sort_keys=True)
+
+
+def economics_rows(econ: dict) -> List[dict]:
+    """bench.py ``soak_spot_economics`` row."""
+    return [{
+        "metric": "soak_spot_economics",
+        "value": econ["spot_advantage"],
+        "unit": "x throughput/cost vs on-demand",
+        "spot_tpc": econ["spot"]["throughput_per_cost"],
+        "ondemand_tpc": econ["ondemand"]["throughput_per_cost"],
+        "spot_goodput": econ["spot"]["goodput_frac"],
+        "ondemand_goodput": econ["ondemand"]["goodput_frac"],
+        "goodput_retained": econ["spot_goodput_retained"],
+        "preempts_per_min": econ["config"]["preempts_per_min"],
+        "price_ratio": round(
+            econ["config"]["spot_price"]
+            / econ["config"]["ondemand_price"], 3
+        ),
+        "seed": econ["seed"],
+    }]
+
+
+class SpotFleet:
+    """Live seeded revocation process against an autoscaler provider.
+
+    The caller owns the reconcile cadence (tests step
+    ``Autoscaler.reconcile()`` themselves); the fleet owns WHEN and WHO:
+    ``preempt_due(now_s)`` delivers every revocation whose scheduled
+    offset has passed, each one drain-protocol-first.  Victims are
+    drawn seeded among nodes of PREEMPTIBLE types only — on-demand
+    nodes in a mixed fleet are never revoked.
+    """
+
+    def __init__(self, gcs, provider, preemptible_types,
+                 seed: int = 0, deadline_s: float = 3.0,
+                 controller=None):
+        self.gcs = gcs
+        self.provider = provider
+        self.preemptible_types = set(preemptible_types)
+        self.rng = random.Random(f"{seed}:spot")
+        self.deadline_s = deadline_s
+        self.controller = controller
+        self.preempted: List[str] = []
+
+    def _record(self, event: str, **detail) -> None:
+        if self.controller is not None:
+            self.controller.record_external(event, **detail)
+
+    def _pick(self):
+        cands = sorted(
+            (pn for pn in self.provider.non_terminated_nodes()
+             if pn.node_type in self.preemptible_types
+             and pn.provider_id not in self.preempted),
+            key=lambda pn: pn.provider_id,
+        )
+        if not cands:
+            return None
+        return cands[self.rng.randrange(len(cands))]
+
+    async def preempt_one(self) -> Optional[str]:
+        """One full revocation: notice → drain → settle → terminate.
+        Returns the provider id of the victim (None if the fleet has no
+        revocable node right now)."""
+        import asyncio
+        import time
+
+        pn = self._pick()
+        if pn is None:
+            self._record("spot_preempt_skip", reason="no preemptible node")
+            return None
+        self.preempted.append(pn.provider_id)
+        nids = pn.meta.get("node_ids") or [pn.node_id_hex]
+        self._record("spot_preempt", provider_id=pn.provider_id,
+                     node_ids=nids, node_type=pn.node_type,
+                     deadline_s=self.deadline_s)
+        for nid in nids:
+            try:
+                await self.gcs.call(
+                    "drain_node",
+                    {"node_id": nid, "reason": "preemption",
+                     "deadline_s": self.deadline_s},
+                )
+            except Exception:
+                pass  # node may already be gone; the kill below settles it
+        deadline = time.monotonic() + self.deadline_s + 2.0
+        while time.monotonic() < deadline:
+            try:
+                states = [
+                    (await self.gcs.call(
+                        "get_drain_status", {"node_id": nid}
+                    ) or {}).get("state")
+                    for nid in nids
+                ]
+                if all(s in _SETTLED for s in states):
+                    break
+            except Exception:
+                pass
+            await asyncio.sleep(0.1)
+        await asyncio.to_thread(self.provider.terminate_node, pn)
+        self._record("spot_kill", provider_id=pn.provider_id,
+                     node_ids=nids)
+        return pn.provider_id

@@ -1,0 +1,162 @@
+"""Storm timeline: seeded composition of every fault plane at once.
+
+``build_storm`` expands a scenario's ``StormSpec`` into a concrete,
+sorted ``StormEvent`` timeline as a PURE function of the scenario seed
+— no wall clock, no OS entropy (RT116 polices this file).  The same
+scenario therefore storms identically in the deterministic sim harness
+and against a live cluster; what differs between the two is only how
+an event is APPLIED.
+
+``StormDriver`` is the live half: it walks the timeline against a
+``cluster_utils.Cluster`` through the PR 7 ``ChaosController`` —
+preemption notices ride the PR 9 drain protocol (notice → drain →
+kill), partitions ride the PR 10 directional link-cut registry with
+auto-heal, node kills are the hard path — so every applied event lands
+in the controller's replayable log and the unified ``storm_log()``.
+The nth-hit site faults (rpc/lease/store) are NOT timeline events:
+they are armed at t=0 via ``RT_FAULTS`` inheritance and fire on their
+own hit schedules; their firings surface in ``storm_log()`` through
+``faults.trace()``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from ray_tpu.soak.scenario import SoakScenario, StormEvent
+
+__all__ = ["StormDriver", "build_storm"]
+
+
+def build_storm(scenario: SoakScenario) -> List[StormEvent]:
+    """The concrete timeline: event times uniform inside
+    [start_frac, end_frac] of the run, sorted, then pushed apart to
+    ``min_gap_s`` (overlapping recoveries are a separate, harder
+    scenario — the gap keeps one incident's blackout attributable to
+    one event); kinds shuffled; victims drawn per event.  Everything
+    from ``random.Random(f"{seed}:storm")``."""
+    spec = scenario.storm
+    rng = random.Random(f"{scenario.seed}:storm")
+    kinds: List[str] = (
+        ["preempt"] * spec.preempts
+        + ["partition"] * spec.partitions
+        + ["kill"] * spec.node_kills
+    )
+    if not kinds:
+        return []
+    rng.shuffle(kinds)
+    lo = scenario.duration_s * spec.start_frac
+    hi = scenario.duration_s * spec.end_frac
+    times = sorted(rng.uniform(lo, hi) for _ in kinds)
+    for i in range(1, len(times)):
+        if times[i] - times[i - 1] < spec.min_gap_s:
+            times[i] = times[i - 1] + spec.min_gap_s
+    events: List[StormEvent] = []
+    for t, kind in zip(times, kinds):
+        victim = rng.randrange(max(1, scenario.initial_workers))
+        if kind == "preempt":
+            args = {"victim": victim,
+                    "deadline_s": spec.preempt_deadline_s}
+        elif kind == "partition":
+            args = {"victim": victim,
+                    "duration_s": spec.partition_duration_s}
+        else:
+            args = {"victim": victim}
+        events.append(StormEvent(t_s=round(t, 3), kind=kind, args=args))
+    return events
+
+
+class StormDriver:
+    """Executes a timeline against a live cluster in a worker thread.
+
+    Victim indices resolve against the INITIAL worker roster (the
+    non-head nodes present when the driver starts); if the indexed node
+    has since died, the next live worker substitutes — a real storm
+    hits whoever is there, and the substitution is recorded so the log
+    still explains what ran.  ``ChaosController.preempt_node`` blocks
+    through the drain, so a long drain pushes later events back — the
+    recorded ``ts`` of each applied event, not the planned ``t_s``, is
+    what the scorecard joins against.
+    """
+
+    def __init__(self, controller, events: Sequence[StormEvent],
+                 workers: Optional[list] = None):
+        self.controller = controller
+        self.events = list(events)
+        cluster = controller.cluster
+        self.workers = list(
+            workers if workers is not None
+            else [n for n in cluster._nodes if n is not cluster.head_node]
+        )
+        self._thread: Optional[threading.Thread] = None
+        self.applied: List[dict] = []
+
+    # -- victim resolution ----------------------------------------------
+    def _resolve(self, idx: int):
+        live = [n for n in self.controller.cluster._nodes
+                if n is not self.controller.cluster.head_node]
+        if not live:
+            return None, False
+        if idx < len(self.workers) and self.workers[idx] in live:
+            return self.workers[idx], False
+        # indexed worker already dead: the storm hits whoever is there
+        return live[idx % len(live)], True
+
+    def _apply(self, ev: StormEvent) -> None:
+        node, substituted = self._resolve(int(ev.args.get("victim", 0)))
+        if node is None:
+            self.controller.record_external(
+                "storm_skip", kind=ev.kind, planned_t_s=ev.t_s,
+                reason="no live workers",
+            )
+            return
+        detail = {"planned_t_s": ev.t_s, "substituted": substituted}
+        if ev.kind == "preempt":
+            self.controller.preempt_node(
+                node, deadline_s=float(ev.args.get("deadline_s", 4.0))
+            )
+        elif ev.kind == "partition":
+            self.controller.partition(
+                node, "gcs",
+                duration_s=float(ev.args.get("duration_s", 2.0)),
+            )
+        elif ev.kind == "kill":
+            self.controller.kill_node(node)
+        else:
+            self.controller.record_external(
+                "storm_skip", kind=ev.kind, planned_t_s=ev.t_s,
+                reason="unknown kind",
+            )
+            return
+        self.applied.append({"kind": ev.kind, "node_id": node.node_id,
+                             **detail})
+
+    def _run(self, t0: float) -> None:
+        for ev in self.events:
+            delay = t0 + ev.t_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                self._apply(ev)
+            except Exception as e:  # a dead victim must not end the storm
+                self.controller.record_external(
+                    "storm_error", kind=ev.kind, planned_t_s=ev.t_s,
+                    error=repr(e),
+                )
+
+    def start(self, t0: Optional[float] = None) -> None:
+        """Begin delivering events relative to ``t0`` (defaults to
+        now — pass the load window's start so event offsets line up
+        with request offsets)."""
+        t0 = time.monotonic() if t0 is None else t0
+        self._thread = threading.Thread(
+            target=self._run, args=(t0,), name="soak-storm", daemon=True
+        )
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)

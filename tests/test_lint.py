@@ -1135,6 +1135,98 @@ class TestCliAllTiers:
 
 
 # ---------------------------------------------------------------------------
+# RT116 unseeded-randomness (scoped: soak/, common/faults)
+# ---------------------------------------------------------------------------
+
+
+SOAK_PATH = "ray_tpu/soak/storm.py"
+
+
+class TestUnseededRandomness:
+    def test_flags_global_rng_draw_in_soak(self):
+        src = """
+        import random
+
+        def pick_victim(workers):
+            return workers[random.randrange(len(workers))]
+        """
+        assert rule_ids(src, path=SOAK_PATH,
+                        rules=["RT116"]) == ["RT116"]
+
+    def test_flags_from_import_alias_draw(self):
+        src = """
+        from random import choice as pick
+
+        def victim(workers):
+            return pick(workers)
+        """
+        assert rule_ids(src, path=SOAK_PATH,
+                        rules=["RT116"]) == ["RT116"]
+
+    def test_flags_unseeded_random_instance(self):
+        src = """
+        import random
+
+        def build(scenario):
+            rng = random.Random()
+            return rng.uniform(0, scenario.duration_s)
+        """
+        assert rule_ids(src, path=SOAK_PATH,
+                        rules=["RT116"]) == ["RT116"]
+
+    def test_flags_wall_clock_seed(self):
+        # unseeded randomness wearing a seed costume
+        src = """
+        import random
+        import time
+
+        def build(scenario):
+            rng = random.Random(int(time.time()))
+            return rng.random()
+        """
+        assert rule_ids(src, path=SOAK_PATH,
+                        rules=["RT116"]) == ["RT116"]
+
+    def test_flags_seed_variable_from_clock(self):
+        src = """
+        import time
+
+        def make_plan():
+            seed = time.time_ns()
+            return seed
+        """
+        assert rule_ids(src, path=SOAK_PATH,
+                        rules=["RT116"]) == ["RT116"]
+
+    def test_silent_on_derived_substream(self):
+        # the compliant twin: the package's substream idiom — every
+        # draw rides an instance seeded from the scenario
+        src = """
+        import random
+
+        def build(scenario):
+            rng = random.Random(f"{scenario.seed}:storm")
+            times = sorted(
+                rng.uniform(0.0, scenario.duration_s) for _ in range(3)
+            )
+            victim = rng.randrange(scenario.initial_workers)
+            return times, victim
+        """
+        assert rule_ids(src, path=SOAK_PATH, rules=["RT116"]) == []
+
+    def test_silent_outside_replay_critical_paths(self):
+        # same violation elsewhere in the tree: out of scope by design
+        src = """
+        import random
+
+        def jitter():
+            return random.random()
+        """
+        assert rule_ids(src, path="ray_tpu/serve/router.py",
+                        rules=["RT116"]) == []
+
+
+# ---------------------------------------------------------------------------
 # The gate: the installed package stays clean
 # ---------------------------------------------------------------------------
 

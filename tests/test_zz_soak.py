@@ -1,0 +1,699 @@
+"""Soak plane: seeded chaos-storm scenarios, availability scorecard,
+spot-fleet economics.
+
+The acceptance contract (ISSUE 18): a seeded ``SoakScenario`` with at
+least three fault planes firing at once — a preemption notice (drain
+plane), a directional partition + heal (health plane), and nth-hit
+rpc/lease site faults (chaos plane) — under queue-driven autoscaling,
+completing with a scorecard that is BYTE-IDENTICAL across two runs of
+the same seed, SLO-enforced goodput, and a per-incident blackout
+breakdown that attributes every availability dip to a storm event.
+The deterministic half runs through ``soak.sim`` (real
+FaultController, real storm timeline, real scorecard, simulated
+fleet); the live half drives a real cluster + serve + ChaosController
+and asserts the structural contract (measured wall-clock numbers are
+not byte-stable and are not pinned).
+
+NOTE on the filename: sorts past the tier-1 870 s truncation window on
+purpose (see test_zz_chaos.py) — the live soak and spot-fleet churn
+tests are multi-process and ``slow``-marked.
+"""
+
+import asyncio
+import dataclasses
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.common import faults
+from ray_tpu.common.faults import (
+    ChaosController,
+    FaultController,
+    FaultPlan,
+    plans_from_json,
+    plans_to_json,
+)
+from ray_tpu.soak import (
+    SLOSpec,
+    SoakScenario,
+    StormSpec,
+    WorkloadSpec,
+    acceptance_scenario,
+    arrival_offsets,
+    build_storm,
+    run_sim,
+    run_spot_economics,
+    spot_preempt_times,
+    summarize,
+)
+from ray_tpu.soak.load import RequestRecord
+from ray_tpu.soak.scorecard import compute_scorecard
+from ray_tpu.soak.spot import SpotFleetConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No chaos may leak across tests (or into the rest of the suite)."""
+    yield
+    faults.clear()
+    faults.clear_links()
+    os.environ.pop("RT_FAULTS", None)
+
+
+# ---------------------------------------------------------------------------
+# Scenario: strict JSON round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioRoundTrip:
+    def test_acceptance_scenario_round_trips(self):
+        s = acceptance_scenario(seed=11, duration_s=42.0)
+        s2 = SoakScenario.from_json(s.to_json())
+        assert s2 == s
+        assert s2.to_json() == s.to_json()
+
+    def test_fault_plans_survive_the_trip(self):
+        s = acceptance_scenario(seed=3)
+        s2 = SoakScenario.from_json(s.to_json())
+        assert s2.fault_plans == s.fault_plans
+        assert {p.site for p in s2.fault_plans} == {
+            "rpc.send.frame", "raylet.lease.grant", "store.put"
+        }
+
+    def test_unknown_field_raises(self):
+        d = acceptance_scenario().to_dict()
+        d["durations_s"] = 10.0  # typo'd duration_s
+        with pytest.raises(ValueError, match="durations_s"):
+            SoakScenario.from_dict(d)
+
+    def test_nested_unknown_field_raises(self):
+        d = acceptance_scenario().to_dict()
+        d["storm"]["premepts"] = 5  # typo'd preempts
+        with pytest.raises(ValueError, match="premepts"):
+            SoakScenario.from_dict(d)
+
+    def test_capacity_is_arithmetic(self):
+        s = SoakScenario(workload=WorkloadSpec(service_ms=100.0,
+                                               max_ongoing=4))
+        assert s.capacity_rps() == 40.0
+
+
+# ---------------------------------------------------------------------------
+# plans_to_json: the full-schema pin (satellite a)
+# ---------------------------------------------------------------------------
+
+
+class TestPlansJsonSchemaPin:
+    """Round-trip pin over EVERY FaultPlan field.  A PR 9 review found
+    ``delay_s`` silently dropped by serialization — a chaos plan's
+    announced drain deadline rewritten by the wire format.  This pin
+    makes any field regression (dropped, renamed, default-swallowed
+    when explicit) fail loudly."""
+
+    FULL_PLAN = FaultPlan(
+        site="node.preempt", action="preempt", match="raylet",
+        nth=3, count=2, p=0.25, seed=99, delay_s=7.5,
+    )
+
+    def test_every_field_round_trips(self):
+        (back,) = plans_from_json(plans_to_json([self.FULL_PLAN]))
+        assert back == self.FULL_PLAN
+        for f in FaultPlan._FIELDS:
+            assert getattr(back, f) == getattr(self.FULL_PLAN, f), f
+
+    def test_non_default_delay_s_survives_for_any_action(self):
+        # the regression: delay_s only serialized for action="delay"
+        for action in ("preempt", "drop", "error", "kill"):
+            p = FaultPlan(site="rpc.send.frame", action=action,
+                          delay_s=3.25)
+            (back,) = plans_from_json(plans_to_json([p]))
+            assert back.delay_s == 3.25, action
+
+    def test_wire_schema_key_set_is_pinned(self):
+        d = json.loads(plans_to_json([self.FULL_PLAN]))[0]
+        assert set(d) == {"site", "action", "match", "nth", "count",
+                          "p", "seed", "delay_s"}
+
+    def test_unknown_wire_key_raises(self):
+        rows = json.loads(plans_to_json([self.FULL_PLAN]))
+        rows[0]["mach"] = "typo"
+        with pytest.raises(ValueError, match="mach"):
+            plans_from_json(json.dumps(rows))
+
+    def test_env_var_inheritance_shape(self):
+        # what subprocess arming actually consumes
+        os.environ["RT_FAULTS"] = plans_to_json([self.FULL_PLAN])
+        assert plans_from_json(os.environ["RT_FAULTS"]) == [
+            self.FULL_PLAN
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Storm timeline: pure function of the seed
+# ---------------------------------------------------------------------------
+
+
+class TestBuildStorm:
+    def test_same_seed_same_timeline(self):
+        s = acceptance_scenario(seed=5)
+        assert build_storm(s) == build_storm(s)
+
+    def test_different_seed_different_timeline(self):
+        a = build_storm(acceptance_scenario(seed=5))
+        b = build_storm(acceptance_scenario(seed=6))
+        assert a != b
+
+    def test_counts_match_spec(self):
+        s = dataclasses.replace(
+            acceptance_scenario(seed=2),
+            storm=StormSpec(preempts=2, partitions=3, node_kills=1,
+                            min_gap_s=0.5),
+            duration_s=60.0,
+        )
+        kinds = [e.kind for e in build_storm(s)]
+        assert kinds.count("preempt") == 2
+        assert kinds.count("partition") == 3
+        assert kinds.count("kill") == 1
+
+    def test_window_and_gap_respected(self):
+        s = dataclasses.replace(
+            acceptance_scenario(seed=9),
+            storm=StormSpec(preempts=2, partitions=2, min_gap_s=2.0),
+            duration_s=60.0,
+        )
+        evs = build_storm(s)
+        times = [e.t_s for e in evs]
+        assert times == sorted(times)
+        assert times[0] >= 60.0 * s.storm.start_frac
+        for a, b in zip(times, times[1:]):
+            assert b - a >= s.storm.min_gap_s - 1e-9
+
+    def test_victims_are_worker_indices(self):
+        s = acceptance_scenario(seed=4)
+        for ev in build_storm(s):
+            v = ev.args["victim"]
+            assert 0 <= v < s.initial_workers
+
+
+# ---------------------------------------------------------------------------
+# The unified storm log (satellite: one replayable record)
+# ---------------------------------------------------------------------------
+
+
+class TestUnifiedStormLog:
+    def test_merges_all_three_sources_in_one_schema(self):
+        """chaos events + link cuts + fault firings land in ONE log,
+        every entry normalized to {"ts", "source", "event", "detail"},
+        monotonically ordered."""
+        faults.install([FaultPlan(site="store.put", action="error",
+                                  nth=1, count=1)])
+        ctl = ChaosController(cluster=None, seed=0)
+        ctl.record_external("spot_preempt", provider_id="prov-1")
+        faults.ACTIVE.hit("store.put", "test.ctx")
+        faults.cut_link("aaaa", "gcs")
+        faults.heal_link("aaaa", "gcs")
+        log = ctl.storm_log()
+
+        assert {e["source"] for e in log} == {"chaos", "link", "fault"}
+        for e in log:
+            assert set(e) == {"ts", "source", "event", "detail"}, e
+        ts = [e["ts"] for e in log]
+        assert ts == sorted(ts)
+
+        fault = next(e for e in log if e["source"] == "fault")
+        assert fault["event"] == "error"
+        assert fault["detail"]["site"] == "store.put"
+        assert fault["detail"]["ctx"] == "test.ctx"
+        assert fault["detail"]["hit"] == 1
+
+        cut = next(e for e in log if e["source"] == "link"
+                   and e["event"] == "cut")
+        assert cut["detail"]["src"] == "aaaa"
+        assert cut["detail"]["dst"] == "gcs"
+
+        chaos = next(e for e in log if e["source"] == "chaos")
+        assert chaos["event"] == "spot_preempt"
+        assert chaos["detail"]["provider_id"] == "prov-1"
+
+    def test_trace_and_link_entries_carry_timestamps(self):
+        """The ts stamps (added for the soak join) exist on raw trace
+        and link entries, not only on the merged view."""
+        faults.install([FaultPlan(site="rpc.send.frame", action="drop",
+                                  nth=1, count=1)])
+        faults.ACTIVE.hit("rpc.send.frame", "x")
+        (entry,) = faults.trace()
+        assert entry["ts"] > 0
+        faults.cut_link("bbbb", "gcs")
+        assert all(e["ts"] > 0 for e in faults.link_log())
+
+
+# ---------------------------------------------------------------------------
+# Open-loop load model
+# ---------------------------------------------------------------------------
+
+
+class TestLoadModel:
+    def test_poisson_schedule_replays_from_seed(self):
+        a = arrival_offsets(50.0, 10.0, seed="7:arrivals")
+        b = arrival_offsets(50.0, 10.0, seed="7:arrivals")
+        assert a == b
+        assert a != arrival_offsets(50.0, 10.0, seed="8:arrivals")
+
+    def test_poisson_without_seed_refuses(self):
+        with pytest.raises(ValueError, match="seed"):
+            arrival_offsets(50.0, 10.0)
+
+    def test_uniform_is_the_legacy_fixed_schedule(self):
+        offs = arrival_offsets(10.0, 1.0, process="uniform")
+        assert offs == [i / 10.0 for i in range(10)]
+
+    def test_summarize_row_shape(self):
+        recs = [RequestRecord(0.1, 100.0, "ok"),
+                RequestRecord(0.2, 120.0, "ok"),
+                RequestRecord(0.3, 1.0, "shed"),
+                RequestRecord(0.4, 5.0, "error")]
+        s = summarize(recs, elapsed_s=1.0)
+        assert set(s) == {"offered", "admitted_rps", "p50_ms", "p99_ms",
+                          "shed_rate", "errors"}
+        assert s["offered"] == 4 and s["errors"] == 1
+        assert s["shed_rate"] == 0.25
+
+
+# ---------------------------------------------------------------------------
+# The deterministic acceptance soak (the tentpole gate)
+# ---------------------------------------------------------------------------
+
+
+class TestAcceptanceSoak:
+    """ISSUE-18 acceptance, on the deterministic harness: seeded
+    scenario, >=3 fault planes, autoscaling live, scorecard
+    bit-reproducible, every dip attributed, SLOs enforced."""
+
+    @pytest.fixture(scope="class")
+    def result(self):
+        return run_sim(acceptance_scenario(seed=7, duration_s=30.0))
+
+    def test_scorecard_bit_reproducible_across_two_runs(self, result):
+        again = run_sim(acceptance_scenario(seed=7, duration_s=30.0))
+        assert result.scorecard.to_json() == again.scorecard.to_json()
+
+    def test_different_seed_different_bytes(self, result):
+        other = run_sim(acceptance_scenario(seed=8, duration_s=30.0))
+        assert result.scorecard.to_json() != other.scorecard.to_json()
+
+    def test_three_fault_planes_fired(self, result):
+        chaos_events = {e["event"] for e in result.storm_log
+                        if e["source"] == "chaos"}
+        assert "node_preempt" in chaos_events  # drain plane
+        assert "partition" in chaos_events     # health plane
+        fault_firings = [e for e in result.storm_log
+                         if e["source"] == "fault"]
+        assert fault_firings                    # injected site faults
+        assert {e["source"] for e in result.storm_log} == {
+            "chaos", "link", "fault"
+        }
+
+    def test_autoscaling_was_live(self, result):
+        assert result.replica_launches >= 1
+
+    def test_every_dip_attributed(self, result):
+        assert result.scorecard.unattributed_dips == []
+
+    def test_slo_enforced_goodput(self, result):
+        card = result.scorecard
+        assert card.slo_pass, card.slo_failures
+        assert card.goodput_frac >= 0.6
+        assert card.p99_ms <= card.slo_p99_ms
+
+    def test_incident_breakdown_carries_evidence(self, result):
+        card = result.scorecard
+        assert card.incidents
+        inc = card.incidents[0]
+        assert inc.event in ("partition", "node_preempt", "node_kill",
+                             "cut")
+        assert inc.blackout_s > 0
+        # the health-plane join: the partition incident must show the
+        # phi spike and the suspect verdict
+        part = [i for i in card.incidents if i.event == "partition"]
+        if part:
+            assert part[0].max_phi is not None and part[0].max_phi >= 3.0
+            assert part[0].suspect_nodes
+
+    def test_scorecard_rows_shape(self, result):
+        rows = result.scorecard.to_rows()
+        head = rows[0]
+        assert head["metric"] == "soak_availability"
+        assert 0.0 <= head["value"] <= 1.0
+        assert head["seed"] == 7
+        assert all(r["metric"] == "soak_incident" for r in rows[1:])
+
+    def test_health_samples_joined_not_invented(self, result):
+        assert result.health_samples
+        assert {"t_s", "node", "phi", "suspect", "incarnation",
+                "alive"} <= set(result.health_samples[0])
+
+
+class TestScorecardAttribution:
+    """compute_scorecard unit behavior, independent of the sim."""
+
+    def _scenario(self):
+        return SoakScenario(
+            duration_s=10.0,
+            workload=WorkloadSpec(offered_rps=10.0, slo_ms=500.0),
+            slo=SLOSpec(p99_ms=500.0),
+        )
+
+    def _steady(self, rate=10, dur=10):
+        return [
+            RequestRecord(t_s=i / rate + b, latency_ms=100.0, status="ok")
+            for b in range(dur) for i in range(rate)
+        ]
+
+    def test_clean_run_scores_full_availability(self):
+        card = compute_scorecard(self._scenario(), self._steady())
+        assert card.availability == 1.0
+        assert card.incidents == [] and card.unattributed_dips == []
+        assert card.slo_pass
+
+    def test_error_bucket_attributes_to_covering_event(self):
+        recs = self._steady()
+        recs += [RequestRecord(t_s=5.2, latency_ms=40.0, status="error")]
+        storm = [{"ts": 5.0, "source": "chaos", "event": "node_kill",
+                  "detail": {"node_id": "n1"}}]
+        card = compute_scorecard(self._scenario(), recs, storm)
+        assert card.unattributed_dips == []
+        (inc,) = card.incidents
+        assert inc.event == "node_kill" and inc.errors == 1
+
+    def test_dip_with_no_covering_event_is_unattributed(self):
+        recs = self._steady()
+        recs += [RequestRecord(t_s=8.4, latency_ms=40.0, status="error")]
+        storm = [{"ts": 1.0, "source": "chaos", "event": "node_kill",
+                  "detail": {}}]  # far outside the attribution window
+        card = compute_scorecard(self._scenario(), recs, storm)
+        assert card.incidents == []
+        assert len(card.unattributed_dips) == 1
+
+    def test_poisson_lull_is_not_a_dip(self):
+        # a bucket with 2 arrivals, both served fine: arrival noise
+        recs = [r for r in self._steady() if not 3.0 <= r.t_s < 4.0]
+        recs += [RequestRecord(3.1, 100.0, "ok"),
+                 RequestRecord(3.7, 100.0, "ok")]
+        card = compute_scorecard(self._scenario(), recs)
+        assert card.availability == 1.0
+
+    def test_latest_explaining_event_wins(self):
+        recs = self._steady()
+        recs += [RequestRecord(t_s=6.3, latency_ms=40.0, status="error")]
+        storm = [
+            {"ts": 4.0, "source": "chaos", "event": "node_preempt",
+             "detail": {}},
+            {"ts": 6.0, "source": "chaos", "event": "node_kill",
+             "detail": {}},
+        ]
+        card = compute_scorecard(self._scenario(), recs, storm)
+        (inc,) = card.incidents
+        assert inc.event == "node_kill"  # blame the nearest cause
+
+    def test_slo_failures_enumerated(self):
+        recs = [RequestRecord(i / 10.0, 100.0, "shed") for i in range(100)]
+        card = compute_scorecard(self._scenario(), recs)
+        assert not card.slo_pass
+        assert any("goodput" in f for f in card.slo_failures)
+        assert any("shed" in f for f in card.slo_failures)
+
+
+# ---------------------------------------------------------------------------
+# Spot-fleet economics (deterministic ledger)
+# ---------------------------------------------------------------------------
+
+
+class TestSpotEconomics:
+    def test_ledger_bit_reproducible(self):
+        s = acceptance_scenario(seed=7, duration_s=30.0)
+        a = run_spot_economics(s)
+        b = run_spot_economics(s)
+        assert json.dumps(a, sort_keys=True) == json.dumps(
+            b, sort_keys=True
+        )
+
+    def test_revocation_schedule_is_seeded(self):
+        s = acceptance_scenario(seed=7)
+        cfg = SpotFleetConfig()
+        assert spot_preempt_times(s, cfg) == spot_preempt_times(s, cfg)
+        other = acceptance_scenario(seed=8)
+        assert spot_preempt_times(s, cfg) != spot_preempt_times(other, cfg)
+
+    def test_discount_beats_churn_on_same_seed(self):
+        s = acceptance_scenario(seed=7, duration_s=30.0)
+        econ = run_spot_economics(s)
+        # churn costs goodput...
+        assert econ["spot"]["in_slo"] <= econ["ondemand"]["in_slo"]
+        assert 0.0 < econ["spot_goodput_retained"] <= 1.0
+        # ...but the 65% discount dominates throughput-per-cost
+        assert econ["spot_advantage"] > 1.0
+        assert econ["spot"]["cost"] < econ["ondemand"]["cost"]
+
+    def test_bench_soak_rows(self):
+        import bench
+
+        rows = bench.bench_soak(profile="short")
+        metrics = [r["metric"] for r in rows]
+        assert metrics[0] == "soak_availability"
+        assert "soak_spot_economics" in metrics
+        again = bench.bench_soak(profile="short")
+        assert json.dumps(rows, sort_keys=True) == json.dumps(
+            again, sort_keys=True
+        )
+
+
+# ---------------------------------------------------------------------------
+# Live soak: the scenario against a real cluster (slow)
+# ---------------------------------------------------------------------------
+
+
+def _live_scenario(seed=21):
+    """Scaled-down acceptance shape for a 2-core sandbox: light load,
+    short run, one preemption + one partition, rpc faults armed."""
+    return SoakScenario(
+        name="live_soak",
+        seed=seed,
+        duration_s=12.0,
+        initial_workers=2,
+        workload=WorkloadSpec(
+            service_ms=50.0, max_ongoing=4, offered_rps=12.0,
+            slo_ms=5000.0, max_queue_depth=64,
+            min_replicas=2, max_replicas=3,
+        ),
+        slo=SLOSpec(p99_ms=5000.0, goodput_floor=0.3,
+                    shed_ceiling=0.5, max_error_rate=0.3),
+        storm=StormSpec(preempts=1, preempt_deadline_s=6.0,
+                        partitions=1, partition_duration_s=1.5,
+                        node_kills=0, min_gap_s=3.0),
+        fault_plans=(
+            FaultPlan(site="rpc.send.frame", action="drop",
+                      nth=200, count=2, seed=seed),
+        ),
+    )
+
+
+@pytest.mark.slow
+class TestLiveSoak:
+    def test_live_storm_soak_end_to_end(self):
+        """The full live path: proxy -> admission -> autoscaled
+        replicas on two worker nodes, while the seeded storm preempts
+        one and partitions the other, with RT_FAULTS armed in every
+        process.  Asserts the structural contract: the service
+        survives, the storm applied its timeline, the unified log
+        covers it, and the scorecard renders with the health join."""
+        from ray_tpu import serve
+        from ray_tpu.soak.runner import run_live
+
+        scenario = _live_scenario()
+        # arm site faults BEFORE the cluster spawns: subprocesses
+        # inherit RT_FAULTS through the environment
+        os.environ["RT_FAULTS"] = plans_to_json(
+            list(scenario.fault_plans)
+        )
+        faults.install(list(scenario.fault_plans))
+        cluster = Cluster(initialize_head=True, connect=True,
+                          head_node_args={"num_cpus": 4})
+        try:
+            for _ in range(scenario.initial_workers):
+                cluster.add_node(num_cpus=1, resources={"soak": 2.0})
+            cluster.wait_for_nodes(timeout=60)
+            serve.start()
+
+            result = run_live(
+                scenario, cluster,
+                actor_options={"num_cpus": 0, "resources": {"soak": 1.0}},
+            )
+            card = result.scorecard
+
+            # the service took real traffic and mostly answered
+            assert card.offered > 0
+            assert card.completed_ok > 0
+            assert card.goodput_frac >= scenario.slo.goodput_floor, (
+                card.to_dict()
+            )
+            # the storm actually ran its timeline
+            applied_kinds = sorted(e["kind"] for e in result.applied_events)
+            assert applied_kinds == ["partition", "preempt"], (
+                result.applied_events, result.storm_log[-5:]
+            )
+            chaos_events = {e["event"] for e in result.storm_log
+                            if e["source"] == "chaos"}
+            assert "node_preempt" in chaos_events
+            assert "partition" in chaos_events
+            # unified-log schema holds in live mode too
+            for e in result.storm_log:
+                assert set(e) == {"ts", "source", "event", "detail"}
+            # the health sampler rode along
+            assert result.health_samples
+            # the storm timeline itself is the reproducible surface
+            assert build_storm(scenario) == build_storm(scenario)
+        finally:
+            # no graceful serve.delete/shutdown here: a storm-killed
+            # replica can't ack teardown and the graceful path would
+            # block on it — hard process teardown is the point
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Spot-fleet churn against the live autoscaler (slow, satellite c)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSpotFleetChurn:
+    def test_preemptible_fleet_survives_seeded_churn(self):
+        """The autoscaler provisions a preemptible node type to its
+        min_workers floor; the seeded SpotFleet revocation process
+        drains + kills one; the floor must relaunch a replacement
+        (provisioning OVERLAPS the drain — draining nodes are excluded
+        from supply counts), the fleet never drops below min_workers,
+        and driver-visible task traffic never fails."""
+        from ray_tpu.autoscaler import (
+            Autoscaler,
+            AutoscalerConfig,
+            LocalSubprocessProvider,
+            NodeTypeConfig,
+        )
+        from ray_tpu.core import rpc
+        from ray_tpu.soak.spot import SpotFleet
+
+        MIN_WORKERS = 2
+        cluster = Cluster(initialize_head=True, connect=True,
+                          head_node_args={"num_cpus": 1})
+        provider = LocalSubprocessProvider(
+            cluster.gcs_address, cluster.session_dir
+        )
+        cfg = AutoscalerConfig(
+            node_types=[
+                NodeTypeConfig(
+                    "spot_small", {"CPU": 2}, min_workers=MIN_WORKERS,
+                    max_workers=4, price=0.35, preemptible=True,
+                ),
+            ],
+            idle_timeout_s=3600.0,  # churn only via preemption here
+            interval_s=0.2,
+        )
+        autoscaler = Autoscaler(cluster.gcs_address, provider, cfg)
+        controller = ChaosController(cluster, seed=31)
+
+        @ray_tpu.remote(num_cpus=1)
+        def unit(x):
+            return x + 1
+
+        failures = []
+        floor_violations = []
+
+        async def drive():
+            autoscaler.gcs = rpc.ReconnectingConnection(
+                cluster.gcs_address, name="autoscaler->gcs"
+            )
+            fleet = SpotFleet(
+                autoscaler.gcs, provider, {"spot_small"},
+                seed=31, deadline_s=3.0, controller=controller,
+            )
+            try:
+                # 1. floor: min_workers preemptible nodes come up
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    await autoscaler.reconcile()
+                    if len(provider.non_terminated_nodes()) >= MIN_WORKERS:
+                        break
+                    await asyncio.sleep(0.2)
+                assert len(provider.non_terminated_nodes()) >= MIN_WORKERS, (
+                    "autoscaler never reached the min_workers floor"
+                )
+                cluster.wait_for_nodes(timeout=60)
+
+                # 2. seeded revocation mid-traffic
+                victim = await fleet.preempt_one()
+                assert victim is not None
+
+                # 3. replacement: floor restored with a FRESH node
+                deadline = time.monotonic() + 90
+                replaced = False
+                while time.monotonic() < deadline:
+                    await autoscaler.reconcile()
+                    live = provider.non_terminated_nodes()
+                    if (len(live) < MIN_WORKERS
+                            and victim not in
+                            [pn.provider_id for pn in live]):
+                        floor_violations.append(
+                            [pn.provider_id for pn in live]
+                        )
+                    if (len([pn for pn in live
+                             if pn.provider_id != victim])
+                            >= MIN_WORKERS):
+                        replaced = True
+                        break
+                    await asyncio.sleep(0.2)
+                assert replaced, "replacement node never launched"
+            finally:
+                await autoscaler.gcs.close()
+
+        try:
+            # driver-visible traffic throughout the churn
+            import threading
+
+            stop = threading.Event()
+
+            def traffic():
+                while not stop.is_set():
+                    try:
+                        ref = unit.remote(1)
+                        assert ray_tpu.get(ref, timeout=60) == 2
+                    except Exception as e:  # noqa: BLE001
+                        failures.append(repr(e))
+                    time.sleep(0.1)
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+            try:
+                asyncio.run(drive())
+            finally:
+                stop.set()
+                t.join(timeout=30)
+
+            assert failures == [], f"driver-visible failures: {failures}"
+            assert floor_violations == [], floor_violations
+            # the revocation rode the unified storm log
+            events = {e["event"] for e in controller.storm_log()}
+            assert "spot_preempt" in events
+            assert "spot_kill" in events
+        finally:
+            for pn in provider.non_terminated_nodes():
+                try:
+                    provider.terminate_node(pn)
+                except Exception:
+                    pass
+            ray_tpu.shutdown()
+            cluster.shutdown()
