@@ -310,7 +310,7 @@ class ShmStore:
             # an injected arena-pressure failure — callers must survive
             # it exactly like a genuinely full arena (spill request +
             # bounded retry in _write_to_store)
-            plan = fault_ctl.hit("store.put", object_id.hex())
+            plan = fault_ctl.hit(faults.SITE_STORE_PUT, object_id.hex())
             if plan is not None and plan.action == "error":
                 raise StoreFullError(
                     f"injected arena put failure for {object_id.hex()[:12]}"

@@ -64,6 +64,13 @@ __all__ = [
     "FaultPlan",
     "LINKS_ACTIVE",
     "LOCAL_ENDPOINT",
+    "SITES",
+    "SITE_COLLECTIVE_PEER_CONN",
+    "SITE_NODE_PREEMPT",
+    "SITE_RAYLET_LEASE_GRANT",
+    "SITE_RPC_RECV_MSG",
+    "SITE_RPC_SEND_FRAME",
+    "SITE_STORE_PUT",
     "clear",
     "cut_link",
     "heal_link",
@@ -77,6 +84,28 @@ __all__ = [
 ]
 
 ENV_VAR = "RT_FAULTS"
+
+# The canonical injection-site registry.  Every runtime hit() call
+# guards one of these names, the docs/architecture.md site table is
+# asserted against this tuple in tests, and rtproto's RT404 flags any
+# hit site, plan, or registry entry that drifts from the others.  Add a
+# site here WHEN you add its runtime check — a registered-but-unchecked
+# name arms plans that never fire.
+SITE_RPC_SEND_FRAME = "rpc.send.frame"
+SITE_RPC_RECV_MSG = "rpc.recv.msg"
+SITE_STORE_PUT = "store.put"
+SITE_RAYLET_LEASE_GRANT = "raylet.lease.grant"
+SITE_NODE_PREEMPT = "node.preempt"
+SITE_COLLECTIVE_PEER_CONN = "collective.peer_conn"
+
+SITES = (
+    SITE_RPC_SEND_FRAME,
+    SITE_RPC_RECV_MSG,
+    SITE_STORE_PUT,
+    SITE_RAYLET_LEASE_GRANT,
+    SITE_NODE_PREEMPT,
+    SITE_COLLECTIVE_PEER_CONN,
+)
 
 
 @dataclass(frozen=True)
@@ -138,6 +167,17 @@ class FaultPlan:
             raise ValueError(
                 f"FaultPlan has no field(s) {sorted(unknown)}; "
                 f"valid fields: {list(cls._FIELDS)}"
+            )
+        site = d.get("site")
+        if site is not None and site not in SITES:
+            # the wire path (RT_FAULTS env / scenario JSON) validates
+            # against the canonical registry: a typo'd site arms a plan
+            # that never fires, which is exactly a chaos test that lies.
+            # Direct FaultPlan(...) construction stays free-form so
+            # unit tests can use synthetic site names.
+            raise ValueError(
+                f"unknown fault site {site!r}; registered sites: "
+                f"{list(SITES)}"
             )
         return cls(**{k: d[k] for k in cls._FIELDS if k in d})
 

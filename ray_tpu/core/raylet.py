@@ -542,7 +542,9 @@ class Raylet:
             deadline_s = 0.0
             fault_ctl = faults.ACTIVE  # bind once: clear() races the check
             if fault_ctl is not None:
-                plan = fault_ctl.hit("node.preempt", self.node_id.hex())
+                plan = fault_ctl.hit(
+                    faults.SITE_NODE_PREEMPT, self.node_id.hex()
+                )
                 if plan is not None and plan.action in ("preempt", "error"):
                     # delay_s carries the announced deadline; unset
                     # (FaultPlan's 0.05 "delay" default) or non-positive
@@ -794,7 +796,9 @@ class Raylet:
         fault_ctl = faults.ACTIVE  # re-read: clear() races the caller's check
         if fault_ctl is None:
             return
-        plan = fault_ctl.hit("raylet.lease.grant", w.worker_id.hex())
+        plan = fault_ctl.hit(
+            faults.SITE_RAYLET_LEASE_GRANT, w.worker_id.hex()
+        )
         if plan is not None and plan.action == "kill":
             logger.warning(
                 "chaos: killing worker %s on lease grant", w.worker_id

@@ -178,7 +178,7 @@ class Connection:
             # simply never sees these messages) or reset (transport
             # aborted; both sides observe ConnectionLost and run their
             # real loss paths)
-            plan = fault_ctl.hit("rpc.send.frame", self.name)
+            plan = fault_ctl.hit(faults.SITE_RPC_SEND_FRAME, self.name)
             if plan is not None:
                 if plan.action == "drop":
                     return
@@ -362,7 +362,9 @@ class Connection:
                 return
         fault_ctl = faults.ACTIVE  # bind once: clear() races the check
         if fault_ctl is not None:
-            plan = fault_ctl.hit("rpc.recv.msg", f"{self.name}:{method}")
+            plan = fault_ctl.hit(
+                faults.SITE_RPC_RECV_MSG, f"{self.name}:{method}"
+            )
             if plan is not None and self._inject_recv_fault(
                 plan, kind, msg_id, method, payload
             ):

@@ -6,22 +6,27 @@ this codebase has actually shipped (event-loop blocking, non-atomic
 persists, impure traced functions, ...).  Findings carry ``file:line``,
 a stable rule id, and a fix hint.
 
-Three tiers share this CLI: the per-file rules below (RT1xx); the
+Four tiers share this CLI: the per-file rules below (RT1xx); the
 whole-program ``rtflow`` tier (RT2xx, ``ray_tpu.devtools.flow``) which
 indexes the full package into a call graph and runs interprocedural
 rules (actor deadlock cycles, ObjectRef leaks, unserializable captures,
-rank-divergent collectives); and the concurrency ``rtrace`` tier
+rank-divergent collectives); the concurrency ``rtrace`` tier
 (RT3xx, ``ray_tpu.devtools.trace``) which classifies functions by
 execution plane (io loop / executor threads / caller threads), checks
 cross-plane state hand-offs, and runs a lock-order checker over the
-native ``_native/*.cc`` sources.  ``--flow`` / ``--trace`` add a tier;
-``--all`` runs every tier.
+native ``_native/*.cc`` sources; and the wire-contract ``rtproto``
+tier (RT4xx, ``ray_tpu.devtools.proto``) which extracts both sides of
+every string-keyed wire surface (rpc handlers vs. call sites, pubsub
+topics, chaos sites, config knobs) and checks them against each other.
+``--flow`` / ``--trace`` / ``--proto`` add a tier; ``--all`` runs
+every tier.
 
 CLI::
 
     python -m ray_tpu.devtools.lint ray_tpu            # text report
     python -m ray_tpu.devtools.lint --flow ray_tpu     # + RT2xx tier
     python -m ray_tpu.devtools.lint --trace ray_tpu    # + RT3xx tier
+    python -m ray_tpu.devtools.lint --proto ray_tpu    # + RT4xx tier
     python -m ray_tpu.devtools.lint --all ray_tpu      # every tier
     python -m ray_tpu.devtools.lint ray_tpu --format json
     python -m ray_tpu.devtools.lint ray_tpu --format sarif  # CI annotations
@@ -447,9 +452,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="also run the rtrace concurrency tier "
                              "(RT3xx plane/race rules plus the native "
                              "lock-order checker over _native/*.cc)")
+    parser.add_argument("--proto", action="store_true",
+                        help="also run the rtproto wire-contract tier "
+                             "(RT4xx rules over the string-keyed rpc/"
+                             "pubsub/chaos/config surfaces)")
     parser.add_argument("--all", action="store_true", dest="all_tiers",
                         help="run every tier (equivalent to --flow "
-                             "--trace)")
+                             "--trace --proto)")
     parser.add_argument("--changed-only", action="store_true",
                         help="report only on files dirty per `git diff "
                              "--name-only HEAD` (flow/trace still index "
@@ -464,6 +473,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--trace-baseline", default=None,
                         help="baseline JSON path for the trace tier "
                              "(default: trace/trace_baseline.json)")
+    parser.add_argument("--proto-baseline", default=None,
+                        help="baseline JSON path for the proto tier "
+                             "(default: proto/proto_baseline.json)")
     parser.add_argument("--no-baseline", action="store_true",
                         help="ignore the baseline file(s)")
     parser.add_argument("--write-baseline", action="store_true",
@@ -473,13 +485,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.all_tiers:
         args.flow = True
         args.trace = True
+        args.proto = True
 
     flow_mod = None
     trace_mod = None
+    proto_mod = None
     if args.flow or args.list_rules:
         from ray_tpu.devtools import flow as flow_mod  # lazy: index cost
     if args.trace or args.list_rules:
         from ray_tpu.devtools import trace as trace_mod
+    if args.proto or args.list_rules:
+        from ray_tpu.devtools import proto as proto_mod
 
     if args.list_rules:
         for rule in all_rules():
@@ -497,6 +513,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 else "whole-program, --trace"
             )
             print(f"{rule.id}  {rule.name}  [{scope}]")
+            print(f"    {rule.description}")
+            print(f"    hint: {rule.hint}")
+        for rule in proto_mod.all_proto_rules():
+            print(f"{rule.id}  {rule.name}  [whole-program, --proto]")
             print(f"    {rule.description}")
             print(f"    hint: {rule.hint}")
         return 0
@@ -534,18 +554,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     only_file = only
     only_flow = None
     only_trace = None
-    if args.flow or args.trace:
+    only_proto = None
+    if args.flow or args.trace or args.proto:
         flow_ids = set(flow_mod.flow_rule_ids()) if args.flow else set()
         trace_ids = (
             set(trace_mod.trace_rule_ids()) if args.trace else set()
+        )
+        proto_ids = (
+            set(proto_mod.proto_rule_ids()) if args.proto else set()
         )
         if only is not None:
             only_file = [
                 r for r in only
                 if r not in flow_ids and r not in trace_ids
+                and r not in proto_ids
             ]
             only_flow = [r for r in only if r in flow_ids]
             only_trace = [r for r in only if r in trace_ids]
+            only_proto = [r for r in only if r in proto_ids]
 
     findings: List[Finding] = []
     files_scanned = 0
@@ -596,6 +622,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 e for e in trace_report.parse_errors
                 if e not in parse_errors
             )
+        if args.proto and (only is None or only_proto):
+            proto_report = proto_mod.analyze_paths(
+                paths, rules=only_proto
+            )
+            proto_findings = proto_report.findings
+            if file_filter is not None:
+                # same narrowing as flow/trace: the wire tables need
+                # the whole index, reporting narrows to dirty files
+                proto_findings = [
+                    f for f in proto_findings
+                    if os.path.abspath(f.path) in file_filter
+                ]
+            findings.extend(proto_findings)
+            files_scanned = max(
+                files_scanned, proto_report.files_indexed
+            )
+            parse_errors.extend(
+                e for e in proto_report.parse_errors
+                if e not in parse_errors
+            )
     except ValueError as e:
         print(f"rtlint: {e}", file=sys.stderr)
         return 2
@@ -607,12 +653,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     trace_baseline_path = args.trace_baseline
     if trace_baseline_path is None and args.trace:
         trace_baseline_path = trace_mod.DEFAULT_TRACE_BASELINE
+    proto_baseline_path = args.proto_baseline
+    if proto_baseline_path is None and args.proto:
+        proto_baseline_path = proto_mod.DEFAULT_PROTO_BASELINE
 
     if args.write_baseline:
         # each tier owns its own baseline file, keyed by rule-id prefix
         file_findings = [
             f for f in findings
-            if not f.rule.startswith(("RT2", "RT3"))
+            if not f.rule.startswith(("RT2", "RT3", "RT4"))
         ]
         wrote = []
         write_baseline(file_findings, args.baseline)
@@ -631,6 +680,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             wrote.append(
                 f"{len(trace_findings)} to {trace_baseline_path}"
             )
+        if args.proto:
+            proto_findings = [
+                f for f in findings if f.rule.startswith("RT4")
+            ]
+            write_baseline(proto_findings, proto_baseline_path)
+            wrote.append(
+                f"{len(proto_findings)} to {proto_baseline_path}"
+            )
         print("rtlint: wrote " + " and ".join(wrote))
         return 0
 
@@ -641,6 +698,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             baseline += load_baseline(flow_baseline_path)
         if args.trace:
             baseline += load_baseline(trace_baseline_path)
+        if args.proto:
+            baseline += load_baseline(proto_baseline_path)
     new, grandfathered = split_baselined(findings, baseline)
 
     if args.format == "json":
@@ -664,6 +723,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             rules_meta.extend(flow_mod.all_flow_rules())
         if args.trace:
             rules_meta.extend(trace_mod.all_trace_rules())
+        if args.proto:
+            rules_meta.extend(proto_mod.all_proto_rules())
         print(json.dumps(
             render_sarif(new, grandfathered, rules_meta), indent=2,
         ))

@@ -1,0 +1,44 @@
+"""rtproto: the wire-contract analysis tier (RT4xx).
+
+The reference framework's control plane is contract-checked at compile
+time by protoc; ours is deliberately string-keyed — an rpc is
+``conn.call("drain_node", {...})`` meeting ``def rpc_drain_node``, a
+pubsub topic is a literal like ``"serve:routes"``, a chaos site is
+``"raylet.lease.grant"``, a config knob resolves through
+``_Config.__getattr__``.  The same drift class protoc rejects at build
+time here fails only at runtime, or silently.  This fourth tier closes
+that gap: an extraction pass builds both sides of every wire surface
+(handler/call/topic/site/knob tables) and six rules check them against
+each other.
+
+- RT401 unknown-rpc-target: a call names an rpc no handler dispatches.
+- RT402 rpc-shape-mismatch: a payload dict is missing keys every
+  candidate handler reads unconditionally (``**kwargs``/opaque
+  handlers exempt).
+- RT403 orphan-handler: dead wire surface — a handler nothing calls or
+  even names (baseline-able for public entry points).
+- RT404 unknown-chaos-site: a fault plan names a site no runtime
+  ``hit()`` guards, or a hit site drifts from ``faults.SITES``.
+- RT405 unknown-config-knob: a config-singleton read or ``override``
+  names a knob no ``_Config.define`` declares.
+- RT406 pubsub-topic-mismatch: a publish with no subscriber or
+  subscribe with no publisher (dynamic prefixes match by prefix).
+
+Findings ride the same ``Finding`` type, suppression comments, and
+baseline machinery as the other tiers; run everything with::
+
+    python -m ray_tpu.devtools.lint --all ray_tpu
+"""
+
+from ray_tpu.devtools.proto.engine import (  # noqa: F401
+    DEFAULT_PROTO_BASELINE,
+    ProtoReport,
+    all_proto_rules,
+    analyze_paths,
+    analyze_sources,
+    proto_rule_ids,
+)
+from ray_tpu.devtools.proto.extract import (  # noqa: F401
+    WireIndex,
+    build_wire_index,
+)

@@ -1,0 +1,152 @@
+"""rtproto engine: builds the program index, derives the wire-surface
+tables (:mod:`ray_tpu.devtools.proto.extract`), runs the RT4xx rules,
+and funnels findings through the SAME suppression + fingerprint
+machinery as the other tiers, so ``# rtlint: disable-next=RT401``
+comments and baseline entries behave identically across all four.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.devtools.lint import (
+    Finding,
+    _apply_suppressions,
+)
+
+DEFAULT_PROTO_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "proto_baseline.json"
+)
+
+
+class ProtoRule:
+    """Wire-contract rule: ``check(index, wire)`` walks the extracted
+    wire tables and reports through ``add`` into the owning module's
+    context (so per-module suppression comments apply)."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    hint: str = ""
+
+    def check(self, index, wire) -> None:
+        raise NotImplementedError
+
+    def add(self, module, node, message=None, hint=None) -> None:
+        module.ctx.add(self, node, message=message, hint=hint)
+
+
+def all_proto_rules() -> List[ProtoRule]:
+    # imported here: the rule module imports ProtoRule from this module
+    from ray_tpu.devtools.proto.rules import (
+        OrphanHandler,
+        PubsubTopicMismatch,
+        RpcShapeMismatch,
+        UnknownChaosSite,
+        UnknownConfigKnob,
+        UnknownRpcTarget,
+    )
+
+    return [
+        UnknownRpcTarget(),
+        RpcShapeMismatch(),
+        OrphanHandler(),
+        UnknownChaosSite(),
+        UnknownConfigKnob(),
+        PubsubTopicMismatch(),
+    ]
+
+
+def proto_rule_ids() -> Tuple[str, ...]:
+    return tuple(r.id for r in all_proto_rules())
+
+
+@dataclasses.dataclass
+class ProtoReport:
+    findings: List[Finding]
+    files_indexed: int
+    parse_errors: List[str]
+
+
+def _select(rules: Optional[Sequence[str]]) -> List[ProtoRule]:
+    selected = all_proto_rules()
+    if rules is not None:
+        wanted = set(rules)
+        unknown = wanted - {r.id for r in selected}
+        if unknown:
+            raise ValueError(
+                f"unknown proto rule id(s): {sorted(unknown)}"
+            )
+        selected = [r for r in selected if r.id in wanted]
+    return selected
+
+
+def analyze_index(
+    index, rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    from ray_tpu.devtools.proto.extract import build_wire_index
+
+    wire = build_wire_index(index)
+    for rule in _select(rules):
+        rule.check(index, wire)
+    findings: List[Finding] = []
+    for mname in sorted(index.modules):
+        findings.extend(_apply_suppressions(index.modules[mname].ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_sources(
+    files: Dict[str, str], rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Fixture/test entry point: ``files`` maps package-relative paths
+    (``pkg/mod.py``) to sources; paths double as module names."""
+    from ray_tpu.devtools.flow.index import (
+        build_index,
+        module_name_from_relpath,
+    )
+
+    entries = []
+    for path in sorted(files):
+        norm = path.replace(os.sep, "/")
+        tree = ast.parse(files[path], filename=norm)
+        entries.append(
+            (norm, module_name_from_relpath(norm), files[path], tree)
+        )
+    index = build_index(entries)
+    return analyze_index(index, rules=rules)
+
+
+def analyze_paths(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> ProtoReport:
+    from ray_tpu.devtools.flow.engine import _collect_entries
+    from ray_tpu.devtools.flow.index import (
+        build_index,
+        module_name_from_relpath,
+    )
+
+    entries = []
+    errors: List[str] = []
+    for finding_path, rel_for_name, apath in _collect_entries(paths):
+        try:
+            with open(apath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=finding_path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # RT000 is the per-file tier's finding; this tier just
+            # indexes what parses and reports the rest as errors
+            errors.append(f"{finding_path}: {e}")
+            continue
+        entries.append((
+            finding_path,
+            module_name_from_relpath(rel_for_name),
+            source,
+            tree,
+        ))
+    index = build_index(entries)
+    findings = analyze_index(index, rules=rules)
+    return ProtoReport(findings, len(entries), errors)

@@ -1,0 +1,826 @@
+"""rtproto extraction: both sides of every wire surface.
+
+The control plane is string-keyed on purpose (no protoc step), which
+means the contract between a ``conn.call("drain_node", {...})`` site and
+``def rpc_drain_node`` exists only as matching literals.  This pass
+walks the whole-program index (``flow.index.ProgramIndex``) once and
+builds a :class:`WireIndex` with five tables:
+
+- **handlers** — ``def rpc_<name>`` methods, ``register_rpc_handler``
+  sites, and dispatcher-function branches (``method == "lit"`` inside a
+  function taking both ``conn`` and ``method``), each with the payload
+  keys it reads;
+- **calls** — every ``.call`` / ``.call_soon`` / ``.notify`` site whose
+  target resolves to a literal, a module-level string constant, or a
+  static f-string prefix (variable names are skipped: precision over
+  recall, same contract as the other tiers);
+- **topics** — ``publish`` / ``subscribe`` / ``subscribe_async``
+  literals and prefixes, including topics built by one-return helper
+  functions (``reform_channel(g)`` → ``collective:reform:`` prefix) and
+  the ``.call("subscribe", {"channel": ...})`` wire shape;
+- **chaos sites** — names consumed by ``FaultPlan(site=...)`` /
+  plan-shaped dict literals vs. names actually guarded by a
+  ``fault_ctl.hit(...)`` runtime site, plus the canonical
+  ``faults.SITES`` registry;
+- **knobs** — ``_Config.define`` names vs. every attribute read /
+  string ``override`` against the config singleton (function-local
+  shadowing of the imported name is respected).
+
+Soundness limits are documented per rule in docs/architecture.md; the
+shared stance is that an unresolvable name produces *no* table entry and
+therefore no finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import Counter
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+# verbs that put a method name on the rpc wire
+RPC_VERBS = ("call", "call_soon", "notify")
+# _Config attrs that are API, not knobs
+CONFIG_API_ATTRS = {"override", "reset", "define"}
+
+
+@dataclasses.dataclass
+class Handler:
+    """One side of the rpc contract: something dispatchable by name."""
+
+    name: str
+    module: object            # flow.index.ModuleInfo
+    node: ast.AST             # anchor for findings (the def / call site)
+    kind: str                 # "rpc-def" | "registered" | "dispatcher"
+    required: FrozenSet[str]  # payload keys read unconditionally
+    optional: FrozenSet[str]  # payload keys read via .get()
+    opaque: bool              # payload escapes / **kwargs / unresolvable
+    self_mentions: int        # string constants its own declaration adds
+
+
+@dataclasses.dataclass
+class CallSite:
+    module: object
+    node: ast.AST
+    verb: str
+    name: Optional[str]       # exact target, or None for prefix/f-string
+    prefix: Optional[str]     # static prefix of an f-string target
+    keys: Optional[FrozenSet[str]]  # payload dict keys; None = opaque
+    has_payload: bool
+
+
+@dataclasses.dataclass
+class TopicSite:
+    module: object
+    node: ast.AST
+    role: str                 # "publish" | "subscribe"
+    exact: Optional[str]
+    prefix: Optional[str]     # exact is None → f-string/helper prefix
+
+    @property
+    def dynamic(self) -> bool:
+        return self.exact is None and self.prefix is None
+
+
+@dataclasses.dataclass
+class SiteRef:
+    module: object
+    node: ast.AST
+    name: str
+
+
+@dataclasses.dataclass
+class KnobRef:
+    module: object
+    node: ast.AST
+    name: str
+    kind: str                 # "read" | "override"
+
+
+class WireIndex:
+    """The five wire-surface tables over one program index."""
+
+    def __init__(self):
+        self.handlers: Dict[str, List[Handler]] = {}
+        self.calls: List[CallSite] = []
+        self.topics: List[TopicSite] = []
+        self.plan_sites: List[SiteRef] = []
+        self.checked_sites: List[SiteRef] = []
+        self.declared_sites: List[SiteRef] = []
+        self.knob_defs: Set[str] = set()
+        self.knob_refs: List[KnobRef] = []
+        self.singletons: Set[str] = set()   # config singleton qualnames
+        self.mentions: Counter = Counter()  # every string constant
+
+    def add_handler(self, h: Handler) -> None:
+        self.handlers.setdefault(h.name, []).append(h)
+
+    @property
+    def checked_site_names(self) -> Set[str]:
+        return {s.name for s in self.checked_sites}
+
+    @property
+    def declared_site_names(self) -> Set[str]:
+        return {s.name for s in self.declared_sites}
+
+    @property
+    def exact_call_names(self) -> Set[str]:
+        return {c.name for c in self.calls if c.name is not None}
+
+    @property
+    def call_prefixes(self) -> Set[str]:
+        return {
+            c.prefix for c in self.calls
+            if c.name is None and c.prefix
+        }
+
+
+# ---------------------------------------------------------------------------
+# Constant / prefix resolution
+# ---------------------------------------------------------------------------
+
+
+def _module_const(pindex, dotted: str) -> Optional[str]:
+    """``pkg.mod.NAME`` → the module-level string constant it names, or
+    None.  One alias hop (``NAME = OTHER`` in the same module) is
+    followed; anything deeper stays unresolved."""
+    for _hop in range(2):
+        head, _, attr = dotted.rpartition(".")
+        if not head or not attr:
+            return None
+        mod = pindex.modules.get(head)
+        if mod is None:
+            return None
+        value = mod.top_assigns.get(attr)
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            return value.value
+        if isinstance(value, ast.Name):
+            nxt = mod.resolve(value)
+            if nxt is None or nxt == dotted:
+                return None
+            dotted = nxt
+            continue
+        return None
+    return None
+
+
+def _joined_prefix(node: ast.JoinedStr) -> Tuple[Optional[str], str]:
+    """(exact, prefix) of an f-string: exact when every piece is a
+    constant, else the leading static prefix (possibly empty)."""
+    parts: List[str] = []
+    for piece in node.values:
+        if isinstance(piece, ast.Constant) and isinstance(piece.value, str):
+            parts.append(piece.value)
+        else:
+            return None, "".join(parts)
+    return "".join(parts), ""
+
+
+def _single_return(fn_node: ast.AST) -> Optional[ast.expr]:
+    """The returned expression of a one-statement helper (docstring
+    allowed), e.g. ``def reform_channel(g): return f"...:{g}"``."""
+    body = [
+        s for s in fn_node.body
+        if not (
+            isinstance(s, ast.Expr)
+            and isinstance(s.value, ast.Constant)
+            and isinstance(s.value.value, str)
+        )
+    ]
+    if len(body) == 1 and isinstance(body[0], ast.Return):
+        return body[0].value
+    return None
+
+
+def resolve_wire_name(
+    pindex, module, expr: ast.AST, follow_calls: bool = True
+) -> Tuple[Optional[str], Optional[str]]:
+    """(exact, prefix) for a wire-name expression.  ``(None, None)``
+    means dynamic — the caller records nothing and flags nothing."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value, None
+    if isinstance(expr, ast.JoinedStr):
+        exact, prefix = _joined_prefix(expr)
+        if exact is not None:
+            return exact, None
+        return None, (prefix or None)
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        dotted = module.resolve(expr)
+        if dotted is not None:
+            value = _module_const(pindex, dotted)
+            if value is not None:
+                return value, None
+        return None, None
+    if follow_calls and isinstance(expr, ast.Call):
+        dotted = pindex.resolve_name(module, expr.func)
+        fn = pindex.functions.get(dotted) if dotted else None
+        if fn is not None:
+            ret = _single_return(fn.node)
+            if ret is not None:
+                return resolve_wire_name(
+                    pindex, fn.module, ret, follow_calls=False
+                )
+        return None, None
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# Handler signatures
+# ---------------------------------------------------------------------------
+
+
+def _positional_params(fn_node: ast.AST) -> List[str]:
+    a = fn_node.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def _iter_skip_nested(body) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+_UNCONDITIONAL_STMTS = (
+    ast.Expr, ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Return,
+    ast.Raise, ast.Assert, ast.With, ast.AsyncWith,
+)
+
+
+def _collect_keys(node: ast.AST, payload: str, out: Set[str]) -> None:
+    """Constant keys of bare ``payload["k"]`` loads under ``node``,
+    skipping conditional expression arms (IfExp, `and`/`or` tails) and
+    nested defs."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Lambda)):
+        return
+    if isinstance(node, ast.IfExp):
+        _collect_keys(node.test, payload, out)
+        return
+    if isinstance(node, ast.BoolOp):
+        if node.values:
+            _collect_keys(node.values[0], payload, out)
+        return
+    if (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == payload
+        and isinstance(node.ctx, ast.Load)
+        and isinstance(node.slice, ast.Constant)
+        and isinstance(node.slice.value, str)
+    ):
+        out.add(node.slice.value)
+    for child in ast.iter_child_nodes(node):
+        _collect_keys(child, payload, out)
+
+
+def handler_signature(
+    body, payload: Optional[str]
+) -> Tuple[FrozenSet[str], FrozenSet[str], bool]:
+    """(required, optional, opaque) for a handler body reading
+    ``payload``.  Required keys come only from unconditional top-level
+    statements (a key read inside an ``if`` is not a contract).  Any use
+    of the payload other than ``p["k"]`` / ``p.get("k")`` / ``"k" in p``
+    makes the handler opaque — it may forward the dict anywhere, so no
+    shape claim is safe."""
+    if payload is None:
+        return frozenset(), frozenset(), True
+    required: Set[str] = set()
+    optional: Set[str] = set()
+    sanctioned: Set[int] = set()
+    for node in _iter_skip_nested(body):
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == payload
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+        ):
+            sanctioned.add(id(node.value))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == payload
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            sanctioned.add(id(node.func.value))
+            optional.add(node.args[0].value)
+        elif (
+            isinstance(node, ast.Compare)
+            and len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.In, ast.NotIn))
+            and isinstance(node.comparators[0], ast.Name)
+            and node.comparators[0].id == payload
+        ):
+            sanctioned.add(id(node.comparators[0]))
+    for node in _iter_skip_nested(body):
+        if (
+            isinstance(node, ast.Name)
+            and node.id == payload
+            and id(node) not in sanctioned
+        ):
+            return frozenset(), frozenset(), True
+    for stmt in body:
+        if isinstance(stmt, _UNCONDITIONAL_STMTS):
+            _collect_keys(stmt, payload, required)
+    return frozenset(required), frozenset(optional), False
+
+
+def _payload_param(fn_node: ast.AST, skip_self: bool) -> Optional[str]:
+    """Wire convention: handlers are ``(conn, payload)`` (plus ``self``
+    for methods) — the payload is the last positional parameter."""
+    params = _positional_params(fn_node)
+    if skip_self and params and params[0] in ("self", "cls"):
+        params = params[1:]
+    if len(params) >= 2:
+        return params[-1]
+    return None
+
+
+def _has_kwargs(fn_node: ast.AST) -> bool:
+    return fn_node.args.kwarg is not None
+
+
+# ---------------------------------------------------------------------------
+# Pass A: knob defs, config singletons, SITES registry, string mentions
+# ---------------------------------------------------------------------------
+
+
+def _collect_module_facts(mod, wire: WireIndex) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            wire.mentions[node.value] += 1
+
+    # D = _Config.define style aliases, mapped to their owning class
+    alias_owner: Dict[str, str] = {}
+    for stmt in mod.tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Attribute)
+            and stmt.value.attr == "define"
+            and isinstance(stmt.value.value, ast.Name)
+        ):
+            alias_owner[stmt.targets[0].id] = stmt.value.value.id
+
+    owners: Set[str] = set()
+    found_defs = False
+    for stmt in mod.tree.body:
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)):
+            continue
+        call = stmt.value
+        owner = None
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "define"
+            and isinstance(call.func.value, ast.Name)
+        ):
+            owner = call.func.value.id
+        elif (
+            isinstance(call.func, ast.Name)
+            and call.func.id in alias_owner
+        ):
+            owner = alias_owner[call.func.id]
+        if owner is None or not call.args:
+            continue
+        first = call.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            wire.knob_defs.add(first.value)
+            owners.add(owner)
+            found_defs = True
+
+    if found_defs:
+        for stmt in mod.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Name)
+                and stmt.value.func.id in owners
+            ):
+                wire.singletons.add(
+                    f"{mod.name}.{stmt.targets[0].id}"
+                )
+
+    # the canonical chaos-site registry lives in a `faults` module
+    if mod.name.split(".")[-1] == "faults":
+        for stmt in mod.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "SITES"
+                and isinstance(stmt.value, (ast.Tuple, ast.List))
+            ):
+                for elt in stmt.value.elts:
+                    name = None
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        name = elt.value
+                    elif isinstance(elt, ast.Name):
+                        value = mod.top_assigns.get(elt.id)
+                        if isinstance(value, ast.Constant) and isinstance(
+                            value.value, str
+                        ):
+                            name = value.value
+                    if name is not None:
+                        wire.declared_sites.append(
+                            SiteRef(mod, elt, name)
+                        )
+
+
+# ---------------------------------------------------------------------------
+# Pass B: handlers, calls, topics, chaos refs, knob refs
+# ---------------------------------------------------------------------------
+
+
+def _bound_names(fn_node: ast.AST) -> Set[str]:
+    """Names the function binds (params, assignments, imports, loop and
+    ``with``/``except`` targets) — over-approximated across nested
+    scopes, so shadow checks under-report rather than false-positive."""
+    bound: Set[str] = set()
+    a = fn_node.args
+    for arg in (
+        list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        + ([a.vararg] if a.vararg else [])
+        + ([a.kwarg] if a.kwarg else [])
+    ):
+        bound.add(arg.arg)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+    return bound
+
+
+def _dict_keys(expr: ast.AST) -> Optional[FrozenSet[str]]:
+    """Constant string keys of a dict literal; None (opaque) for any
+    other payload expression, ``**`` expansion, or non-string key."""
+    if isinstance(expr, ast.Constant) and expr.value is None:
+        return frozenset()
+    if not isinstance(expr, ast.Dict):
+        return None
+    keys: Set[str] = set()
+    for k in expr.keys:
+        if k is None:  # {**base, ...}
+            return None
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        keys.add(k.value)
+    return frozenset(keys)
+
+
+def _dict_value(expr: ast.AST, key: str) -> Optional[ast.AST]:
+    if not isinstance(expr, ast.Dict):
+        return None
+    for k, v in zip(expr.keys, expr.values):
+        if isinstance(k, ast.Constant) and k.value == key:
+            return v
+    return None
+
+
+def _method_branch_literals(test: ast.AST) -> List[Tuple[str, bool]]:
+    """(literal, signature_extractable) per rpc name a dispatcher branch
+    test matches: ``method == "x"``, ``"x" == method``, ``method in
+    ("a", "b")``, and ``or``-chains thereof."""
+    out: List[Tuple[str, bool]] = []
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+        for v in test.values:
+            out.extend(_method_branch_literals(v))
+        return out
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+        return out
+    left, op, right = test.left, test.ops[0], test.comparators[0]
+    if isinstance(op, ast.Eq):
+        for a, b in ((left, right), (right, left)):
+            if (
+                isinstance(a, ast.Name) and a.id == "method"
+                and isinstance(b, ast.Constant)
+                and isinstance(b.value, str)
+            ):
+                out.append((b.value, True))
+    elif isinstance(op, ast.In):
+        if (
+            isinstance(left, ast.Name) and left.id == "method"
+            and isinstance(right, (ast.Tuple, ast.List, ast.Set))
+        ):
+            for elt in right.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    out.append((elt.value, False))
+    return out
+
+
+class _ModuleWalker(ast.NodeVisitor):
+    """Pass B over one module: every wire-surface fact that needs the
+    cross-module resolution environment."""
+
+    def __init__(self, pindex, wire: WireIndex, mod):
+        self.pindex = pindex
+        self.wire = wire
+        self.mod = mod
+        self.class_stack: List[ast.ClassDef] = []
+        self.func_stack: List[ast.AST] = []
+        self._bound_cache: Dict[int, Set[str]] = {}
+
+    def run(self) -> None:
+        self.visit(self.mod.tree)
+
+    # -- scope tracking --------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        self._enter_function(node)
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _shadowed(self, name: str) -> bool:
+        for fn in self.func_stack:
+            cache = self._bound_cache.get(id(fn))
+            if cache is None:
+                cache = self._bound_cache[id(fn)] = _bound_names(fn)
+            if name in cache:
+                return True
+        return False
+
+    # -- handlers --------------------------------------------------------
+
+    def _enter_function(self, node) -> None:
+        if self.class_stack and node.name.startswith("rpc_"):
+            payload = _payload_param(node, skip_self=True)
+            req, opt, opaque = handler_signature(node.body, payload)
+            if _has_kwargs(node):
+                opaque = True
+            self.wire.add_handler(Handler(
+                name=node.name[len("rpc_"):],
+                module=self.mod,
+                node=node,
+                kind="rpc-def",
+                required=req,
+                optional=opt,
+                opaque=opaque,
+                self_mentions=0,
+            ))
+        params = _positional_params(node)
+        if "method" in params and "conn" in params:
+            self._dispatcher_branches(node)
+
+    def _dispatcher_branches(self, fn_node) -> None:
+        payload = _payload_param(fn_node, skip_self=True)
+        if payload in ("method", "conn"):
+            payload = None
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.If):
+                continue
+            for literal, extractable in _method_branch_literals(node.test):
+                if extractable and payload is not None:
+                    req, opt, opaque = handler_signature(
+                        node.body, payload
+                    )
+                else:
+                    req, opt, opaque = frozenset(), frozenset(), True
+                self.wire.add_handler(Handler(
+                    name=literal,
+                    module=self.mod,
+                    node=node,
+                    kind="dispatcher",
+                    required=req,
+                    optional=opt,
+                    opaque=opaque,
+                    self_mentions=1,
+                ))
+
+    def _registered_handler(self, node: ast.Call) -> None:
+        if len(node.args) < 2:
+            return
+        name, _pfx = resolve_wire_name(
+            self.pindex, self.mod, node.args[0], follow_calls=False
+        )
+        if name is None:
+            return
+        target = node.args[1]
+        fn_node = None
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self.class_stack
+        ):
+            for item in self.class_stack[-1].body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and item.name == target.attr:
+                    fn_node = item
+                    break
+        elif isinstance(target, ast.Name):
+            dotted = self.pindex.resolve_name(self.mod, target)
+            fi = self.pindex.functions.get(dotted) if dotted else None
+            if fi is not None:
+                fn_node = fi.node
+        if fn_node is not None:
+            payload = _payload_param(fn_node, skip_self=True)
+            req, opt, opaque = handler_signature(fn_node.body, payload)
+            if _has_kwargs(fn_node):
+                opaque = True
+        else:
+            req, opt, opaque = frozenset(), frozenset(), True
+        self.wire.add_handler(Handler(
+            name=name,
+            module=self.mod,
+            node=node,
+            kind="registered",
+            required=req,
+            optional=opt,
+            opaque=opaque,
+            self_mentions=1,
+        ))
+
+    # -- calls / topics / chaos / knobs ----------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in RPC_VERBS and node.args:
+                self._rpc_call(node, f.attr)
+            elif f.attr == "publish" and node.args:
+                self._topic(node, node.args[0], "publish")
+            elif f.attr in ("subscribe", "subscribe_async") and node.args:
+                self._topic(node, node.args[0], "subscribe")
+            elif f.attr == "hit" and node.args:
+                self._checked_site(node)
+            elif f.attr == "register_rpc_handler":
+                self._registered_handler(node)
+            elif f.attr == "override" and node.args:
+                self._override(node, f)
+        elif isinstance(f, ast.Name):
+            if f.id == "hit" and node.args:
+                self._checked_site(node)
+        last = None
+        if isinstance(f, ast.Attribute):
+            last = f.attr
+        elif isinstance(f, ast.Name):
+            last = f.id
+        if last == "FaultPlan":
+            for kw in node.keywords:
+                if kw.arg == "site" and isinstance(
+                    kw.value, ast.Constant
+                ) and isinstance(kw.value.value, str):
+                    self.wire.plan_sites.append(
+                        SiteRef(self.mod, node, kw.value.value)
+                    )
+            if node.args and isinstance(
+                node.args[0], ast.Constant
+            ) and isinstance(node.args[0].value, str):
+                self.wire.plan_sites.append(
+                    SiteRef(self.mod, node, node.args[0].value)
+                )
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict):
+        # a plan-shaped dict literal ({"site": ..., "action": ...}) is a
+        # wire-format FaultPlan (RT_FAULTS / scenario JSON)
+        keys = {
+            k.value for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+        if "site" in keys and "action" in keys:
+            site = _dict_value(node, "site")
+            if isinstance(site, ast.Constant) and isinstance(
+                site.value, str
+            ):
+                self.wire.plan_sites.append(
+                    SiteRef(self.mod, node, site.value)
+                )
+        self.generic_visit(node)
+
+    def _rpc_call(self, node: ast.Call, verb: str) -> None:
+        name, prefix = resolve_wire_name(
+            self.pindex, self.mod, node.args[0]
+        )
+        if name is None and prefix is None:
+            return
+        keys: Optional[FrozenSet[str]]
+        has_payload = len(node.args) >= 2
+        if has_payload:
+            keys = _dict_keys(node.args[1])
+        else:
+            kw = next(
+                (k for k in node.keywords if k.arg == "payload"), None
+            )
+            if kw is not None:
+                has_payload = True
+                keys = _dict_keys(kw.value)
+            else:
+                keys = frozenset()
+        self.wire.calls.append(CallSite(
+            module=self.mod, node=node, verb=verb,
+            name=name, prefix=prefix, keys=keys,
+            has_payload=has_payload,
+        ))
+        # the wire shapes of pubsub: subscribing is an rpc whose payload
+        # names the channel; publishing is a "publish" notify
+        if name in ("subscribe", "publish") and has_payload:
+            chan = len(node.args) >= 2 and _dict_value(
+                node.args[1], "channel"
+            )
+            if chan:
+                role = (
+                    "subscribe" if name == "subscribe" else "publish"
+                )
+                self._topic(node, chan, role)
+
+    def _topic(self, node: ast.AST, expr: ast.AST, role: str) -> None:
+        exact, prefix = resolve_wire_name(self.pindex, self.mod, expr)
+        self.wire.topics.append(TopicSite(
+            module=self.mod, node=node, role=role,
+            exact=exact, prefix=prefix,
+        ))
+
+    def _checked_site(self, node: ast.Call) -> None:
+        name, _pfx = resolve_wire_name(
+            self.pindex, self.mod, node.args[0], follow_calls=False
+        )
+        if name is not None:
+            self.wire.checked_sites.append(SiteRef(self.mod, node, name))
+
+    def _override(self, node: ast.Call, f: ast.Attribute) -> None:
+        dotted = self.mod.resolve(f.value)
+        if dotted is None or dotted not in self.wire.singletons:
+            return
+        if (
+            isinstance(f.value, ast.Name)
+            and self._shadowed(f.value.id)
+        ):
+            return
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(
+            first.value, str
+        ):
+            self.wire.knob_refs.append(
+                KnobRef(self.mod, node, first.value, "override")
+            )
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (
+            isinstance(node.ctx, ast.Load)
+            and not node.attr.startswith("_")
+            and node.attr not in CONFIG_API_ATTRS
+            and isinstance(node.value, (ast.Name, ast.Attribute))
+        ):
+            dotted = self.mod.resolve(node.value)
+            if dotted is not None and dotted in self.wire.singletons:
+                root = node.value
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if not (
+                    isinstance(root, ast.Name)
+                    and self._shadowed(root.id)
+                ):
+                    self.wire.knob_refs.append(
+                        KnobRef(self.mod, node, node.attr, "read")
+                    )
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def build_wire_index(pindex) -> WireIndex:
+    wire = WireIndex()
+    for mname in sorted(pindex.modules):
+        _collect_module_facts(pindex.modules[mname], wire)
+    for mname in sorted(pindex.modules):
+        _ModuleWalker(pindex, wire, pindex.modules[mname]).run()
+    return wire

@@ -1,0 +1,266 @@
+"""RT4xx wire-contract rules over the :class:`~.extract.WireIndex`.
+
+Shared stance on dynamic names (see docs/architecture.md): a name the
+extractor cannot resolve to a literal or a static prefix produces no
+table entry — it can neither be flagged nor satisfy another side of a
+contract.  Precision over recall, same as rtflow/rtrace.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.proto.engine import ProtoRule
+
+
+class UnknownRpcTarget(ProtoRule):
+    id = "RT401"
+    name = "unknown-rpc-target"
+    description = (
+        "A .call/.call_soon/.notify names an rpc that no handler "
+        "anywhere in the program can dispatch."
+    )
+    hint = (
+        "Check the method string against the rpc_* defs, "
+        "register_rpc_handler sites, and dispatcher branches; a typo "
+        "here fails only at runtime with a method-not-found error."
+    )
+
+    def check(self, index, wire) -> None:
+        for call in wire.calls:
+            if call.name is None:
+                continue  # prefix/f-string targets are never flagged
+            if call.name in wire.handlers:
+                continue
+            self.add(
+                call.module,
+                call.node,
+                message=(
+                    f"rpc target {call.name!r} has no handler anywhere "
+                    f"in the scanned program"
+                ),
+            )
+
+
+class RpcShapeMismatch(ProtoRule):
+    id = "RT402"
+    name = "rpc-shape-mismatch"
+    description = (
+        "A call site's payload dict is missing keys that every "
+        "candidate handler for that rpc reads unconditionally."
+    )
+    hint = (
+        "Add the missing key(s) to the payload, or read them with "
+        ".get() in the handler if they are genuinely optional."
+    )
+
+    def check(self, index, wire) -> None:
+        for call in wire.calls:
+            if call.name is None or call.keys is None:
+                continue  # dynamic target or opaque payload
+            handlers = wire.handlers.get(call.name)
+            if not handlers or any(h.opaque for h in handlers):
+                continue
+            # compatible with ANY candidate handler → fine; the call is
+            # wrong only if every handler demands keys it doesn't send
+            missing_per_handler = [
+                sorted(h.required - call.keys) for h in handlers
+            ]
+            if all(missing_per_handler):
+                missing = min(missing_per_handler, key=len)
+                self.add(
+                    call.module,
+                    call.node,
+                    message=(
+                        f"payload for rpc {call.name!r} is missing "
+                        f"key(s) {missing} that every handler reads "
+                        f"unconditionally"
+                    ),
+                )
+
+
+class OrphanHandler(ProtoRule):
+    id = "RT403"
+    name = "orphan-handler"
+    description = (
+        "A registered rpc handler that no call site and no string "
+        "mention anywhere in the program refers to — dead wire surface."
+    )
+    hint = (
+        "Delete the handler, or baseline it with an audit comment if "
+        "it is a public entry point for out-of-package clients."
+    )
+
+    def check(self, index, wire) -> None:
+        exact = wire.exact_call_names
+        prefixes = wire.call_prefixes
+        for name in sorted(wire.handlers):
+            if name in exact:
+                continue
+            if any(name.startswith(p) for p in prefixes):
+                continue
+            handlers = wire.handlers[name]
+            self_mentions = sum(h.self_mentions for h in handlers)
+            if wire.mentions[name] - self_mentions > 0:
+                # named somewhere else (tests, docs-by-string, dynamic
+                # call assembly) — not provably dead
+                continue
+            for h in handlers:
+                self.add(
+                    h.module,
+                    h.node,
+                    message=(
+                        f"handler for rpc {h.name!r} has no call site "
+                        f"or string mention anywhere in the scanned "
+                        f"program"
+                    ),
+                )
+
+
+class UnknownChaosSite(ProtoRule):
+    id = "RT404"
+    name = "unknown-chaos-site"
+    description = (
+        "A FaultPlan (or plan-shaped dict) names a chaos site that no "
+        "runtime fault_ctl.hit() guards, or a hit site drifts from the "
+        "canonical faults.SITES registry."
+    )
+    hint = (
+        "Site names are only meaningful where a runtime check exists; "
+        "add the site to faults.SITES and guard it with hit(), or fix "
+        "the plan's site string."
+    )
+
+    def check(self, index, wire) -> None:
+        checked = wire.checked_site_names
+        declared = wire.declared_site_names
+        for ref in wire.plan_sites:
+            if ref.name not in checked:
+                self.add(
+                    ref.module,
+                    ref.node,
+                    message=(
+                        f"fault plan targets site {ref.name!r} but no "
+                        f"runtime hit() check guards that name — the "
+                        f"plan arms and never fires"
+                    ),
+                )
+        for ref in wire.declared_sites:
+            if ref.name not in checked:
+                self.add(
+                    ref.module,
+                    ref.node,
+                    message=(
+                        f"registry declares site {ref.name!r} but no "
+                        f"runtime hit() check guards it"
+                    ),
+                )
+        if declared:
+            for ref in wire.checked_sites:
+                if ref.name not in declared:
+                    self.add(
+                        ref.module,
+                        ref.node,
+                        message=(
+                            f"runtime check site {ref.name!r} is not "
+                            f"in the canonical faults.SITES registry"
+                        ),
+                    )
+
+
+class UnknownConfigKnob(ProtoRule):
+    id = "RT405"
+    name = "unknown-config-knob"
+    description = (
+        "An attribute read or string override() against the config "
+        "singleton names a knob no _Config.define declares."
+    )
+    hint = (
+        "The read raises AttributeError only when that code path runs; "
+        "fix the knob name or add the missing define()."
+    )
+
+    def check(self, index, wire) -> None:
+        if not wire.knob_defs:
+            return  # no config plane in the scanned program
+        for ref in wire.knob_refs:
+            if ref.name in wire.knob_defs:
+                continue
+            what = (
+                "override()" if ref.kind == "override"
+                else "attribute read"
+            )
+            self.add(
+                ref.module,
+                ref.node,
+                message=(
+                    f"config {what} names knob {ref.name!r} but no "
+                    f"_Config.define declares it"
+                ),
+            )
+
+
+class PubsubTopicMismatch(ProtoRule):
+    id = "RT406"
+    name = "pubsub-topic-mismatch"
+    description = (
+        "A publish with no matching subscriber, or a subscribe with no "
+        "matching publisher — one-sided topics are silent failures."
+    )
+    hint = (
+        "Check the topic string on both sides; dynamic prefixes match "
+        "by static prefix.  Baseline (with an audit comment) topics "
+        "that are intentionally consumed outside the package."
+    )
+
+    @staticmethod
+    def _matches(a, b) -> bool:
+        """Can topic site *a* reach topic site *b*?  Exact names match
+        exactly; a prefix site matches anything it prefixes (and vice
+        versa); two prefixes match if either extends the other."""
+        if a.exact is not None and b.exact is not None:
+            return a.exact == b.exact
+        if a.exact is not None and b.prefix is not None:
+            return a.exact.startswith(b.prefix)
+        if a.prefix is not None and b.exact is not None:
+            return b.exact.startswith(a.prefix)
+        if a.prefix is not None and b.prefix is not None:
+            return a.prefix.startswith(b.prefix) or b.prefix.startswith(
+                a.prefix
+            )
+        return False
+
+    def check(self, index, wire) -> None:
+        pubs = [t for t in wire.topics if t.role == "publish"]
+        subs = [t for t in wire.topics if t.role == "subscribe"]
+        # a fully-dynamic site on either side could name anything, so
+        # it neither gets flagged nor vouches for the other side; the
+        # GCS relay (publish(p["channel"], ...)) is exactly this case
+        for pub in pubs:
+            if pub.dynamic:
+                continue
+            if not any(self._matches(pub, s) for s in subs):
+                topic = pub.exact if pub.exact is not None else (
+                    pub.prefix + "*"
+                )
+                self.add(
+                    pub.module,
+                    pub.node,
+                    message=(
+                        f"publish to topic {topic!r} has no subscriber "
+                        f"anywhere in the scanned program"
+                    ),
+                )
+        for sub in subs:
+            if sub.dynamic:
+                continue
+            if not any(self._matches(sub, p) for p in pubs):
+                topic = sub.exact if sub.exact is not None else (
+                    sub.prefix + "*"
+                )
+                self.add(
+                    sub.module,
+                    sub.node,
+                    message=(
+                        f"subscribe to topic {topic!r} has no "
+                        f"publisher anywhere in the scanned program"
+                    ),
+                )

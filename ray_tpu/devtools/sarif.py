@@ -1,9 +1,10 @@
-"""SARIF 2.1.0 output for rtlint/rtflow/rtrace findings.
+"""SARIF 2.1.0 output for rtlint/rtflow/rtrace/rtproto findings.
 
 SARIF is the interchange format CI systems (GitHub code scanning,
 Azure, Gitlab) render as inline PR annotations.  One run object carries
 every active tier (per-file RT1xx, whole-program RT2xx, concurrency
-RT3xx — including the native C++ lock-order findings); baselined
+RT3xx — including the native C++ lock-order findings — and
+wire-contract RT4xx); baselined
 findings are included but marked with an ``external`` suppression so
 dashboards show them as accepted debt instead of new violations.
 """

@@ -222,7 +222,8 @@ class RpcRingBackend(RuntimeBackend):
             # ring exactly like a member dying mid-op — the group must
             # poison (and then be reformable), never wedge
             plan = fault_ctl.hit(
-                "collective.peer_conn", f"{self.spec.name}:{peer_rank}"
+                faults.SITE_COLLECTIVE_PEER_CONN,
+                f"{self.spec.name}:{peer_rank}",
             )
             if plan is not None and plan.action == "reset":
                 await conn.close()

@@ -1,0 +1,734 @@
+"""rtproto (RT4xx): per-rule fixture pairs + the whole-package gate.
+
+Same contract as tests/test_rtflow_lint.py and tests/test_rtrace_lint.py
+one tier down: every wire-contract rule must flag its positive fixture
+and stay silent on the compliant twin (mutation fixtures proving each
+rule actually fires), the dynamic-name policy (f-string prefixes,
+variable names) is pinned explicitly, the chaos site registry is
+asserted against the docs table and the runtime constants, and the
+final gate runs the real analysis over the installed package with the
+audited baseline — every baselined fingerprint MUST carry an audit
+justification.
+"""
+
+import os
+import re
+
+import pytest
+
+from ray_tpu.common import faults
+from ray_tpu.devtools.lint import load_baseline, split_baselined
+from ray_tpu.devtools.proto import (
+    DEFAULT_PROTO_BASELINE,
+    analyze_paths,
+    analyze_sources,
+    proto_rule_ids,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+
+
+def proto_ids(files, rules=None):
+    return [f.rule for f in analyze_sources(files, rules=rules)]
+
+
+# A minimal server/client wire pair most fixtures build on.  The
+# membership set absorbs RT403 for whichever handler a given fixture
+# doesn't call (same shape as gcs.py's rpc-permission sets).
+SERVER = '''
+_RPCS = {"ping", "put_blob"}
+
+class Server:
+    async def rpc_ping(self, conn, p):
+        return {"ok": True}
+
+    async def rpc_put_blob(self, conn, p):
+        sha = p["sha"]
+        hint = p.get("hint")
+        return sha, hint
+'''
+
+
+# ---------------------------------------------------------------------------
+# RT401 unknown-rpc-target
+# ---------------------------------------------------------------------------
+
+
+class TestUnknownRpcTarget:
+    def test_flags_typoed_call_name(self):
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("pingg", None)
+''',
+        }
+        assert proto_ids(files) == ["RT401"]
+
+    def test_silent_when_handler_exists(self):
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("ping", None)
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_notify_and_call_soon_are_checked_too(self):
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn):
+    conn.notify("pong", {"a": 1})
+    conn.call_soon("pongg", {"a": 1})
+''',
+        }
+        assert proto_ids(files) == ["RT401", "RT401"]
+
+    def test_registered_handler_satisfies_call(self):
+        files = {
+            "pkg/server.py": '''
+class Sub:
+    def wire(self, rt):
+        rt.register_rpc_handler("collective", self._inbound)
+
+    async def _inbound(self, conn, p):
+        return p.get("op")
+''',
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("collective", {"op": "x"})
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_dispatcher_branch_satisfies_call(self):
+        files = {
+            "pkg/worker.py": '''
+class W:
+    async def _handle(self, conn, method, p):
+        if method == "push_task":
+            return p["task"]
+''',
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("push_task", {"task": 1})
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_fstring_prefix_target_never_flagged(self):
+        # a templated name can't be checked against the handler table —
+        # the dynamic-name policy says: no entry, no finding
+        files = {
+            "pkg/client.py": '''
+async def go(conn, group):
+    await conn.call(f"collective:{group}", None)
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_module_constant_name_resolves(self):
+        files = {
+            "pkg/names.py": 'PING = "ping"\n',
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+from pkg.names import PING
+
+async def go(conn):
+    await conn.call(PING, None)
+''',
+        }
+        assert proto_ids(files) == []
+
+
+# ---------------------------------------------------------------------------
+# RT402 rpc-shape-mismatch
+# ---------------------------------------------------------------------------
+
+
+class TestRpcShapeMismatch:
+    def test_flags_missing_required_key(self):
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("put_blob", {"shaa": "abc"})
+''',
+        }
+        assert proto_ids(files) == ["RT402"]
+
+    def test_silent_when_required_key_present(self):
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("put_blob", {"sha": "abc"})
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_optional_get_key_not_required(self):
+        # "hint" is read via p.get() — omitting it is fine
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("put_blob", {"sha": "abc", "extra": 1})
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_kwargs_handler_is_exempt(self):
+        files = {
+            "pkg/server.py": '''
+class Server:
+    async def rpc_flex(self, conn, p, **kwargs):
+        return p["sha"]
+''',
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("flex", {"other": 1})
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_payload_escaping_handler_is_opaque(self):
+        # the handler forwards p wholesale — no shape claim is safe
+        files = {
+            "pkg/server.py": '''
+class Server:
+    async def rpc_relay(self, conn, p):
+        sha = p["sha"]
+        return self.forward(p)
+''',
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("relay", {"other": 1})
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_conditional_key_read_not_required(self):
+        files = {
+            "pkg/server.py": '''
+class Server:
+    async def rpc_maybe(self, conn, p):
+        if "mode" in p:
+            return p["mode"]
+        return None
+''',
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("maybe", {})
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_non_literal_payload_is_opaque(self):
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn, payload):
+    await conn.call("put_blob", payload)
+''',
+        }
+        assert proto_ids(files) == []
+
+
+# ---------------------------------------------------------------------------
+# RT403 orphan-handler
+# ---------------------------------------------------------------------------
+
+
+class TestOrphanHandler:
+    def test_flags_handler_nothing_names(self):
+        files = {
+            "pkg/server.py": '''
+class Server:
+    async def rpc_zombie(self, conn, p):
+        return 1
+''',
+        }
+        assert proto_ids(files) == ["RT403"]
+
+    def test_call_site_absorbs(self):
+        files = {
+            "pkg/server.py": '''
+class Server:
+    async def rpc_alive(self, conn, p):
+        return 1
+''',
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("alive", None)
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_string_mention_absorbs(self):
+        # permission-set membership (the gcs.py _READONLY_RPCS shape)
+        # counts as a reference — not provably dead
+        files = {
+            "pkg/server.py": '''
+_READONLY_RPCS = {"listed"}
+
+class Server:
+    async def rpc_listed(self, conn, p):
+        return 1
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_prefix_call_absorbs(self):
+        files = {
+            "pkg/server.py": '''
+class Server:
+    async def rpc_collective_op(self, conn, p):
+        return 1
+''',
+            "pkg/client.py": '''
+async def go(conn, kind):
+    await conn.call(f"collective_{kind}", None)
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_registered_name_does_not_self_absorb(self):
+        # the registration site's own string literal must not count as
+        # a "mention" — otherwise no registered handler could ever be
+        # an orphan
+        files = {
+            "pkg/server.py": '''
+class Sub:
+    def wire(self, rt):
+        rt.register_rpc_handler("orphaned", self._inbound)
+
+    async def _inbound(self, conn, p):
+        return 1
+''',
+        }
+        assert proto_ids(files) == ["RT403"]
+
+
+# ---------------------------------------------------------------------------
+# RT404 unknown-chaos-site
+# ---------------------------------------------------------------------------
+
+
+CHAOS_RUNTIME = '''
+from pkg import faults
+
+def send(ctl, frame):
+    if ctl is not None:
+        plan = ctl.hit("rpc.send.frame", "conn")
+        if plan is not None:
+            return None
+    return frame
+'''
+
+
+class TestUnknownChaosSite:
+    def test_flags_plan_for_unchecked_site(self):
+        files = {
+            "pkg/runtime.py": CHAOS_RUNTIME,
+            "pkg/test_plan.py": '''
+from pkg.faults import FaultPlan
+
+PLAN = FaultPlan(site="rpc.send.frames", action="drop")
+''',
+            "pkg/faults.py": '''
+class FaultPlan:
+    def __init__(self, site, action):
+        self.site = site
+''',
+        }
+        assert proto_ids(files) == ["RT404"]
+
+    def test_silent_for_checked_site(self):
+        files = {
+            "pkg/runtime.py": CHAOS_RUNTIME,
+            "pkg/test_plan.py": '''
+from pkg.faults import FaultPlan
+
+PLAN = FaultPlan(site="rpc.send.frame", action="drop")
+''',
+            "pkg/faults.py": '''
+class FaultPlan:
+    def __init__(self, site, action):
+        self.site = site
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_plan_shaped_dict_literal_is_checked(self):
+        # the RT_FAULTS / scenario-JSON wire form
+        files = {
+            "pkg/runtime.py": CHAOS_RUNTIME,
+            "pkg/scenario.py": '''
+ROWS = [{"site": "store.putt", "action": "error"}]
+''',
+        }
+        assert proto_ids(files) == ["RT404"]
+
+    def test_registry_entry_without_runtime_check_flagged(self):
+        files = {
+            "pkg/runtime.py": CHAOS_RUNTIME,
+            "pkg/faults.py": '''
+SITES = ("rpc.send.frame", "ghost.site")
+''',
+        }
+        assert proto_ids(files) == ["RT404"]
+
+    def test_checked_site_missing_from_registry_flagged(self):
+        # single-sourcing: once a registry exists, every hit site must
+        # be in it
+        files = {
+            "pkg/runtime.py": CHAOS_RUNTIME,
+            "pkg/faults.py": '''
+SITES = ("some.other.site",)
+
+def check(ctl):
+    if ctl is not None:
+        ctl.hit("some.other.site", "")
+''',
+        }
+        assert proto_ids(files) == ["RT404"]
+
+    def test_registry_matching_checks_is_silent(self):
+        files = {
+            "pkg/runtime.py": CHAOS_RUNTIME,
+            "pkg/faults.py": '''
+SITE_RPC_SEND_FRAME = "rpc.send.frame"
+SITES = (SITE_RPC_SEND_FRAME,)
+''',
+        }
+        assert proto_ids(files) == []
+
+
+# ---------------------------------------------------------------------------
+# RT405 unknown-config-knob
+# ---------------------------------------------------------------------------
+
+
+CONFIG_MOD = '''
+class _Config:
+    _DEFS = {}
+
+    @classmethod
+    def define(cls, name, typ, default):
+        cls._DEFS[name] = (typ, default)
+
+    def override(self, name, value):
+        pass
+
+
+D = _Config.define
+D("rpc_timeout_s", float, 30.0)
+_Config.define("pull_retry_max", int, 8)
+
+cfg = _Config()
+'''
+
+
+class TestUnknownConfigKnob:
+    def test_flags_typoed_attribute_read(self):
+        files = {
+            "pkg/config.py": CONFIG_MOD,
+            "pkg/user.py": '''
+from pkg.config import cfg
+
+def timeout():
+    return cfg.rpc_timeoutt_s
+''',
+        }
+        assert proto_ids(files) == ["RT405"]
+
+    def test_silent_for_defined_knob(self):
+        files = {
+            "pkg/config.py": CONFIG_MOD,
+            "pkg/user.py": '''
+from pkg.config import cfg
+
+def timeout():
+    return cfg.rpc_timeout_s + cfg.pull_retry_max
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_flags_typoed_override_string(self):
+        files = {
+            "pkg/config.py": CONFIG_MOD,
+            "pkg/user.py": '''
+from pkg.config import cfg
+
+def arm():
+    cfg.override("rpc_timeout_sec", 5.0)
+''',
+        }
+        assert proto_ids(files) == ["RT405"]
+
+    def test_shadowed_local_name_is_not_the_singleton(self):
+        # cfg here is a parameter (e.g. a PipelineConfig), not the
+        # config singleton — the import is shadowed
+        files = {
+            "pkg/config.py": CONFIG_MOD,
+            "pkg/user.py": '''
+from pkg.config import cfg
+
+def stage_count(cfg):
+    return cfg.num_stages
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_api_attrs_exempt(self):
+        files = {
+            "pkg/config.py": CONFIG_MOD,
+            "pkg/user.py": '''
+from pkg.config import cfg
+
+def reset_all():
+    return cfg.override
+''',
+        }
+        assert proto_ids(files) == []
+
+
+# ---------------------------------------------------------------------------
+# RT406 pubsub-topic-mismatch
+# ---------------------------------------------------------------------------
+
+
+class TestPubsubTopicMismatch:
+    def test_flags_publish_without_subscriber(self):
+        files = {
+            "pkg/pub.py": '''
+async def announce(rt):
+    rt.publish("orphan_topic", {"x": 1})
+''',
+        }
+        assert proto_ids(files) == ["RT406"]
+
+    def test_flags_subscribe_without_publisher(self):
+        files = {
+            "pkg/sub.py": '''
+async def watch(rt):
+    await rt.subscribe("nobody_publishes", cb)
+''',
+        }
+        assert proto_ids(files) == ["RT406"]
+
+    def test_matched_exact_topic_is_silent(self):
+        files = {
+            "pkg/pub.py": '''
+async def announce(rt):
+    rt.publish("routes", {"v": 2})
+''',
+            "pkg/sub.py": '''
+async def watch(rt):
+    await rt.subscribe_async("routes", cb)
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_fstring_prefix_matches_both_directions(self):
+        # publish f"room:{x}" meets subscribe f"room:{y}" by prefix;
+        # and an exact subscribe under the prefix matches too
+        files = {
+            "pkg/pub.py": '''
+async def announce(rt, gid):
+    rt.publish(f"room:{gid}", {"x": 1})
+''',
+            "pkg/sub.py": '''
+async def watch(rt, gid):
+    await rt.subscribe_async(f"room:{gid}", cb)
+
+async def watch_one(rt):
+    await rt.subscribe("room:main", cb)
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_helper_built_topic_resolves_through_one_return(self):
+        # the reform_channel shape: both sides call a one-return helper
+        files = {
+            "pkg/chan.py": '''
+def chan(group):
+    return f"reform:{group}"
+''',
+            "pkg/pub.py": '''
+from pkg.chan import chan
+
+async def announce(rt, g):
+    rt.publish(chan(g), {"gen": 1})
+''',
+            "pkg/sub.py": '''
+from pkg.chan import chan
+
+async def watch(rt, g):
+    await rt.subscribe_async(chan(g), cb)
+''',
+        }
+        assert proto_ids(files) == []
+
+    def test_dynamic_topic_neither_flags_nor_vouches(self):
+        # the GCS relay: publish(p["channel"], ...) could be anything —
+        # it must not satisfy the orphaned subscribe below
+        files = {
+            "pkg/relay.py": '''
+async def relay(rt, p):
+    rt.publish(p["channel"], p["message"])
+''',
+            "pkg/sub.py": '''
+async def watch(rt):
+    await rt.subscribe("specific_topic", cb)
+''',
+        }
+        assert proto_ids(files) == ["RT406"]
+
+    def test_wire_shape_subscribe_via_gcs_call(self):
+        # Runtime.subscribe is .call("subscribe", {"channel": ...});
+        # Runtime.publish is .notify("publish", {"channel": ...}) — the
+        # wire shapes must feed the topic table like the helpers do
+        files = {
+            "pkg/a.py": '''
+async def announce(gcs):
+    gcs.notify("publish", {"channel": "nodes", "message": {}})
+''',
+            "pkg/b.py": '''
+async def watch(gcs):
+    await gcs.call("subscribe", {"channel": "nodes"})
+''',
+        }
+        # "subscribe"/"publish" rpc names have no handler in this tiny
+        # fixture — restrict to RT406 to isolate the topic check
+        assert proto_ids(files, rules=["RT406"]) == []
+
+
+# ---------------------------------------------------------------------------
+# Machinery: ids, fingerprints, suppression
+# ---------------------------------------------------------------------------
+
+
+class TestMachinery:
+    def test_rule_ids_pinned(self):
+        assert proto_rule_ids() == (
+            "RT401", "RT402", "RT403", "RT404", "RT405", "RT406",
+        )
+
+    def test_fingerprints_deterministic_and_unique(self):
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn):
+    await conn.call("pingg", None)
+    await conn.call("put_blob", {"shaa": 1})
+''',
+        }
+        first = [f.fingerprint() for f in analyze_sources(files)]
+        second = [f.fingerprint() for f in analyze_sources(files)]
+        assert first == second
+        assert len(set(first)) == len(first) == 2
+
+    def test_suppression_comment_applies(self):
+        files = {
+            "pkg/server.py": SERVER,
+            "pkg/client.py": '''
+async def go(conn):
+    # rtlint: disable-next=RT401
+    await conn.call("pingg", None)
+''',
+        }
+        assert proto_ids(files) == []
+
+
+# ---------------------------------------------------------------------------
+# Chaos site registry single-sourcing (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestSiteRegistry:
+    def test_docs_table_matches_faults_sites(self):
+        """The architecture.md site-registry table is asserted (not
+        generated) against the canonical tuple: every `FaultPlan` site
+        row must be in faults.SITES and vice versa.  `rpc.link` is the
+        link-cut registry, documented in the same table but explicitly
+        not a FaultPlan site."""
+        doc = os.path.join(REPO, "docs", "architecture.md")
+        with open(doc, encoding="utf-8") as fh:
+            text = fh.read()
+        start = text.index("### Site registry")
+        end = text.index("### FaultPlan semantics")
+        rows = re.findall(
+            r"^\| `([a-z_.]+)` \|", text[start:end], flags=re.M
+        )
+        assert rows, "site table not found in docs/architecture.md"
+        documented = set(rows) - {"rpc.link"}
+        assert documented == set(faults.SITES)
+
+    def test_site_constants_are_the_registry(self):
+        assert faults.SITES == (
+            faults.SITE_RPC_SEND_FRAME,
+            faults.SITE_RPC_RECV_MSG,
+            faults.SITE_STORE_PUT,
+            faults.SITE_RAYLET_LEASE_GRANT,
+            faults.SITE_NODE_PREEMPT,
+            faults.SITE_COLLECTIVE_PEER_CONN,
+        )
+        assert len(set(faults.SITES)) == len(faults.SITES)
+
+    def test_from_dict_accepts_every_registered_site(self):
+        for site in faults.SITES:
+            plan = faults.FaultPlan.from_dict({"site": site})
+            assert plan.site == site
+
+    def test_from_dict_rejects_unregistered_site(self):
+        # the wire path (RT_FAULTS / scenario JSON) validates; a typo'd
+        # site used to arm a plan that never fired
+        with pytest.raises(ValueError, match="rpc.send.frames"):
+            faults.FaultPlan.from_dict({"site": "rpc.send.frames"})
+
+    def test_direct_construction_stays_freeform(self):
+        # unit tests use synthetic sites via the constructor
+        assert faults.FaultPlan(site="synthetic.site").site == (
+            "synthetic.site"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Whole-package gate + audited baseline
+# ---------------------------------------------------------------------------
+
+
+class TestWholePackage:
+    def test_package_has_no_non_baselined_findings(self):
+        report = analyze_paths([PKG])
+        assert report.parse_errors == []
+        assert report.files_indexed > 100
+        baseline = load_baseline(DEFAULT_PROTO_BASELINE)
+        new, _ = split_baselined(report.findings, baseline)
+        assert new == [], [f.render() for f in new]
+
+    def test_every_baselined_finding_has_audit_justification(self):
+        import json
+
+        with open(DEFAULT_PROTO_BASELINE, encoding="utf-8") as fh:
+            data = json.load(fh)
+        audit = data.get("audit", {})
+        for fp in data.get("findings", {}):
+            assert audit.get(fp, "").strip(), (
+                f"baselined fingerprint {fp} has no audit justification"
+            )
+
+    def test_baseline_absorbs_only_current_findings(self):
+        # no stale entries: every baselined fingerprint must still be
+        # produced by the live tree (otherwise the debt was paid and
+        # the entry should be deleted)
+        report = analyze_paths([PKG])
+        live = {f.fingerprint() for f in report.findings}
+        baseline = load_baseline(DEFAULT_PROTO_BASELINE)
+        stale = set(baseline) - live
+        assert stale == set(), stale
