@@ -1130,17 +1130,25 @@ def bench_preemption_recovery():
 
 
 def bench_pipeline_gpt2(ray_tpu, steps: int = 6, trials: int = 3):
-    """MPMD pipeline GPT-2 vs the single-gang baseline at equal chips,
-    interleaved A/B (pipeline step block / local step block per trial,
-    so host drift hits both arms equally).
+    """MPMD pipeline GPT-2, three interleaved arms per trial — p2p
+    channel handoff / driver-ref handoff / single-gang local — so host
+    drift hits every arm equally.
 
-    CPU context: one host, so the row measures ORCHESTRATION overhead —
-    the per-micro-op actor-call + shm-handoff cost over the same math —
-    not parallel speedup (that needs stages on distinct chips).  Both
-    arms run the identical per-stage programs (train.pipeline's
+    CPU context: one host, so the tokens/s rows measure ORCHESTRATION
+    overhead — per-micro-op actor calls plus the handoff plane — over
+    identical math, not parallel speedup (that needs stages on distinct
+    chips).  All arms run the same per-stage programs (train.pipeline's
     LocalPipelineRunner IS the pipeline partition run in one process),
-    and the bitwise loss cross-check keeps the row honest.
+    and the bitwise loss cross-check on BOTH distributed arms keeps the
+    rows honest.
+
+    The ``driver_rpcs_per_microop`` pair is the data-plane-v2 headline:
+    outbound driver RPCs (``core.rpc.CALLS`` delta across the timed
+    block — control submissions, ref promotions, store/GCS traffic)
+    per ideal micro-op.  The p2p arm ships no data refs, so its count
+    collapses to the pure control-ack floor.
     """
+    from ray_tpu.core import rpc as rpc_mod
     from ray_tpu.models import gpt2 as gpt2_mod
     from ray_tpu.train.pipeline import (
         LocalPipelineRunner,
@@ -1150,42 +1158,68 @@ def bench_pipeline_gpt2(ray_tpu, steps: int = 6, trials: int = 3):
     )
 
     cfg = gpt2_mod.GPTConfig.tiny(num_layers=4, max_seq_len=64)
-    pc = PipelineConfig(
-        model_config=cfg, n_stages=2, n_micro=4, micro_batch=4,
-        seq_len=64, optimizer={"name": "adam", "lr": 1e-3},
-        name="bench-pipe",
-    )
+
+    def make(handoff, name):
+        return PipelineConfig(
+            model_config=cfg, n_stages=2, n_micro=4, micro_batch=4,
+            seq_len=64, optimizer={"name": "adam", "lr": 1e-3},
+            name=name, handoff=handoff,
+        )
+
+    pc = make("p2p", "bench-pipe-p2p")
+    pc_ref = make("driver", "bench-pipe-ref")
     tr = PipelineTrainer(pc, bundle={"CPU": 1})
+    tr_ref = PipelineTrainer(pc_ref, bundle={"CPU": 1})
     try:
         tr.start()
+        tr_ref.start()
         local = LocalPipelineRunner(pc)
         warm = synthetic_batches(pc, 1, seed=99)
-        tr.train(warm)      # compile both arms outside the timed window
+        tr.train(warm)      # compile all arms outside the timed window
+        tr_ref.train(warm)
         local.train(warm)
         tok_step = pc.tokens_per_step()
-        pipe_s, local_s = [], []
+        p2p_s, ref_s, local_s = [], [], []
+        p2p_calls = ref_calls = 0
         all_equal = True
         for t in range(trials):
             batches = synthetic_batches(pc, steps, seed=100 + t)
+            c0 = rpc_mod.CALLS
             t0 = time.perf_counter()
             lp = tr.train(batches)
-            pipe_s.append(time.perf_counter() - t0)
+            p2p_s.append(time.perf_counter() - t0)
+            p2p_calls += rpc_mod.CALLS - c0
+            c0 = rpc_mod.CALLS
+            t0 = time.perf_counter()
+            lr = tr_ref.train(batches)
+            ref_s.append(time.perf_counter() - t0)
+            ref_calls += rpc_mod.CALLS - c0
             t0 = time.perf_counter()
             ll = local.train(batches)
             local_s.append(time.perf_counter() - t0)
-            all_equal = all_equal and (lp == ll)
-        pipe_tps = tok_step * steps / (sum(pipe_s) / trials)
+            all_equal = all_equal and (lp == ll) and (lr == ll)
+        p2p_tps = tok_step * steps / (sum(p2p_s) / trials)
+        ref_tps = tok_step * steps / (sum(ref_s) / trials)
         local_tps = tok_step * steps / (sum(local_s) / trials)
+        micro_ops = tr.ideal_micro_ops(steps) * trials
         return {
-            "pipeline_tokens_per_s": pipe_tps,
+            "pipeline_tokens_per_s": p2p_tps,
+            "pipeline_driver_tokens_per_s": ref_tps,
             "single_gang_tokens_per_s": local_tps,
-            "ratio": pipe_tps / local_tps,
+            "ratio": p2p_tps / local_tps,
+            "ratio_driver": ref_tps / local_tps,
+            "driver_rpcs_per_microop": p2p_calls / micro_ops,
+            "driver_rpcs_per_microop_ref": ref_calls / micro_ops,
+            "rpc_reduction": (
+                ref_calls / p2p_calls if p2p_calls else float("inf")
+            ),
             "loss_bitwise_equal": all_equal,
             "n_stages": pc.n_stages,
             "n_micro": pc.n_micro,
         }
     finally:
         tr.shutdown()
+        tr_ref.shutdown()
 
 
 def bench_pipeline_preemption(steps: int = 8, seed: int = 2026):
@@ -1911,22 +1945,37 @@ def main():
                 except Exception as e:  # noqa: BLE001
                     emit("fault_recovery_task_ms", 0.0, "ms", error=repr(e))
             # MPMD pipeline: orchestration overhead vs the single-gang
-            # baseline at equal chips, interleaved A/B, bitwise-loss
-            # cross-checked (full context in BENCH.md "MPMD pipeline")
-            if remaining() > 90:
+            # baseline at equal chips, interleaved p2p/driver/local
+            # arms, bitwise-loss cross-checked on both distributed arms
+            # (full context in BENCH.md "MPMD pipeline")
+            if remaining() > 120:
                 try:
                     pg = bench_pipeline_gpt2(ray_tpu)
                     emit(
                         "pipeline_gpt2_tokens_per_s",
                         pg["pipeline_tokens_per_s"], "tokens/s",
+                        driver_arm=round(
+                            pg["pipeline_driver_tokens_per_s"], 1),
                         single_gang=round(
                             pg["single_gang_tokens_per_s"], 1),
                         ratio=round(pg["ratio"], 3),
+                        ratio_driver=round(pg["ratio_driver"], 3),
                         loss_bitwise_equal=pg["loss_bitwise_equal"],
                         n_stages=pg["n_stages"],
-                        note="1 CPU host: measures actor-call + shm "
+                        note="1 CPU host: measures actor-call + "
                              "handoff overhead over identical math, "
-                             "not parallel speedup",
+                             "not parallel speedup; headline arm is "
+                             "the p2p channel handoff",
+                    )
+                    emit(
+                        "pipeline_driver_rpcs_per_microop",
+                        pg["driver_rpcs_per_microop"], "rpcs",
+                        driver_ref_arm=round(
+                            pg["driver_rpcs_per_microop_ref"], 2),
+                        reduction=round(pg["rpc_reduction"], 2),
+                        note="outbound driver RPCs (core.rpc.CALLS "
+                             "delta) per ideal micro-op; p2p ships no "
+                             "data refs so only control acks remain",
                     )
                 except Exception as e:  # noqa: BLE001
                     emit("pipeline_gpt2_tokens_per_s", 0.0, "tokens/s",

@@ -65,6 +65,7 @@ __all__ = [
     "LINKS_ACTIVE",
     "LOCAL_ENDPOINT",
     "SITES",
+    "SITE_COLLECTIVE_P2P",
     "SITE_COLLECTIVE_PEER_CONN",
     "SITE_NODE_PREEMPT",
     "SITE_RAYLET_LEASE_GRANT",
@@ -97,6 +98,7 @@ SITE_STORE_PUT = "store.put"
 SITE_RAYLET_LEASE_GRANT = "raylet.lease.grant"
 SITE_NODE_PREEMPT = "node.preempt"
 SITE_COLLECTIVE_PEER_CONN = "collective.peer_conn"
+SITE_COLLECTIVE_P2P = "collective.p2p"
 
 SITES = (
     SITE_RPC_SEND_FRAME,
@@ -105,6 +107,7 @@ SITES = (
     SITE_RAYLET_LEASE_GRANT,
     SITE_NODE_PREEMPT,
     SITE_COLLECTIVE_PEER_CONN,
+    SITE_COLLECTIVE_P2P,
 )
 
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import subprocess
 import sys
 import time
@@ -36,6 +37,12 @@ from ray_tpu.core import rpc
 from ray_tpu.core.errors import FencedError, is_fenced
 
 logger = logging.getLogger(__name__)
+
+#: Pull-source shuffle: one private instance instead of the module-global
+#: random state, so the load-spreading shuffle neither perturbs nor is
+#: perturbed by seeded user code (and stays outside RT116's
+#: unseeded-global-RNG scope if the soak lint ever widens)
+_PULL_SHUFFLE_RNG = random.Random()
 
 #: FaultPlan.delay_s's field default — a node.preempt plan that never set
 #: delay_s means "use the config drain deadline", not a 50 ms drain
@@ -1306,12 +1313,10 @@ class Raylet:
         # source choice spreads the remaining pulls across all replicas —
         # an emergent broadcast tree instead of N full reads of one node
         # (ray: push_manager.h broadcast role, inverted pull-side).
-        import random
-
         peers = [
             loc for loc in locations if loc["node_id"] != self.node_id.hex()
         ]
-        random.shuffle(peers)
+        _PULL_SHUFFLE_RNG.shuffle(peers)
         # health plane: non-suspect copies first (stable sort keeps the
         # shuffle within each class) — a failure-suspected replica costs
         # a full transfer timeout per attempt, so it is the last resort

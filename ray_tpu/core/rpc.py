@@ -47,6 +47,12 @@ RESPONSE_ERR = 2
 NOTIFY = 3
 BATCH = 4  # payload: list of (kind, msg_id, method, payload) messages
 
+# process-wide outbound REQUEST tally (every Connection.call /
+# call_soon, any peer).  Pure diagnostics: the pipeline bench reads
+# the delta across a timed step to report driver rpcs per micro-op
+# for the handoff A/B — never reset, wrap-free in practice.
+CALLS = 0
+
 
 class RpcError(Exception):
     pass
@@ -208,10 +214,12 @@ class Connection:
         """timeout=None → config default; timeout<0 → wait forever.
         ``urgent`` writes the request as its own lone frame ahead of any
         coalesced batch queued this tick (liveness traffic only)."""
+        global CALLS
         if timeout is None:
             timeout = cfg.rpc_call_timeout_s
         elif timeout < 0:
             timeout = None
+        CALLS += 1
         msg_id = next(self._msg_ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
@@ -235,8 +243,10 @@ class Connection:
         write flow control — transport.write buffers unboundedly — so
         callers MUST police `send_backlog` and fall back to an awaiting
         path (conn.drain) past their budget."""
+        global CALLS
         if self._closed:
             raise ConnectionLost(f"connection {self.name} is closed")
+        CALLS += 1
         msg_id = next(self._msg_ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut

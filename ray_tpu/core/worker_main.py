@@ -749,6 +749,18 @@ class WorkerServer:
                     break
                 await asyncio.sleep(0.02)
             state = await loop.run_in_executor(self._exec, ck)
+            # the capture now owns re-delivery: stop this doomed
+            # process's p2p channel streaming (in-flight sends are
+            # cancelled, reform listeners deregistered) — the restored
+            # twin's checkpointed outbox re-offers on reform, and
+            # without the teardown the old incarnation keeps pushing
+            # chunks it already captured, burning the drain window on
+            # dead traffic.  Ordered AFTER the capture (the outbox
+            # snapshot must precede the cancel) and BEFORE serialize.
+            if "ray_tpu.util.collective.channel" in sys.modules:
+                from ray_tpu.util.collective import channel as channel_mod
+
+                channel_mod.drain_teardown()
             s = self.rt.serialize(state)
             # a previous capture's object-plane blob was never consumed
             # (its reply was lost, or that drain fell over before the

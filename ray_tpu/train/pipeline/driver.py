@@ -3,11 +3,21 @@
 ``PipelineTrainer`` places each stage as a gang of ``dp`` long-lived
 actors (one ``train.worker_group.WorkerGroup`` per stage — atomic
 placement-group reservation, node-aware lane ranks) and drives the
-1F1B schedule over the batched task plane: every micro-op is one actor
-call whose activation/grad inputs arrive as ObjectRefs, so the handoff
-rides the data plane's vectored put path (small activations on the
-inline slab, large ones worker-stored in the shm arena and pulled by
-the consuming stage).
+1F1B schedule over the batched task plane.  Two data planes
+(``PipelineConfig.handoff``):
+
+- ``"p2p"`` (default): adjacent stages stream activations/grads over
+  persistent per-lane channels (util/collective/channel.py) and the
+  driver ships NO data per micro-op — ONE ``run_ops`` control RPC per
+  stage per step carries the stage's whole 1F1B op list (stages
+  self-synchronize on channel seq arrival), the edge stages swap tail
+  grads over the lane "T" stream, and stage compute overlaps the
+  in-flight transfers (async channel sends).  Driver RPCs per step
+  collapse from O(micro-ops) to O(stages).
+- ``"driver"``: PR 13's path — every micro-op call's activation/grad
+  inputs arrive as ObjectRefs, riding the data plane's vectored put
+  path (small activations on the inline slab, large ones
+  worker-stored in the shm arena and pulled by the consuming stage).
 
 ``LocalPipelineRunner`` executes the SAME per-stage programs (same
 partition, same accumulation order, same optimizer math) sequentially
@@ -59,12 +69,21 @@ class PipelineConfig:
     # in-flight micro-ops ride retries across a stage migration
     max_task_retries: int = 8
     get_timeout_s: float = 600.0
+    # micro-batch handoff plane: "p2p" streams activations over
+    # persistent stage-to-stage channels; "driver" ships ObjectRefs
+    # through the driver per micro-op (see module docstring)
+    handoff: str = "p2p"
 
     def __post_init__(self):
         if self.micro_batch % self.dp:
             raise ValueError(
                 f"micro_batch {self.micro_batch} must divide over "
                 f"dp {self.dp}"
+            )
+        if self.handoff not in ("p2p", "driver"):
+            raise ValueError(
+                f"handoff must be 'p2p' or 'driver', got "
+                f"{self.handoff!r}"
             )
 
     @property
@@ -92,6 +111,8 @@ class PipelineConfig:
             "group_name": f"{self.name}:stage{stage_idx}",
             "collective_backend": self.collective_backend,
             "collective_options": self.collective_options,
+            "handoff": self.handoff,
+            "lane_group": f"{self.name}:lane{lane}:pp",
         }
 
 
@@ -228,46 +249,72 @@ class PipelineTrainer:
         S, M, dp, step = cfg.n_stages, cfg.n_micro, cfg.dp, self.step
         mb = cfg.lane_mb
         A = self.actors
-        h: Dict[Tuple[int, int, int], Any] = {}   # (s, m, r) -> ref
-        g: Dict[Tuple[int, int, int], Any] = {}
         sink = []  # refs gathered only to surface errors
-        for s, kind, m in sched.submission_order(S, M):
+        if cfg.handoff == "p2p":
+            # pure control plane, O(1) RPCs per stage per step: ONE
+            # run_ops call ships a stage's whole 1F1B op list (plus the
+            # edge stages' token/target slices); the stages move every
+            # activation, grad, and tail-grad between themselves on the
+            # lane channels, self-synchronizing on seq arrival, and
+            # each reply is a tiny ack
+            for s in range(S):
+                ops = sched.stage_ops(s, S, M)
+                for r in range(dp):
+                    rows = slice(r * mb, (r + 1) * mb)
+                    sink.append(A[s][r].run_ops.remote(
+                        step, ops,
+                        tokens[:, rows] if s == 0 else None,
+                        targets[:, rows] if s == S - 1 else None,
+                    ))
+            applies = [
+                A[s][r].apply_gradients.remote(step)
+                for r in range(dp) for s in range(S)
+            ]
+        else:
+            h: Dict[Tuple[int, int, int], Any] = {}   # (s, m, r) -> ref
+            g: Dict[Tuple[int, int, int], Any] = {}
+            for s, kind, m in sched.submission_order(S, M):
+                for r in range(dp):
+                    rows = slice(r * mb, (r + 1) * mb)
+                    if kind == "F":
+                        if s == 0:
+                            ref = A[0][r].forward.remote(
+                                step, m, tokens[m, rows]
+                            )
+                            h[(0, m, r)] = ref
+                        elif s == S - 1:
+                            ref = A[s][r].forward.remote(
+                                step, m, h[(s - 1, m, r)],
+                                targets[m, rows]
+                            )
+                            g[(s, m, r)] = ref   # fused: F returns grad
+                        else:
+                            ref = A[s][r].forward.remote(
+                                step, m, h[(s - 1, m, r)]
+                            )
+                            h[(s, m, r)] = ref
+                    else:
+                        ref = A[s][r].backward.remote(
+                            step, m, g[(s + 1, m, r)]
+                        )
+                        if s == 0:
+                            sink.append(ref)
+                        else:
+                            g[(s, m, r)] = ref
+            tg_first = [A[0][r].tail_grads.remote(step) for r in range(dp)]
+            tg_last = [
+                A[S - 1][r].tail_grads.remote(step) for r in range(dp)
+            ]
+            applies = []
             for r in range(dp):
-                rows = slice(r * mb, (r + 1) * mb)
-                if kind == "F":
-                    if s == 0:
-                        ref = A[0][r].forward.remote(
-                            step, m, tokens[m, rows]
-                        )
-                        h[(0, m, r)] = ref
-                    elif s == S - 1:
-                        ref = A[s][r].forward.remote(
-                            step, m, h[(s - 1, m, r)], targets[m, rows]
-                        )
-                        g[(s, m, r)] = ref   # fused: F returns grad
-                    else:
-                        ref = A[s][r].forward.remote(
-                            step, m, h[(s - 1, m, r)]
-                        )
-                        h[(s, m, r)] = ref
-                else:
-                    ref = A[s][r].backward.remote(step, m, g[(s + 1, m, r)])
-                    if s == 0:
-                        sink.append(ref)
-                    else:
-                        g[(s, m, r)] = ref
-        tg_first = [A[0][r].tail_grads.remote(step) for r in range(dp)]
-        tg_last = [A[S - 1][r].tail_grads.remote(step) for r in range(dp)]
-        applies = []
-        for r in range(dp):
-            applies.append(
-                A[0][r].apply_gradients.remote(step, tg_last[r])
-            )
-            applies.append(
-                A[S - 1][r].apply_gradients.remote(step, tg_first[r])
-            )
-            for s in range(1, S - 1):
-                applies.append(A[s][r].apply_gradients.remote(step))
+                applies.append(
+                    A[0][r].apply_gradients.remote(step, tg_last[r])
+                )
+                applies.append(
+                    A[S - 1][r].apply_gradients.remote(step, tg_first[r])
+                )
+                for s in range(1, S - 1):
+                    applies.append(A[s][r].apply_gradients.remote(step))
         loss_refs = [A[S - 1][r].step_loss.remote(step) for r in range(dp)]
         try:
             ray_tpu.get(sink + applies, timeout=cfg.get_timeout_s)

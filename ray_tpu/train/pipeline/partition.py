@@ -71,6 +71,14 @@ def to_numpy(tree):
     return jax.tree.map(np.asarray, tree)
 
 
+def to_wire(arr):
+    """A channel-ready view of one handoff array: C-contiguous numpy.
+    The p2p channel ships the raw buffer as chunked uint8 views, which
+    requires contiguity; copy-free for ``to_numpy`` outputs (already
+    contiguous), a single copy for strided slices."""
+    return np.ascontiguousarray(arr)
+
+
 class StagePrograms:
     """The jitted programs for ONE pipeline stage.
 

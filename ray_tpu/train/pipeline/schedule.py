@@ -13,7 +13,11 @@ Two pure functions the driver (and tests) share:
   every stage's op list in which each op appears after the op that
   produces its input ref — the order the driver must CREATE the calls
   in (a ref must exist before it can be passed as an argument; it need
-  not be resolved).
+  not be resolved).  Only the driver-ref handoff needs this: under the
+  p2p channel handoff there are no refs to thread, and the driver
+  ships each stage its own ``stage_ops`` list in ONE batched
+  ``run_ops`` control call (stages self-synchronize on channel
+  arrival).
 
 The last stage has no separate B ops: its forward fuses loss + the
 first backward step (see partition.StagePrograms), which is what makes
@@ -91,6 +95,16 @@ def submission_order(n_stages: int,
                 f"(n_stages={n_stages}, n_micro={n_micro})"
             )
     return order
+
+
+def inflight_micros(s: int, n_stages: int, n_micro: int) -> int:
+    """Peak in-flight microbatches at stage ``s`` under 1F1B — warmup
+    depth + the one steady-state forward.  Sizes the channel window
+    (pre-posted receive slots / unreaped async sends): the schedule can
+    never put more than this many of one stage's payloads in flight."""
+    if s == n_stages - 1:
+        return 1  # fused fwd+loss+bwd: consumed as it arrives
+    return min(n_micro, n_stages - s)
 
 
 def bubble_micro_ops(n_stages: int) -> int:

@@ -22,6 +22,19 @@ task): every micro-op is EXACTLY-ONCE under migration —
   configure time; the drain plane's proactive reform re-forms the
   group around the migrated member BEFORE the old node dies.
 
+Micro-batch handoff (spec["handoff"]):
+
+- ``"p2p"`` (default): adjacent stages of one dp lane are ranks of a
+  per-lane collective group and stream activations/grads directly over
+  persistent channels (util/collective/channel.py) — the driver's
+  calls carry no data, only control; ops self-synchronize by fetching
+  ``seq = step·n_micro + micro`` (the ledger key extended to the wire),
+  and async sends overlap the next micro-op's compute.  Channel
+  outboxes ride the checkpoint, so a migrated member re-offers its
+  in-flight payloads into the re-formed group.
+- ``"driver"``: PR 13's path — every activation an ObjectRef through
+  the driver (kept for A/B benching and as the fallback).
+
 Everything crossing the process boundary is numpy (bit-exact buffers);
 jit re-ingests on entry.
 """
@@ -40,6 +53,7 @@ from ray_tpu.train.pipeline.partition import (
     flatten_grads,
     get_partition,
     to_numpy,
+    to_wire,
     unflatten_grads,
 )
 
@@ -62,6 +76,8 @@ class PipelineStageActor:
         self._losses: Dict[int, Dict[int, Any]] = {}
         self._executed = 0
         self._deduped = 0
+        self._ch: Dict[str, Any] = {}
+        self._p2p = False
 
     # -- topology discovery (WorkerGroup rank assignment) ----------------
     def node_info(self) -> dict:
@@ -83,7 +99,7 @@ class PipelineStageActor:
         dp, lane, optimizer, scale, group_name, collective_backend,
         collective_options (optional dict: wire_dtype / algorithm /
         chunk_bytes for the dp grad allreduce — default None keeps the
-        bit-exact fp32 ring).
+        bit-exact fp32 ring), handoff, lane_group (p2p channel group).
         """
         self._build(spec)
         self._blocks = blocks
@@ -95,6 +111,21 @@ class PipelineStageActor:
                 )
             self._tail = tail
             self._opt_tail = to_numpy(self._progs.init_opt(tail))
+        if spec.get("handoff", "driver") == "p2p" and spec["n_stages"] > 1:
+            from ray_tpu.util import collective as col
+
+            # every actor joins its LANE group before its dp group: the
+            # two group families partition the actors two ways, and one
+            # consistent join order keeps the concurrent configure()
+            # rendezvous rounds cycle-free.  No options: activations
+            # must cross the wire bit-exact (quantization is a dp
+            # grad-allreduce concern, never a channel one).
+            col.init_collective_group(
+                spec["n_stages"], spec["stage_idx"],
+                backend=spec.get("collective_backend", "rpc"),
+                group_name=spec["lane_group"],
+            )
+            self._open_channels()
         if spec["dp"] > 1:
             from ray_tpu.util import collective as col
 
@@ -117,6 +148,54 @@ class PipelineStageActor:
         )
         self._spec = spec
 
+    # -- p2p channels ------------------------------------------------------
+    def _open_channels(self) -> None:
+        """Open this stage's persistent channel ends on the lane group
+        (group-lazy: only registers endpoints + reform listeners, so
+        the restore path may call it BEFORE the group re-join)."""
+        from ray_tpu.train.pipeline import schedule as sched
+        from ray_tpu.util.collective.channel import (
+            ChannelReceiver,
+            ChannelSender,
+        )
+
+        spec = self._spec
+        s, S, M = spec["stage_idx"], spec["n_stages"], spec["n_micro"]
+        g = spec["lane_group"]
+        depth = sched.inflight_micros(s, S, M)
+        self._ch = {}
+        if s > 0:
+            self._ch["fwd_in"] = ChannelReceiver(g, "F", s - 1)
+            self._ch["grad_out"] = ChannelSender(g, "B", s - 1,
+                                                 window=depth)
+        if s < S - 1:
+            self._ch["fwd_out"] = ChannelSender(g, "F", s + 1,
+                                                window=depth)
+            self._ch["grad_in"] = ChannelReceiver(g, "B", s + 1)
+        if s in (0, S - 1):
+            # the edge stages exchange their raw tail-grad sums at
+            # apply time over a dedicated "T" stream (seq = step) —
+            # the last driver-mediated data ref gone from the step
+            peer = S - 1 if s == 0 else 0
+            self._ch["tail_out"] = ChannelSender(g, "T", peer)
+            self._ch["tail_in"] = ChannelReceiver(g, "T", peer)
+        self._p2p = True
+
+    def _seq(self, step: int, micro: int) -> int:
+        # the exactly-once ledger key, extended to the wire: pure in
+        # (step, micro), so a migrated retry re-fetches/re-posts the
+        # SAME stream position and dedupes identically
+        return step * self._spec["n_micro"] + micro
+
+    def _reap_sends(self) -> None:
+        """Surface terminal async-send failures on the next micro-op
+        (the overlap engine completes transfers in the background;
+        nothing else would ever observe a late error)."""
+        for ch in self._ch.values():
+            reap = getattr(ch, "reap", None)
+            if reap is not None:
+                reap()
+
     # -- exactly-once ledger ---------------------------------------------
     def _cached(self, key):
         if key in self._ledger:
@@ -125,18 +204,31 @@ class PipelineStageActor:
         return False, None
 
     # -- micro-ops ---------------------------------------------------------
-    def forward(self, step: int, micro: int, payload, targets=None):
+    def forward(self, step: int, micro: int, payload=None, targets=None):
         """First stage: payload = tokens (mb, S) int32, returns h.
         Mid stage: payload = h from the previous stage, returns h.
         Last stage: payload = h, targets = (mb, S); fused
         forward+loss+backward-begin — returns the grad flowing DOWN to
         the previous stage (the per-micro loss is kept here; the driver
-        reads the step mean once via step_loss)."""
+        reads the step mean once via step_loss).
+
+        p2p handoff: non-first stages ignore ``payload`` and fetch
+        ``seq`` off the lane channel; the output is POSTED downstream
+        (async — the transfer overlaps the next op's compute) and the
+        driver gets a tiny control ack instead of the array."""
         key = ("F", step, micro)
         hit, val = self._cached(key)
         if hit:
             return val
         p = self._progs
+        seq = None
+        if self._p2p:
+            seq = self._seq(step, micro)
+            self._reap_sends()
+            if not p.is_first:
+                # fetch BEFORE counting the execution: an op that dies
+                # waiting on the wire did no work to dedupe
+                payload = self._ch["fwd_in"].fetch(seq)
         self._executed += 1
         if p.is_last:
             loss, (gb, gt, gh) = p.fwd_loss(
@@ -145,6 +237,9 @@ class PipelineStageActor:
             self._accumulate(gb, gt)
             self._losses.setdefault(step, {})[micro] = np.float32(loss)
             out = to_numpy(gh)
+            if self._p2p:
+                self._ch["grad_out"].post(seq, to_wire(out))
+                out = True
         else:
             if p.is_first:
                 h = p.fwd(self._blocks, self._tail, payload)
@@ -152,13 +247,17 @@ class PipelineStageActor:
                 h = p.fwd(self._blocks, payload)
             self._stash[micro] = payload
             out = to_numpy(h)
+            if self._p2p:
+                self._ch["fwd_out"].post(seq, to_wire(out))
+                out = True
         self._ledger[key] = out
         return out
 
-    def backward(self, step: int, micro: int, g_out):
+    def backward(self, step: int, micro: int, g_out=None):
         """Recompute-from-stash backward for first/mid stages; returns
         the grad for the stage below (True on the first stage — token
-        grads stop here)."""
+        grads stop here).  p2p handoff: ``g_out`` is fetched off the
+        lane channel and the produced grad posted downstream."""
         key = ("B", step, micro)
         hit, val = self._cached(key)
         if hit:
@@ -169,6 +268,11 @@ class PipelineStageActor:
                 "last-stage backward is fused into forward; the driver "
                 "must not submit B ops to the last stage"
             )
+        seq = None
+        if self._p2p:
+            seq = self._seq(step, micro)
+            self._reap_sends()
+            g_out = self._ch["grad_in"].fetch(seq)
         self._executed += 1
         h_in = self._stash.pop(micro)
         if p.is_first:
@@ -179,8 +283,30 @@ class PipelineStageActor:
             gb, gh = p.bwd(self._blocks, h_in, g_out)
             self._accumulate(gb, None)
             out = to_numpy(gh)
+            if self._p2p:
+                self._ch["grad_out"].post(seq, to_wire(out))
+                out = True
         self._ledger[key] = out
         return out
+
+    def run_ops(self, step: int, ops, tokens=None, targets=None) -> bool:
+        """ONE control RPC per stage per step (p2p): execute this
+        stage's whole 1F1B op list in admission order; activations and
+        grads move on the lane channels, so the call carries only the
+        edge stages' token/target slices — (n_micro, lane_mb, seq_len)
+        — and returns a single ack.  Every micro-op still ledgers
+        individually, so a batch retried after a migration re-executes
+        only the ops actually lost."""
+        for kind, m in ops:
+            if kind == "F":
+                self.forward(
+                    step, m,
+                    tokens[m] if tokens is not None else None,
+                    targets[m] if targets is not None else None,
+                )
+            else:
+                self.backward(step, m)
+        return True
 
     def _accumulate(self, g_blocks, g_tail):
         p = self._progs
@@ -219,6 +345,9 @@ class PipelineStageActor:
         g_blocks = self._acc_blocks
         g_tail = None
         if p.is_first or p.is_last:
+            if (self._p2p and other_tail_grads is None
+                    and "tail_in" in self._ch):
+                other_tail_grads = self._exchange_tail(step)
             # canonical operand order (first_side, last_side): both tail
             # copies compute the identical sum bitwise
             own, other = self._acc_tail, other_tail_grads
@@ -239,12 +368,37 @@ class PipelineStageActor:
         self._acc_blocks = None
         self._acc_tail = None
         self._stash.clear()
+        if self._p2p:
+            # PAST steps only (seq < step·M): the CURRENT step's
+            # payloads stay re-deliverable until the NEXT apply proves
+            # every cross-stage fetch of this step completed — the
+            # driver finishes step k (all acks) before submitting k+1,
+            # so by the apply of k+1 step k is certainly consumed
+            base = step * self._spec["n_micro"]
+            for ch in self._ch.values():
+                # the "T" stream counts in steps, not micro seqs — its
+                # current entry must likewise outlive THIS apply (the
+                # peer edge stage may still be fetching it)
+                ch.purge_below(step if ch.stream == "T" else base)
         self._ledger = {
             k: v for k, v in self._ledger.items() if k[1] >= step
         }
         self._losses = {s: v for s, v in self._losses.items() if s >= step}
         self._ledger[key] = True
         return True
+
+    def _exchange_tail(self, step: int):
+        """Edge-stage tail-grad swap over the lane "T" stream: post the
+        own RAW sum (flattened to one f32 vector), fetch the peer's,
+        unflatten against the local tree (both edges hold the same tail
+        structure).  seq = step — pure, so a migrated retry re-posts
+        and re-fetches the identical position and dedupes on the wire
+        exactly like the micro-op streams."""
+        self._ch["tail_out"].post(
+            step, to_wire(flatten_grads(to_numpy(self._acc_tail)))
+        )
+        peer_flat = self._ch["tail_in"].fetch(step)
+        return unflatten_grads(to_numpy(self._acc_tail), peer_flat)
 
     def _allreduce(self, g_blocks, g_tail):
         """Grad allreduce over the stage group, riding out a migration
@@ -309,7 +463,7 @@ class PipelineStageActor:
         return col.get_rank(self._spec["group_name"])
 
     def counters(self) -> dict:
-        from ray_tpu.common import serialization as ser
+        from ray_tpu.common import faults, serialization as ser
         from ray_tpu.core.runtime import get_runtime
 
         return {
@@ -318,6 +472,10 @@ class PipelineStageActor:
             "deduped": self._deduped,
             "copy_trace": dict(ser.COPY_TRACE),
             "slab_hits": get_runtime().store.stats().get("slab_hits", 0),
+            # RT_FAULTS firings in THIS worker process — chaos tests arm
+            # plans via the env var and can only read the trace through
+            # the actor (faults.trace() is per-process state)
+            "fault_trace": faults.trace(),
         }
 
     # -- migration hooks (PR 9 drain plane) -------------------------------
@@ -335,6 +493,14 @@ class PipelineStageActor:
             "losses": {s: dict(v) for s, v in self._losses.items()},
             "executed": self._executed,
             "deduped": self._deduped,
+            # unpurged channel payloads: the restored twin re-offers
+            # these into the re-formed lane group (acked sends may have
+            # died unconsumed in a co-migrating peer's mailbox)
+            "send_outbox": {
+                name: ch.outbox_state()
+                for name, ch in self._ch.items()
+                if hasattr(ch, "outbox_state")
+            },
         }
 
     def __rt_restore__(self, state):
@@ -350,3 +516,14 @@ class PipelineStageActor:
         self._losses = state["losses"]
         self._executed = state["executed"]
         self._deduped = state["deduped"]
+        spec = state["spec"]
+        if spec.get("handoff") == "p2p" and spec["n_stages"] > 1:
+            # endpoints + reform listeners only — the lane-group
+            # re-join runs AFTER this hook (worker_main's
+            # _rejoin_collective_group), and its install fires the
+            # listeners, which re-offer the restored outboxes
+            self._open_channels()
+            for name, st in (state.get("send_outbox") or {}).items():
+                ch = self._ch.get(name)
+                if ch is not None and hasattr(ch, "restore_outbox"):
+                    ch.restore_outbox(st)

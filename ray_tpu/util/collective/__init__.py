@@ -65,12 +65,19 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     local_group_memberships,
     recv,
     recv_async,
+    recv_launch,
     reducescatter,
     reducescatter_async,
     reform_collective_group,
     reform_collective_group_async,
     send,
     send_async,
+    send_launch,
+)
+from ray_tpu.util.collective.channel import (  # noqa: F401
+    ChannelError,
+    ChannelReceiver,
+    ChannelSender,
 )
 from ray_tpu.util.collective.types import (  # noqa: F401
     CollectiveError,

@@ -123,8 +123,33 @@ class CollectiveManager:
         # a group's traffic, for death detection (inbound recorded at
         # delivery, outbound at peer-channel acquisition)
         self._conn_groups: Dict[Any, set] = {}
+        # group name → callbacks fired whenever a fresh incarnation of
+        # that group is installed (first init, survivor-side reform, or
+        # this process's post-restore re-join).  The persistent-channel
+        # plane (util/collective/channel.py) hangs its reform-resend
+        # here: a sender re-offers its unpurged outbox into every new
+        # incarnation, because acked payloads may have died unconsumed
+        # in a preempted receiver's mailbox.
+        self._group_listeners: Dict[str, list] = {}
         rt.register_rpc_handler(RPC_METHOD, self._handle)
         rt.add_peer_close_watcher(self._on_conn_closed)
+
+    def add_group_listener(self, group_name: str, cb) -> None:
+        """Register ``cb(group_handle)`` to run after every install of
+        ``group_name``.  A returned coroutine is spawned on the io loop;
+        exceptions are logged, never propagated into the install."""
+        self._group_listeners.setdefault(group_name, []).append(cb)
+
+    def remove_group_listener(self, group_name: str, cb) -> None:
+        cbs = self._group_listeners.get(group_name)
+        if cbs is None:
+            return
+        try:
+            cbs.remove(cb)
+        except ValueError:
+            return
+        if not cbs:
+            del self._group_listeners[group_name]
 
     # ---- RPC plane -----------------------------------------------------
     async def _handle(self, conn, payload: dict):
@@ -482,6 +507,15 @@ class CollectiveManager:
                 "(drain-driven proactive reform disabled here)",
                 spec.name, exc_info=True,
             )
+        for cb in list(self._group_listeners.get(spec.name, ())):
+            try:
+                res = cb(gh)
+                if asyncio.iscoroutine(res):
+                    self.rt._spawn(res)
+            except Exception:
+                logger.exception(
+                    "group listener failed for %r", spec.name
+                )
         return gh
 
     def _on_reform_event(self, group_name: str, msg: dict):
@@ -1369,4 +1403,23 @@ def allgather_launch(tensor,
     allreduce_launch)."""
     return _launch(
         allgather_async(tensor, group_name), "allgather", group_name
+    )
+
+
+def send_launch(tensor, dst_rank: int,
+                group_name: str = DEFAULT_GROUP_NAME) -> CollectiveWork:
+    """Start a p2p send and return immediately: the chunked transfer
+    progresses on the runtime loop while the caller computes (the T3
+    overlap shape the pipeline channels build on)."""
+    return _launch(
+        send_async(tensor, dst_rank, group_name), "send", group_name
+    )
+
+
+def recv_launch(tensor, src_rank: int,
+                group_name: str = DEFAULT_GROUP_NAME) -> CollectiveWork:
+    """Start a p2p receive into ``tensor`` and return immediately
+    (see send_launch); ``work.wait()`` before reading the buffer."""
+    return _launch(
+        recv_async(tensor, src_rank, group_name), "recv", group_name
     )

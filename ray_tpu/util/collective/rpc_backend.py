@@ -67,6 +67,35 @@ from ray_tpu.util.collective.types import (
 RPC_METHOD = "collective"
 
 
+def apply_chunk(rt, flat_u8, msg: dict) -> None:
+    """Write one arrived chunk message into a uint8 destination view —
+    the single consumer of the chunk wire format, shared by the ring
+    backend and the pipeline channel plane (collective/channel.py)."""
+    import numpy as np
+
+    off = msg["offset"]
+    if msg["shm"] is not None:
+        pin = rt.store.get(msg["shm"])
+        if pin is None:
+            # data loss mid-stream: the op's partial state is
+            # unrecoverable — a GROUP error, not a usage error
+            raise CollectiveGroupError(
+                f"co-hosted shm chunk {msg['shm'].hex()[:12]} vanished "
+                f"from the arena before it was consumed"
+            )
+        try:
+            flat_u8[off:off + msg["nbytes"]] = np.frombuffer(
+                pin.view, dtype=np.uint8
+            )
+        finally:
+            pin.release()
+        rt.store.delete(msg["shm"])
+    else:
+        flat_u8[off:off + msg["nbytes"]] = np.asarray(
+            msg["data"], dtype=np.uint8
+        ).reshape(-1)
+
+
 def _segment_bounds(n_elems: int, world_size: int) -> List[tuple]:
     """numpy.array_split segmentation as (start, stop) pairs."""
     base, extra = divmod(n_elems, world_size)
@@ -304,30 +333,7 @@ class RpcRingBackend(RuntimeBackend):
                 raise
 
     def _apply_chunk(self, flat_u8, msg: dict) -> None:
-        """Write one arrived chunk into the uint8 destination view."""
-        import numpy as np
-
-        off = msg["offset"]
-        if msg["shm"] is not None:
-            pin = self.rt.store.get(msg["shm"])
-            if pin is None:
-                # data loss mid-ring: the group's partial state is
-                # unrecoverable — a GROUP error, not a usage error
-                raise CollectiveGroupError(
-                    f"co-hosted shm chunk {msg['shm'].hex()[:12]} vanished "
-                    f"from the arena before it was consumed"
-                )
-            try:
-                flat_u8[off:off + msg["nbytes"]] = np.frombuffer(
-                    pin.view, dtype=np.uint8
-                )
-            finally:
-                pin.release()
-            self.rt.store.delete(msg["shm"])
-        else:
-            flat_u8[off:off + msg["nbytes"]] = np.asarray(
-                msg["data"], dtype=np.uint8
-            ).reshape(-1)
+        apply_chunk(self.rt, flat_u8, msg)
 
     async def _recv_into(self, src: int, tag: str, out) -> None:
         """Fill contiguous ndarray ``out`` from (src, tag) chunks."""

@@ -663,7 +663,7 @@ class TestSiteRegistry:
         start = text.index("### Site registry")
         end = text.index("### FaultPlan semantics")
         rows = re.findall(
-            r"^\| `([a-z_.]+)` \|", text[start:end], flags=re.M
+            r"^\| `([a-z0-9_.]+)` \|", text[start:end], flags=re.M
         )
         assert rows, "site table not found in docs/architecture.md"
         documented = set(rows) - {"rpc.link"}
@@ -677,6 +677,7 @@ class TestSiteRegistry:
             faults.SITE_RAYLET_LEASE_GRANT,
             faults.SITE_NODE_PREEMPT,
             faults.SITE_COLLECTIVE_PEER_CONN,
+            faults.SITE_COLLECTIVE_P2P,
         )
         assert len(set(faults.SITES)) == len(faults.SITES)
 

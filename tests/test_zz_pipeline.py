@@ -272,7 +272,7 @@ class TestHandoffCopyDiscipline:
         cfg = gpt2.GPTConfig.tiny(num_layers=2, max_seq_len=32)
         pc = PipelineConfig(
             model_config=cfg, n_stages=2, n_micro=4, micro_batch=2,
-            seq_len=32, name="slabrun",
+            seq_len=32, name="slabrun", handoff="driver",
         )
         # bf16 activation: 2 rows x 32 seq x 64 embed x 2 B = 8 KiB
         act_bytes = 2 * 32 * 64 * 2
@@ -306,7 +306,7 @@ class TestHandoffCopyDiscipline:
         )
         pc = PipelineConfig(
             model_config=cfg, n_stages=2, n_micro=4, micro_batch=4,
-            seq_len=64, name="bigact",
+            seq_len=64, name="bigact", handoff="driver",
         )
         act_bytes = 4 * 64 * 256 * 2  # bf16: 128 KiB > inline cap
         from ray_tpu.common.config import cfg as rtcfg

@@ -1,0 +1,255 @@
+"""The p2p activation channel plane (util.collective.channel).
+
+What the data plane v2 must hold, beyond the end-to-end parity and
+preemption acceptance pinned in test_zz_pipeline.py:
+
+- CHAOS: an armed ``collective.p2p`` drop mid-run — on the send attempt
+  (the attempt aborts before any chunk leaves; the bounded retry
+  re-sends the outbox copy under the same seq) or on the receive poll
+  (the round parks; nothing consumed) — costs NOTHING: the loss
+  trajectory stays bitwise-equal to the undisturbed single-gang
+  reference and no micro-op re-executes beyond the bubble bound,
+  because seq = step·n_micro + micro is a pure function of the schedule
+  and the receiver dedupes chunk offsets across attempts.
+- REFORM RESEND: after a receiver-side member dies and a replacement
+  joins via ``reform_collective_group``, the sender's group listener
+  re-offers its whole outbox under the new incarnation — the
+  replacement fetches every undelivered seq without any re-post from
+  the application.
+- The outbox is bounded by ``purge_below`` (the step-boundary hook) and
+  empty payloads are rejected loudly (a zero-byte send has no chunks to
+  ack, so delivery could never be confirmed).
+
+Named ``test_zz_*`` so the file sorts past the tier-1 870 s truncation
+window (cluster spin-up + jax compiles; see ROADMAP).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.common import faults
+from ray_tpu.common.faults import FaultPlan
+from ray_tpu.models import gpt2
+from ray_tpu.train.pipeline import (
+    LocalPipelineRunner,
+    PipelineConfig,
+    PipelineTrainer,
+    bubble_micro_ops,
+    synthetic_batches,
+)
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+    os.environ.pop("RT_FAULTS", None)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: nth-hit collective.p2p drop mid-transfer is invisible
+# ---------------------------------------------------------------------------
+
+
+class TestChannelChaos:
+    @pytest.mark.parametrize("side", ["send", "recv"])
+    def test_nth_hit_drop_is_bitwise_invisible(self, side):
+        """Arm a deterministic drop window on the forward stream (hits
+        3-4 in every worker that reaches them) via RT_FAULTS — inherited
+        by the stage worker processes — and train.  The channel absorbs
+        the drop internally (send: bounded retry of the same seq; recv:
+        the poll round parks), so the trajectory is bitwise the
+        reference's, ledger dedupe costs at most one bubble of
+        re-executed micro-ops, and the firing is visible in the worker
+        fault traces."""
+        name = f"chaos{side[0]}"
+        plan = FaultPlan(
+            site=faults.SITE_COLLECTIVE_P2P, action="drop",
+            match=f"{name}:lane0:pp:{side}:F.", nth=3, count=2,
+        )
+        os.environ["RT_FAULTS"] = faults.plans_to_json([plan])
+        cfg = gpt2.GPTConfig.tiny(num_layers=3, max_seq_len=32)
+        pc = PipelineConfig(
+            model_config=cfg, n_stages=3, n_micro=4, micro_batch=2,
+            seq_len=32, optimizer={"name": "adam", "lr": 1e-3},
+            name=name,
+        )
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        try:
+            tr = PipelineTrainer(pc, bundle={"CPU": 1})
+            tr.start()
+            steps = 3
+            batches = synthetic_batches(pc, steps)
+            losses = tr.train(batches)
+            ref = LocalPipelineRunner(pc)
+            assert losses == ref.train(batches), (
+                f"loss trajectory diverged under an injected "
+                f"collective.p2p {side} drop"
+            )
+            counters = tr.counters()
+            executed = sum(
+                c["executed"] for lanes in counters for c in lanes
+            )
+            dups = executed - tr.ideal_micro_ops(steps)
+            assert 0 <= dups <= bubble_micro_ops(pc.n_stages), (
+                f"{dups} duplicate micro-ops > one bubble"
+            )
+            fired = [
+                e
+                for lanes in counters
+                for c in lanes
+                for e in c["fault_trace"]
+                if e["site"] == faults.SITE_COLLECTIVE_P2P
+            ]
+            assert fired, (
+                "the armed collective.p2p plan never fired — the chaos "
+                "test stopped testing anything"
+            )
+            for e in fired:
+                assert e["action"] == "drop"
+                assert f":{side}:F." in e["ctx"], e["ctx"]
+            tr.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Reform resend: a replacement receiver gets the outbox re-offered
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class ChanMember:
+    """One end of a 2-rank channel group, driven method-by-method."""
+
+    def init(self, world, rank, group):
+        col.init_collective_group(world, rank, group_name=group)
+        return col.get_rank(group)
+
+    def open_sender(self, group, dst, window=2):
+        self._tx = col.ChannelSender(group, "F", dst, window=window)
+        return True
+
+    def open_receiver(self, group, src):
+        self._rx = col.ChannelReceiver(group, "F", src)
+        return True
+
+    def post(self, seq, arr):
+        self._tx.post(seq, np.asarray(arr))
+        return True
+
+    def post_empty_error(self, seq):
+        try:
+            self._tx.post(seq, np.empty((0,), np.float32))
+        except col.ChannelError as e:
+            return str(e)
+        return None
+
+    def flush(self):
+        self._tx.flush(timeout=90.0)
+        return True
+
+    def fetch(self, seq):
+        return self._rx.fetch(seq, timeout=90.0)
+
+    def outbox_seqs(self):
+        return sorted(self._tx.outbox_state())
+
+    def purge_below(self, seq):
+        self._tx.purge_below(seq)
+        return sorted(self._tx.outbox_state())
+
+    def reform(self, world, group, rank=None):
+        col.reform_collective_group(world, group_name=group, rank=rank)
+        return col.get_rank(group)
+
+    def destroy(self, group):
+        for end in ("_tx", "_rx"):
+            ch = getattr(self, end, None)
+            if ch is not None:
+                ch.close()
+        try:
+            col.destroy_collective_group(group_name=group)
+        except Exception:
+            pass
+        return True
+
+
+class TestChannelReform:
+    def test_replacement_receiver_gets_outbox_resent(self):
+        """Kill the receiving member mid-stream; a REPLACEMENT joins via
+        reform under the dead member's rank.  The sender's group
+        listener must re-offer the whole outbox under the new
+        incarnation: the replacement fetches every seq bitwise — with
+        zero application-level re-posts — and purge_below then bounds
+        the outbox."""
+        group = "chrf2"
+        rng = np.random.default_rng(2026)
+        payloads = {
+            s: rng.standard_normal(4096).astype(np.float32)
+            for s in range(3)
+        }
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        ms = [ChanMember.options(num_cpus=0).remote() for _ in range(2)]
+        try:
+            ranks = ray_tpu.get(
+                [m.init.remote(2, i, group) for i, m in enumerate(ms)],
+                timeout=120,
+            )
+            assert ranks == [0, 1]
+            ray_tpu.get(ms[0].open_sender.remote(group, 1), timeout=60)
+            ray_tpu.get(ms[1].open_receiver.remote(group, 0), timeout=60)
+            # live delivery works end to end before the fault
+            ray_tpu.get(ms[0].post.remote(0, payloads[0]), timeout=60)
+            got = ray_tpu.get(ms[1].fetch.remote(0), timeout=120)
+            assert np.array_equal(got, payloads[0])
+            # park two more seqs in the outbox, delivery acked
+            for s in (1, 2):
+                ray_tpu.get(ms[0].post.remote(s, payloads[s]), timeout=60)
+            ray_tpu.get(ms[0].flush.remote(), timeout=120)
+            assert ray_tpu.get(
+                ms[0].outbox_seqs.remote(), timeout=60
+            ) == [0, 1, 2]
+
+            ray_tpu.kill(ms[1])
+            time.sleep(1.0)
+            fresh = ChanMember.options(num_cpus=0).remote()
+            got_ranks = ray_tpu.get(
+                [
+                    ms[0].reform.remote(2, group),
+                    fresh.reform.remote(2, group, 1),
+                ],
+                timeout=120,
+            )
+            assert got_ranks == [0, 1]
+            ms[1] = fresh
+            # the reform listener re-offered the outbox: the replacement
+            # reads every seq without any new post
+            ray_tpu.get(fresh.open_receiver.remote(group, 0), timeout=60)
+            for s in range(3):
+                got = ray_tpu.get(fresh.fetch.remote(s), timeout=120)
+                assert np.array_equal(got, payloads[s]), (
+                    f"seq {s} not re-delivered bitwise after reform"
+                )
+            # step-boundary purge bounds the outbox
+            assert ray_tpu.get(
+                ms[0].purge_below.remote(3), timeout=60
+            ) == []
+            # zero-byte sends are rejected loudly (no chunks to ack)
+            err = ray_tpu.get(
+                ms[0].post_empty_error.remote(99), timeout=60
+            )
+            assert err is not None and "empty" in err
+        finally:
+            try:
+                ray_tpu.get(
+                    [m.destroy.remote(group) for m in ms], timeout=60
+                )
+            except Exception:
+                pass
+            ray_tpu.shutdown()
